@@ -1,0 +1,21 @@
+"""pegasus-tpu: a from-scratch, TPU-native distributed key-value store.
+
+Capabilities modeled on XiaoMi/pegasus (hash-partitioned tables, PacificA
+replication, LSM storage engine with TTL/versioned value schemas), re-designed
+TPU-first: the storage engine's flush sort and SST compaction (comparator sort,
+k-way level merge, TTL/version/user-rule filtering) run as JAX kernels over
+HBM-resident columnar key-value blocks, hash-range-sharded across a device mesh.
+
+Package map (reference layer in parentheses, see SURVEY.md):
+  base/        key & value codecs                  (src/base)
+  runtime/     config, tasking, counters, failpts  (rDSN runtime slice)
+  engine/      LSM storage engine                  (src/server over RocksDB)
+  ops/         device sort/merge/filter kernels    (the compaction_backend=tpu path)
+  parallel/    mesh-sharded compaction             (hash partitioning across chips)
+  replication/ mutation log + PacificA             (rDSN replication)
+  rpc/         framed TCP RPC + task codes         (rDSN rpc)
+  client/      client library + partition resolver (src/client_lib)
+  shell/       admin CLI                           (src/shell)
+"""
+
+__version__ = "0.1.0"

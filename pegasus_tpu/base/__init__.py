@@ -1,0 +1,35 @@
+from .crc64 import crc64
+from .key_schema import (
+    generate_key,
+    generate_next_bytes,
+    restore_key,
+    key_hash,
+    hash_key_hash,
+    check_key_hash,
+)
+from .value_schema import (
+    generate_timetag,
+    extract_timestamp_from_timetag,
+    ValueSchemaManager,
+    SCHEMAS,
+)
+from . import consts
+from .utils import epoch_now, epoch_begin, c_escape_string
+
+__all__ = [
+    "crc64",
+    "generate_key",
+    "generate_next_bytes",
+    "restore_key",
+    "key_hash",
+    "hash_key_hash",
+    "check_key_hash",
+    "generate_timetag",
+    "extract_timestamp_from_timetag",
+    "ValueSchemaManager",
+    "SCHEMAS",
+    "consts",
+    "epoch_now",
+    "epoch_begin",
+    "c_escape_string",
+]

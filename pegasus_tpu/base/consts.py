@@ -1,0 +1,49 @@
+"""Table app-env key names and scan sentinels (src/base/pegasus_const.{h,cpp}).
+
+App-envs are the per-table dynamic control surface: set through the meta
+server, delivered to every replica, hot-applied by the engine
+(reference: pegasus_server_impl::update_app_envs, src/server/pegasus_server_impl.cpp:2406).
+"""
+
+SCAN_CONTEXT_ID_VALID_MIN = 0
+SCAN_CONTEXT_ID_COMPLETED = -1
+SCAN_CONTEXT_ID_NOT_EXIST = -2
+
+ENV_RESTORE_FORCE_RESTORE = "restore.force_restore"
+ENV_RESTORE_POLICY_NAME = "restore.policy_name"
+ENV_RESTORE_BACKUP_ID = "restore.backup_id"
+
+ENV_USAGE_SCENARIO_KEY = "rocksdb.usage_scenario"
+USAGE_SCENARIO_NORMAL = "normal"
+USAGE_SCENARIO_PREFER_WRITE = "prefer_write"
+USAGE_SCENARIO_BULK_LOAD = "bulk_load"
+
+MANUAL_COMPACT_KEY_PREFIX = "manual_compact."
+MANUAL_COMPACT_DISABLED_KEY = MANUAL_COMPACT_KEY_PREFIX + "disabled"
+MANUAL_COMPACT_MAX_CONCURRENT_RUNNING_COUNT_KEY = (
+    MANUAL_COMPACT_KEY_PREFIX + "max_concurrent_running_count"
+)
+MANUAL_COMPACT_PERIODIC_KEY_PREFIX = MANUAL_COMPACT_KEY_PREFIX + "periodic."
+MANUAL_COMPACT_PERIODIC_TRIGGER_TIME_KEY = MANUAL_COMPACT_PERIODIC_KEY_PREFIX + "trigger_time"
+MANUAL_COMPACT_ONCE_KEY_PREFIX = MANUAL_COMPACT_KEY_PREFIX + "once."
+MANUAL_COMPACT_ONCE_TRIGGER_TIME_KEY = MANUAL_COMPACT_ONCE_KEY_PREFIX + "trigger_time"
+
+MANUAL_COMPACT_TARGET_LEVEL_KEY = "target_level"
+MANUAL_COMPACT_BOTTOMMOST_LEVEL_COMPACTION_KEY = "bottommost_level_compaction"
+MANUAL_COMPACT_BOTTOMMOST_LEVEL_COMPACTION_FORCE = "force"
+MANUAL_COMPACT_BOTTOMMOST_LEVEL_COMPACTION_SKIP = "skip"
+
+# engine-selection env, specific to the TPU rebuild: "cpu" or "tpu"
+COMPACTION_BACKEND_KEY = "compaction_backend"
+
+TABLE_LEVEL_DEFAULT_TTL = "default_ttl"
+
+CHECKPOINT_RESERVE_MIN_COUNT = "rocksdb.checkpoint.reserve_min_count"
+CHECKPOINT_RESERVE_TIME_SECONDS = "rocksdb.checkpoint.reserve_time_seconds"
+
+PEGASUS_CLUSTER_SECTION_NAME = "pegasus.clusters"
+
+ENV_SLOW_QUERY_THRESHOLD = "replica.slow_query_threshold"
+ITERATION_THRESHOLD_TIME_MS = "replica.rocksdb_iteration_threshold_time_ms"
+SPLIT_VALIDATE_PARTITION_HASH = "replica.split.validate_partition_hash"
+USER_SPECIFIED_COMPACTION = "user_specified_compaction"

@@ -1,0 +1,76 @@
+"""Pegasus key codec — byte-identical to the reference format.
+
+stored key = [hash_key_len (uint16 big-endian)] [hash_key bytes] [sort_key bytes]
+(reference: src/base/pegasus_key_schema.h:34-58)
+
+Keys sort byte-lexicographically, so all records of one hash_key are contiguous
+and ordered by sort_key; the length prefix makes short hash_keys sort before
+longer ones that share a prefix, exactly as the reference engine relies on for
+range scans.
+"""
+
+import struct
+
+from .crc64 import crc64
+
+UINT16_MAX = 0xFFFF
+
+
+def generate_key(hash_key: bytes, sort_key: bytes = b"") -> bytes:
+    """pegasus_generate_key (src/base/pegasus_key_schema.h:40-58)."""
+    if len(hash_key) >= UINT16_MAX:
+        raise ValueError("hash key length must be less than UINT16_MAX")
+    return struct.pack(">H", len(hash_key)) + hash_key + sort_key
+
+
+def generate_next_bytes(hash_key: bytes, sort_key: bytes = None) -> bytes:
+    """Adjacent successor key for exclusive range stops.
+
+    pegasus_generate_next_blob (src/base/pegasus_key_schema.h:63-97): strip
+    trailing 0xFF bytes, then increment the last remaining byte. With sort_key
+    None this is the successor of the hash_key prefix (stop for a full
+    hash_key scan); with a sort_key it is the successor of the exact key.
+    """
+    buf = bytearray(generate_key(hash_key, sort_key if sort_key is not None else b""))
+    p = len(buf) - 1
+    while buf[p] == 0xFF:
+        p -= 1
+    buf[p] += 1
+    return bytes(buf[: p + 1])
+
+
+def restore_key(key: bytes) -> tuple:
+    """(hash_key, sort_key) from a stored key (src/base/pegasus_key_schema.h:101-122)."""
+    if len(key) < 2:
+        raise ValueError("key length must be no less than 2")
+    (hash_key_len,) = struct.unpack_from(">H", key, 0)
+    if len(key) < 2 + hash_key_len:
+        raise ValueError("key length must be no less than (2 + hash_key_len)")
+    return key[2 : 2 + hash_key_len], key[2 + hash_key_len :]
+
+
+def key_hash(key: bytes) -> int:
+    """Partition hash from a stored key (src/base/pegasus_key_schema.h:151-167).
+
+    hash_key_len > 0: crc64 of the hash_key; == 0: crc64 of the sort_key —
+    so sort_key-only tables still spread across partitions.
+    """
+    if len(key) < 2:
+        raise ValueError("key length must be no less than 2")
+    (hash_key_len,) = struct.unpack_from(">H", key, 0)
+    if hash_key_len > 0:
+        if len(key) < 2 + hash_key_len:
+            raise ValueError("key length must be no less than (2 + hash_key_len)")
+        return crc64(key[2 : 2 + hash_key_len])
+    return crc64(key[2:])
+
+
+def hash_key_hash(hash_key: bytes) -> int:
+    """pegasus_hash_key_hash (src/base/pegasus_key_schema.h:170-173)."""
+    return crc64(hash_key)
+
+
+def check_key_hash(key: bytes, pidx: int, partition_version: int) -> bool:
+    """True iff this key is served by partition `pidx` under `partition_version`
+    (a 2^k-1 mask during/after split; src/base/pegasus_key_schema.h:178-185)."""
+    return (key_hash(key) & partition_version) == pidx

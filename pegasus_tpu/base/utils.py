@@ -1,0 +1,51 @@
+"""Base utilities (src/base/pegasus_utils.{h,cpp})."""
+
+import time
+
+# TTL timestamps are seconds since 2016-01-01 00:00:00 GMT
+# (src/base/pegasus_utils.h:34-36)
+epoch_begin = 1451606400
+
+
+def epoch_now(now: float = None) -> int:
+    """Seconds since the 2016 epoch; the expire_ts clock."""
+    return int(now if now is not None else time.time()) - epoch_begin
+
+
+_PRINTABLE = set(range(0x20, 0x7F)) - {ord('"'), ord("\\")}
+
+
+def c_escape_string(data: bytes, always_escape: bool = False) -> str:
+    """C-style escaping for log/shell display (src/base/pegasus_utils.h)."""
+    out = []
+    for b in data:
+        if not always_escape and b in _PRINTABLE:
+            out.append(chr(b))
+        elif b == ord('"') and not always_escape:
+            out.append('\\"')
+        elif b == ord("\\") and not always_escape:
+            out.append("\\\\")
+        else:
+            out.append(f"\\x{b:02X}")
+    return "".join(out)
+
+
+def c_unescape_string(s: str) -> bytes:
+    """Inverse of c_escape_string for shell input."""
+    out = bytearray()
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            n = s[i + 1]
+            if n == "x" and i + 3 < len(s):
+                out.append(int(s[i + 2 : i + 4], 16))
+                i += 4
+                continue
+            if n in ('"', "\\"):
+                out.append(ord(n))
+                i += 2
+                continue
+        out.append(ord(c))
+        i += 1
+    return bytes(out)

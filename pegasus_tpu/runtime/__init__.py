@@ -1,0 +1,19 @@
+from .config import Config, define_flag, get_flag
+from .fail_points import fail_point, setup as failpoint_setup, cfg as failpoint_cfg, teardown as failpoint_teardown
+from .perf_counters import PerfCounters, counters
+from .tasking import TaskPools, ThreadPool, Timer
+
+__all__ = [
+    "Config",
+    "define_flag",
+    "get_flag",
+    "fail_point",
+    "failpoint_setup",
+    "failpoint_cfg",
+    "failpoint_teardown",
+    "PerfCounters",
+    "counters",
+    "TaskPools",
+    "ThreadPool",
+    "Timer",
+]

@@ -1,0 +1,137 @@
+"""Layered configuration: ini files with %{var} substitution + typed flags.
+
+Mirrors the rDSN config surface Pegasus consumes (SURVEY.md §5.6):
+  (a) ini sections read via ``Config.get_*`` (dsn_config_get_value_* analogue,
+      reference call sites: src/server/pegasus_server_impl_init.cpp:112-500);
+  (b) typed process-wide flags with validators (DSN_DEFINE_* analogue,
+      src/server/pegasus_server_impl_init.cpp:36-77);
+  (c) dynamic per-table app-envs live in the engine, not here.
+"""
+
+import configparser
+import re
+import threading
+
+_VAR_RE = re.compile(r"%\{([^}]+)\}")
+
+
+class Config:
+    """An ini config with %{var} substitution.
+
+    Variables resolve against a substitution dict passed at load (the
+    reference substitutes launch-time variables like %{cluster.name}).
+    """
+
+    def __init__(self, path: str = None, text: str = None, variables: dict = None):
+        self._parser = configparser.ConfigParser(
+            interpolation=None, strict=False, delimiters=("=",)
+        )
+        self._parser.optionxform = str  # case-sensitive keys like rDSN
+        self._variables = dict(variables or {})
+        if path is not None:
+            with open(path) as f:
+                text = f.read()
+        if text is not None:
+            self._parser.read_string(self._substitute(text))
+
+    def _substitute(self, text: str) -> str:
+        return _VAR_RE.sub(lambda m: str(self._variables.get(m.group(1), m.group(0))), text)
+
+    def sections(self):
+        return self._parser.sections()
+
+    def has_section(self, section: str) -> bool:
+        return self._parser.has_section(section)
+
+    def keys(self, section: str):
+        return list(self._parser[section]) if self.has_section(section) else []
+
+    def get_string(self, section: str, key: str, default: str = "") -> str:
+        try:
+            return self._parser.get(section, key)
+        except (configparser.NoSectionError, configparser.NoOptionError):
+            return default
+
+    def get_int(self, section: str, key: str, default: int = 0) -> int:
+        v = self.get_string(section, key, None)
+        return default if v is None or not v.strip() else int(v)
+
+    def get_float(self, section: str, key: str, default: float = 0.0) -> float:
+        v = self.get_string(section, key, None)
+        return default if v is None or not v.strip() else float(v)
+
+    def get_bool(self, section: str, key: str, default: bool = False) -> bool:
+        v = self.get_string(section, key, None)
+        if v is None:
+            return default
+        return v.strip().lower() in ("true", "1", "yes", "on")
+
+    def get_list(self, section: str, key: str, default=()):
+        v = self.get_string(section, key, None)
+        if v is None:
+            return list(default)
+        return [s.strip() for s in v.split(",") if s.strip()]
+
+    def set(self, section: str, key: str, value) -> None:
+        if not self._parser.has_section(section):
+            self._parser.add_section(section)
+        self._parser.set(section, key, str(value))
+
+
+class _FlagRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flags = {}       # name -> value
+        self._validators = {}  # name -> callable
+
+    def define(self, name, default, validator=None, help=""):
+        with self._lock:
+            if validator is not None and not validator(default):
+                raise ValueError(f"flag {name}: default {default!r} fails validation")
+            self._flags[name] = default
+            if validator is not None:
+                self._validators[name] = validator
+        return default
+
+    def get(self, name):
+        return self._flags[name]
+
+    def set(self, name, value):
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"undefined flag {name}")
+            v = self._validators.get(name)
+            if v is not None and not v(value):
+                raise ValueError(f"flag {name}: value {value!r} fails validation")
+            self._flags[name] = value
+
+    def load_from_config(self, config: Config, section: str = "flags"):
+        for key in config.keys(section):
+            if key in self._flags:
+                cur = self._flags[key]
+                raw = config.get_string(section, key)
+                if isinstance(cur, bool):
+                    val = raw.strip().lower() in ("true", "1", "yes", "on")
+                elif isinstance(cur, int):
+                    val = int(raw)
+                elif isinstance(cur, float):
+                    val = float(raw)
+                else:
+                    val = raw
+                self.set(key, val)
+
+
+FLAGS = _FlagRegistry()
+
+
+def define_flag(name, default, validator=None, help=""):
+    """DSN_DEFINE_{int64,bool,...} analogue with optional validator."""
+    return FLAGS.define(name, default, validator, help)
+
+
+def get_flag(name):
+    return FLAGS.get(name)
+
+
+def set_flag(name, value):
+    FLAGS.set(name, value)

@@ -1,0 +1,85 @@
+"""Deterministic failure injection, modeled on dsn::fail points.
+
+The reference arms points like ``dsn::fail::cfg("db_write_batch_put",
+"10%return()")`` in tests against hooks compiled into the write path
+(src/server/rocksdb_wrapper.cpp:49,90,143,164;
+src/server/test/pegasus_server_write_test.cpp:45-49). Actions support the
+same mini-language subset the tests use:
+
+    "return()"     -> hook returns the given (or default) injected value
+    "return(v)"    -> hook returns v (string)
+    "10%return()"  -> 10% probability
+    "3*return()"   -> only first 3 hits
+    "off()"        -> disabled
+    "print()"      -> log and continue
+"""
+
+import random
+import re
+import threading
+
+_ACTION_RE = re.compile(
+    r"^\s*(?:(?P<pct>\d+(?:\.\d+)?)%)?\s*(?:(?P<cnt>\d+)\*)?\s*(?P<verb>return|off|print)\((?P<arg>[^)]*)\)\s*$"
+)
+
+
+class _FailPointRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._points = {}
+        self._enabled = False
+        self._rng = random.Random(0)
+
+    def setup(self):
+        with self._lock:
+            self._enabled = True
+            self._points.clear()
+
+    def teardown(self):
+        with self._lock:
+            self._enabled = False
+            self._points.clear()
+
+    def cfg(self, name: str, action: str):
+        m = _ACTION_RE.match(action)
+        if not m:
+            raise ValueError(f"bad fail point action: {action!r}")
+        with self._lock:
+            self._points[name] = {
+                "pct": float(m.group("pct")) if m.group("pct") else None,
+                "remaining": int(m.group("cnt")) if m.group("cnt") else None,
+                "verb": m.group("verb"),
+                "arg": m.group("arg"),
+            }
+
+    def evaluate(self, name: str):
+        """None = not triggered; otherwise ("return", arg) or ("print", arg)."""
+        if not self._enabled:
+            return None
+        with self._lock:
+            p = self._points.get(name)
+            if p is None or p["verb"] == "off":
+                return None
+            if p["pct"] is not None and self._rng.uniform(0, 100) >= p["pct"]:
+                return None
+            if p["remaining"] is not None:
+                if p["remaining"] <= 0:
+                    return None
+                p["remaining"] -= 1
+            return (p["verb"], p["arg"])
+
+
+_REGISTRY = _FailPointRegistry()
+setup = _REGISTRY.setup
+teardown = _REGISTRY.teardown
+cfg = _REGISTRY.cfg
+
+
+def fail_point(name: str):
+    """FAIL_POINT_INJECT_F analogue.
+
+    Returns None when not armed/triggered, else the ("return"|"print", arg)
+    tuple; call sites decide what an injected return means (typically an
+    error status short-circuiting the operation).
+    """
+    return _REGISTRY.evaluate(name)

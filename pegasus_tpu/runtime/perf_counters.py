@@ -1,0 +1,151 @@
+"""Perf counter registry: number / volatile number / rate / percentile.
+
+The four counter kinds the reference uses everywhere via perf_counter_wrapper
+(SURVEY.md §5.5; e.g. 30+ counters in src/server/pegasus_server_impl.h:427-464),
+scrapable by name (shell `perf-counters[-by-substr/-by-prefix]` remote command,
+src/shell/command_helper.h:891-1146).
+"""
+
+import bisect
+import threading
+import time
+
+
+class Counter:
+    KIND = "number"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def increment(self, by: int = 1):
+        with self._lock:
+            self._value += by
+
+    def add(self, by):
+        self.increment(by)
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class VolatileCounter(Counter):
+    """Reads reset the count (per-interval deltas, rDSN volatile_number)."""
+
+    KIND = "volatile_number"
+
+    def value(self):
+        with self._lock:
+            v, self._value = self._value, 0
+            return v
+
+
+class RateCounter(Counter):
+    """Events per second since the last read."""
+
+    KIND = "rate"
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._last_read = time.monotonic()
+
+    def value(self):
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._last_read, 1e-9)
+            v, self._value, self._last_read = self._value, 0, now
+            return v / dt
+
+
+class PercentileCounter(Counter):
+    """Sliding-window percentiles (p50/p90/p95/p99/p999)."""
+
+    KIND = "percentile"
+    WINDOW = 5000
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._samples = []
+        self._idx = 0
+
+    def set(self, value):
+        with self._lock:
+            if len(self._samples) < self.WINDOW:
+                self._samples.append(value)
+            else:
+                self._samples[self._idx] = value
+                self._idx = (self._idx + 1) % self.WINDOW
+
+    add = set
+    increment = set
+
+    def percentile(self, p: float):
+        with self._lock:
+            if not self._samples:
+                return 0
+            s = sorted(self._samples)
+            k = min(len(s) - 1, int(p * len(s)))
+            return s[k]
+
+    def value(self):
+        return self.percentile(0.99)
+
+
+_KINDS = {c.KIND: c for c in (Counter, VolatileCounter, RateCounter, PercentileCounter)}
+
+
+class PerfCounters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+
+    def get(self, name: str, kind: str = "number"):
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = _KINDS[kind](name)
+                self._counters[name] = c
+            elif c.KIND != kind:
+                raise TypeError(
+                    f"counter {name!r} already registered as {c.KIND}, requested {kind}"
+                )
+            return c
+
+    def number(self, name):
+        return self.get(name, "number")
+
+    def volatile_number(self, name):
+        return self.get(name, "volatile_number")
+
+    def rate(self, name):
+        return self.get(name, "rate")
+
+    def percentile(self, name):
+        return self.get(name, "percentile")
+
+    def snapshot(self, substr: str = None, prefix: str = None) -> dict:
+        """perf-counters[-by-substr/-by-prefix] scrape."""
+        with self._lock:
+            items = list(self._counters.items())
+        out = {}
+        for name, c in items:
+            if substr is not None and substr not in name:
+                continue
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            out[name] = c.value()
+        return out
+
+    def remove(self, name: str):
+        with self._lock:
+            self._counters.pop(name, None)
+
+
+# process-wide registry, like rDSN's global counter table
+counters = PerfCounters()

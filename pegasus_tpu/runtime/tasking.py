@@ -1,0 +1,186 @@
+"""Task/threadpool engine: named pools, task-code specs, timers.
+
+The rDSN slice Pegasus consumes (SURVEY.md §2.4 row 2): work is enqueued onto
+named pools (THREAD_POOL_DEFAULT/REPLICATION/LOCAL_APP/COMPACT/...), each task
+code carries a spec (pool, priority, is_write, allow_batch, idempotent —
+DEFINE_STORAGE_WRITE_RPC_CODE, src/include/rrdb/rrdb.code.definition.h:25-40),
+and timers repeat on a pool (dsn::tasking::enqueue_timer,
+src/server/pegasus_server_impl.cpp:1536-1554).
+
+Heavy compute in this build lives in numpy/JAX (GIL released), so Python
+worker threads are an adequate host-side executor; the C++ runtime module
+replaces this hot path later without changing the interface.
+"""
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TaskCode:
+    """A named task type bound to a pool with scheduling attributes."""
+
+    name: str
+    pool: str = "THREAD_POOL_DEFAULT"
+    priority: int = 1  # 0=LOW, 1=COMMON, 2=HIGH
+    is_write: bool = False
+    allow_batch: bool = False
+    idempotent: bool = False
+
+
+_task_codes = {}
+
+
+def define_task_code(name, pool="THREAD_POOL_DEFAULT", priority=1, is_write=False,
+                     allow_batch=False, idempotent=False) -> TaskCode:
+    code = TaskCode(name, pool, priority, is_write, allow_batch, idempotent)
+    _task_codes[name] = code
+    return code
+
+
+def task_code(name: str) -> TaskCode:
+    return _task_codes[name]
+
+
+class ThreadPool:
+    """A named fixed-size worker pool.
+
+    Two internal queues: `_delayed` ordered by ready time, and `_ready`
+    ordered by (priority desc, FIFO). Workers migrate due delayed tasks into
+    the ready queue, so priority decides ordering among runnable tasks and a
+    delayed task cannot starve behind a stream of immediate ones.
+    """
+
+    def __init__(self, name: str, worker_count: int = 1):
+        self.name = name
+        self._delayed = []  # (ready_at, seq, priority, fn, args)
+        self._ready = []    # (-priority, seq, fn, args)
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._shutdown = False
+        self._workers = [
+            threading.Thread(target=self._run, name=f"{name}.{i}", daemon=True)
+            for i in range(worker_count)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def enqueue(self, fn, *args, priority: int = 1, delay_s: float = 0.0):
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError(f"pool {self.name} is shut down")
+            seq = next(self._counter)
+            if delay_s <= 0:
+                heapq.heappush(self._ready, (-priority, seq, fn, args))
+            else:
+                heapq.heappush(self._delayed, (time.monotonic() + delay_s, seq, priority, fn, args))
+            self._not_empty.notify()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while True:
+                    if self._shutdown:
+                        return
+                    now = time.monotonic()
+                    while self._delayed and self._delayed[0][0] <= now:
+                        _, seq, priority, fn, args = heapq.heappop(self._delayed)
+                        heapq.heappush(self._ready, (-priority, seq, fn, args))
+                    if self._ready:
+                        _, _, fn, args = heapq.heappop(self._ready)
+                        break
+                    if self._delayed:
+                        self._not_empty.wait(timeout=self._delayed[0][0] - now)
+                    else:
+                        self._not_empty.wait()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 - a task must never kill its worker
+                import logging, traceback
+
+                logging.getLogger("pegasus_tpu.tasking").error(
+                    "task raised in pool %s:\n%s", self.name, traceback.format_exc()
+                )
+
+    def stop(self):
+        """Stop workers; pending (including delayed) tasks are discarded."""
+        with self._lock:
+            self._shutdown = True
+            self._delayed.clear()
+            self._ready.clear()
+            self._not_empty.notify_all()
+        for w in self._workers:
+            w.join(timeout=5)
+
+
+class Timer:
+    """Repeating timer posting onto a pool; cancel() stops future firings."""
+
+    def __init__(self, pool: ThreadPool, interval_s: float, fn, *args, first_delay_s=None):
+        self._pool = pool
+        self._interval = interval_s
+        self._fn = fn
+        self._args = args
+        self._cancelled = threading.Event()
+        self._schedule(self._interval if first_delay_s is None else first_delay_s)
+
+    def _schedule(self, delay):
+        if not self._cancelled.is_set():
+            try:
+                self._pool.enqueue(self._fire, delay_s=delay)
+            except RuntimeError:
+                self._cancelled.set()  # pool shut down: the timer dies with it
+
+    def _fire(self):
+        if self._cancelled.is_set():
+            return
+        try:
+            self._fn(*self._args)
+        finally:
+            self._schedule(self._interval)
+
+    def cancel(self):
+        self._cancelled.set()
+
+
+DEFAULT_POOLS = {
+    # name -> worker count; the reference's pool layout (config.ini:82-158)
+    "THREAD_POOL_DEFAULT": 4,
+    "THREAD_POOL_REPLICATION": 4,
+    "THREAD_POOL_LOCAL_APP": 4,
+    "THREAD_POOL_COMPACT": 2,
+    "THREAD_POOL_INGESTION": 2,
+    "THREAD_POOL_META_STATE": 1,
+    "THREAD_POOL_FD": 1,
+    "THREAD_POOL_REPLICATION_LONG": 2,
+    "THREAD_POOL_BLOCK_SERVICE": 2,
+    "THREAD_POOL_SLOG": 1,
+    "THREAD_POOL_PLOG": 2,
+}
+
+
+class TaskPools:
+    """The process's pool container; one per service node."""
+
+    def __init__(self, pool_sizes: dict = None):
+        sizes = dict(DEFAULT_POOLS)
+        if pool_sizes:
+            sizes.update(pool_sizes)
+        self._pools = {name: ThreadPool(name, n) for name, n in sizes.items()}
+
+    def pool(self, name: str) -> ThreadPool:
+        return self._pools[name]
+
+    def enqueue(self, code: TaskCode, fn, *args, delay_s: float = 0.0):
+        self._pools[code.pool].enqueue(fn, *args, priority=code.priority, delay_s=delay_s)
+
+    def enqueue_timer(self, code: TaskCode, interval_s: float, fn, *args, first_delay_s=None):
+        return Timer(self._pools[code.pool], interval_s, fn, *args, first_delay_s=first_delay_s)
+
+    def stop(self):
+        for p in self._pools.values():
+            p.stop()

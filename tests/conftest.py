@@ -1,0 +1,13 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import anywhere in the test session, hence env vars
+set at conftest import time. Mirrors the reference's approach of testing
+multi-node behavior on one machine (onebox, run.sh:480).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

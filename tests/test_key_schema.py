@@ -1,0 +1,97 @@
+"""Key codec tests (reference behavior: src/base/pegasus_key_schema.h,
+reference tests: src/base/test)."""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base import (
+    crc64,
+    generate_key,
+    generate_next_bytes,
+    restore_key,
+    key_hash,
+    hash_key_hash,
+    check_key_hash,
+)
+from pegasus_tpu.base.crc64 import crc64_batch
+
+
+def test_generate_restore_roundtrip():
+    for hk, sk in [
+        (b"hash", b"sort"),
+        (b"", b"sort"),
+        (b"hash", b""),
+        (b"", b""),
+        (b"\x00\xff", b"\xff\x00"),
+        (b"h" * 1000, b"s" * 1000),
+    ]:
+        key = generate_key(hk, sk)
+        assert key[:2] == len(hk).to_bytes(2, "big")
+        rhk, rsk = restore_key(key)
+        assert (rhk, rsk) == (hk, sk)
+
+
+def test_generate_key_layout():
+    # [u16 BE len][hash_key][sort_key]
+    assert generate_key(b"ab", b"cd") == b"\x00\x02abcd"
+    assert generate_key(b"", b"xy") == b"\x00\x00xy"
+
+
+def test_key_too_long():
+    with pytest.raises(ValueError):
+        generate_key(b"x" * 0xFFFF, b"")
+
+
+def test_next_bytes_is_adjacent_successor():
+    # plain increment of last byte
+    assert generate_next_bytes(b"ab") == b"\x00\x02ac"
+    # trailing 0xFF bytes are stripped before increment
+    assert generate_next_bytes(b"a\xff") == b"\x00\x02b"
+    assert generate_next_bytes(b"ab", b"c\xff\xff") == b"\x00\x02abd"
+
+
+def test_next_bytes_orders_all_keys_of_hashkey():
+    hk = b"hashkey"
+    stop = generate_next_bytes(hk)
+    for sk in [b"", b"a", b"\xff" * 8, b"zzz"]:
+        assert generate_key(hk, sk) < stop
+    # and keys of the next hash_key of same length sort >= stop
+    assert generate_key(b"hashkez", b"") >= stop
+
+
+def test_key_hash_uses_hashkey_or_sortkey():
+    k1 = generate_key(b"h", b"s1")
+    k2 = generate_key(b"h", b"s2")
+    assert key_hash(k1) == key_hash(k2) == hash_key_hash(b"h")
+    # empty hash_key: hash over sort_key instead
+    k3 = generate_key(b"", b"s1")
+    k4 = generate_key(b"", b"s2")
+    assert key_hash(k3) != key_hash(k4)
+    assert key_hash(k3) == crc64(b"s1")
+
+
+def test_check_key_hash_partition_mask():
+    key = generate_key(b"pk", b"sk")
+    mask = 7  # 8 partitions
+    pidx = key_hash(key) & mask
+    assert check_key_hash(key, pidx, mask)
+    assert not check_key_hash(key, (pidx + 1) % 8, mask)
+
+
+def test_crc64_known_properties():
+    assert crc64(b"") == 0
+    a, b = crc64(b"hello"), crc64(b"hello!")
+    assert a != b
+    assert crc64(b"hello") == a  # deterministic
+
+
+def test_crc64_batch_matches_scalar():
+    rng = np.random.default_rng(0)
+    keys = [rng.integers(0, 256, size=rng.integers(1, 40), dtype=np.uint8).tobytes() for _ in range(50)]
+    keys.append(b"")
+    arena = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    lengths = np.array([len(k) for k in keys])
+    offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    got = crc64_batch(arena, offsets, lengths)
+    want = np.array([crc64(k) for k in keys], dtype=np.uint64)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,170 @@
+"""Runtime core tests: config/flags, fail points, counters, tasking."""
+
+import threading
+import time
+
+import pytest
+
+from pegasus_tpu.runtime import config as cfg_mod
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.config import Config
+from pegasus_tpu.runtime.perf_counters import PerfCounters
+from pegasus_tpu.runtime.tasking import TaskPools, ThreadPool, Timer, define_task_code
+
+
+def test_config_ini_and_substitution():
+    text = """
+[apps.replica]
+name = replica
+ports = 34801
+run = true
+
+[pegasus.server]
+rocksdb_block_cache_capacity = 1024
+ratio = 0.5
+dirs = /data/a, /data/b
+cluster = %{cluster.name}
+"""
+    c = Config(text=text, variables={"cluster.name": "onebox"})
+    assert c.get_string("apps.replica", "name") == "replica"
+    assert c.get_int("apps.replica", "ports") == 34801
+    assert c.get_bool("apps.replica", "run") is True
+    assert c.get_float("pegasus.server", "ratio") == 0.5
+    assert c.get_list("pegasus.server", "dirs") == ["/data/a", "/data/b"]
+    assert c.get_string("pegasus.server", "cluster") == "onebox"
+    assert c.get_string("missing", "key", "dflt") == "dflt"
+
+
+def test_flags_with_validator():
+    cfg_mod.define_flag("test_flag_x", 10, validator=lambda v: v > 0)
+    assert cfg_mod.get_flag("test_flag_x") == 10
+    cfg_mod.set_flag("test_flag_x", 5)
+    assert cfg_mod.get_flag("test_flag_x") == 5
+    with pytest.raises(ValueError):
+        cfg_mod.set_flag("test_flag_x", -1)
+    with pytest.raises(KeyError):
+        cfg_mod.set_flag("undefined_flag_y", 1)
+
+
+def test_fail_points():
+    fp.setup()
+    try:
+        fp.cfg("p1", "return(err)")
+        assert fp.fail_point("p1") == ("return", "err")
+        fp.cfg("p1", "off()")
+        assert fp.fail_point("p1") is None
+        # count-limited: exactly 2 triggers
+        fp.cfg("p2", "2*return()")
+        hits = sum(1 for _ in range(10) if fp.fail_point("p2"))
+        assert hits == 2
+        # probabilistic: ~10% of 2000
+        fp.cfg("p3", "10%return()")
+        hits = sum(1 for _ in range(2000) if fp.fail_point("p3"))
+        assert 100 < hits < 320
+        assert fp.fail_point("unarmed") is None
+    finally:
+        fp.teardown()
+    assert fp.fail_point("p1") is None  # disabled after teardown
+
+
+def test_perf_counters():
+    pc = PerfCounters()
+    pc.number("n").increment(3)
+    assert pc.number("n").value() == 3
+    v = pc.volatile_number("v")
+    v.increment(5)
+    assert v.value() == 5
+    assert v.value() == 0  # reads reset
+    p = pc.percentile("lat")
+    for i in range(100):
+        p.set(i)
+    assert p.percentile(0.5) == 50
+    assert p.percentile(0.99) == 99
+    snap = pc.snapshot(prefix="n")
+    assert snap == {"n": 3}
+    assert "lat" in pc.snapshot(substr="a")
+
+
+def test_thread_pool_executes_and_delays():
+    pool = ThreadPool("t", 2)
+    try:
+        done = threading.Event()
+        results = []
+        pool.enqueue(lambda: (results.append(1), done.set()))
+        assert done.wait(2)
+        assert results == [1]
+        t0 = time.monotonic()
+        done2 = threading.Event()
+        pool.enqueue(done2.set, delay_s=0.15)
+        assert done2.wait(2)
+        assert time.monotonic() - t0 >= 0.14
+    finally:
+        pool.stop()
+
+
+def test_task_pools_and_timer():
+    pools = TaskPools({"THREAD_POOL_DEFAULT": 1})
+    try:
+        code = define_task_code("LPC_TEST", pool="THREAD_POOL_DEFAULT")
+        fired = []
+        timer = pools.enqueue_timer(code, 0.05, lambda: fired.append(time.monotonic()))
+        time.sleep(0.3)
+        timer.cancel()
+        n = len(fired)
+        assert n >= 3
+        time.sleep(0.15)
+        assert len(fired) <= n + 1  # no further firing after cancel
+    finally:
+        pools.stop()
+
+
+def test_priority_orders_runnable_tasks():
+    pool = ThreadPool("prio", 1)
+    try:
+        gate = threading.Event()
+        order = []
+        done = threading.Event()
+        pool.enqueue(gate.wait)  # hold the single worker
+        for i in range(3):
+            pool.enqueue(lambda i=i: order.append(("low", i)), priority=0)
+        pool.enqueue(lambda: order.append(("high", 0)), priority=2)
+        pool.enqueue(done.set, priority=0)
+        gate.set()
+        assert done.wait(2)
+        assert order[0] == ("high", 0)
+    finally:
+        pool.stop()
+
+
+def test_stop_discards_pending_and_returns_promptly():
+    pool = ThreadPool("stopper", 1)
+    ran = []
+    pool.enqueue(lambda: ran.append(1), delay_s=60.0)
+    t0 = time.monotonic()
+    pool.stop()
+    assert time.monotonic() - t0 < 2
+    assert ran == []
+
+
+def test_counter_kind_collision_raises():
+    pc = PerfCounters()
+    pc.number("x")
+    with pytest.raises(TypeError):
+        pc.rate("x")
+
+
+def test_config_empty_value_falls_back_to_default():
+    c = Config(text="[s]\nk =\n")
+    assert c.get_int("s", "k", 7) == 7
+    assert c.get_float("s", "k", 1.5) == 1.5
+
+
+def test_task_exception_does_not_kill_worker():
+    pool = ThreadPool("t2", 1)
+    try:
+        pool.enqueue(lambda: 1 / 0)
+        done = threading.Event()
+        pool.enqueue(done.set)
+        assert done.wait(2)
+    finally:
+        pool.stop()

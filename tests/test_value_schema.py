@@ -1,0 +1,88 @@
+"""Value schema tests (reference formats: src/base/pegasus_value_schema.h,
+src/base/value_schema_v2.cpp; reference tests: src/base/test)."""
+
+import struct
+
+import pytest
+
+from pegasus_tpu.base.value_schema import (
+    SCHEMAS,
+    ValueSchemaManager,
+    check_if_ts_expired,
+    extract_cluster_id_from_timetag,
+    extract_deleted_from_timetag,
+    extract_timestamp_from_timetag,
+    generate_timetag,
+)
+
+
+def test_v0_layout():
+    v = SCHEMAS[0].generate_value(0x01020304, 0, b"data")
+    assert v == b"\x01\x02\x03\x04data"
+    assert SCHEMAS[0].extract_expire_ts(v) == 0x01020304
+    assert SCHEMAS[0].extract_user_data(v) == b"data"
+
+
+def test_v1_layout():
+    tag = generate_timetag(123456789, 5, True)
+    v = SCHEMAS[1].generate_value(42, tag, b"payload")
+    assert v[:4] == struct.pack(">I", 42)
+    assert v[4:12] == struct.pack(">Q", tag)
+    assert SCHEMAS[1].extract_user_data(v) == b"payload"
+    assert SCHEMAS[1].extract_timetag(v) == tag
+
+
+def test_v2_layout_self_describing():
+    tag = generate_timetag(1, 2, False)
+    v = SCHEMAS[2].generate_value(7, tag, b"u")
+    assert v[0] == 0x82  # 0x80 | version 2
+    assert SCHEMAS[2].extract_expire_ts(v) == 7
+    assert SCHEMAS[2].extract_timetag(v) == tag
+    assert SCHEMAS[2].extract_user_data(v) == b"u"
+
+
+def test_timetag_bit_packing():
+    # (timestamp_us << 8) | (cluster_id << 1) | deleted
+    tag = generate_timetag(0xABCDEF, 0x7F, True)
+    assert extract_timestamp_from_timetag(tag) == 0xABCDEF
+    assert extract_cluster_id_from_timetag(tag) == 0x7F
+    assert extract_deleted_from_timetag(tag) is True
+    # 56-bit timestamp truncation
+    assert extract_timestamp_from_timetag(generate_timetag(1 << 60, 0, False)) == (1 << 60) & (
+        (1 << 56) - 1
+    )
+
+
+def test_update_expire_ts_in_place():
+    for ver in (0, 1, 2):
+        tag = 99 if ver else 0
+        v = SCHEMAS[ver].generate_value(10, tag, b"keepme")
+        v2 = SCHEMAS[ver].update_expire_ts(v, 77)
+        assert SCHEMAS[ver].extract_expire_ts(v2) == 77
+        assert SCHEMAS[ver].extract_user_data(v2) == b"keepme"
+        assert SCHEMAS[ver].extract_timetag(v2) == tag
+
+
+def test_manager_dispatch():
+    mgr = ValueSchemaManager()
+    v0 = SCHEMAS[0].generate_value(1, 0, b"x")
+    v1 = SCHEMAS[1].generate_value(1, 2, b"x")
+    v2 = SCHEMAS[2].generate_value(1, 2, b"x")
+    # table-level version decides when first bit unset
+    assert mgr.get_value_schema(0, v0).VERSION == 0
+    assert mgr.get_value_schema(1, v1).VERSION == 1
+    # per-record version wins when first bit set, regardless of meta cf version
+    assert mgr.get_value_schema(0, v2).VERSION == 2
+    assert mgr.get_value_schema(1, v2).VERSION == 2
+    # unknown future per-record version falls back to latest
+    fake_future = bytes([0x80 | 0x55]) + v2[1:]
+    assert mgr.get_value_schema(0, fake_future).VERSION == 2
+    with pytest.raises(ValueError):
+        mgr.get_value_schema(9, v0)
+
+
+def test_expiry_semantics():
+    assert not check_if_ts_expired(100, 0)  # 0 = no ttl
+    assert check_if_ts_expired(100, 100)
+    assert check_if_ts_expired(100, 99)
+    assert not check_if_ts_expired(100, 101)
