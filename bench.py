@@ -1,0 +1,135 @@
+"""pegasus_bench equivalent: fillrandom + full compaction, cpu vs tpu backend.
+
+Mirrors the reference harness shape (src/test/bench_test: fillrandom_pegasus
+then manual compact; BASELINE.json north star = fillrandom+compact wall-clock
+vs CPU) on this build's engine: generate N records across K overlapping runs
+(an L0 state), then run the full merge+dedup+TTL-filter compaction on the CPU
+backend (vectorized numpy — the stand-in for CPU RocksDB's compaction until
+the C++ harness lands) and on the TPU backend (JAX kernels on the real chip).
+
+Prints ONE json line:
+  {"metric": ..., "value": speedup, "unit": "x", "vs_baseline": ...}
+vs_baseline is speedup / 1.0 (the CPU path IS the measured baseline; the
+reference publishes no in-repo numbers — BASELINE.md).
+
+Env knobs: PEGASUS_BENCH_N (records, default 2_000_000), PEGASUS_BENCH_VALUE
+(user bytes per value, default 100), PEGASUS_BENCH_RUNS (L0 runs, default 4),
+PEGASUS_BENCH_REPS (timed reps, default 3).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_run(n: int, value_size: int, seed: int, key_space: int) -> "KVBlock":
+    """Vectorized fillrandom: n records, 16B hashkey + 8B sortkey, v2 values,
+    ~10% with TTL already expired, ~5% tombstones."""
+    from pegasus_tpu.engine.block import KVBlock
+
+    rng = np.random.default_rng(seed)
+    klen = 2 + 16 + 8
+    keys = np.zeros((n, klen), dtype=np.uint8)
+    keys[:, 0], keys[:, 1] = 0, 16  # u16 BE hashkey len
+    # hashkeys drawn from a bounded space so runs overlap (dedup work exists)
+    hk_ids = rng.integers(0, key_space, size=n)
+    digits = np.zeros((n, 16), np.uint8)
+    v = hk_ids.copy()
+    for j in range(15, 7, -1):
+        digits[:, j] = 48 + (v % 10)
+        v //= 10
+    digits[:, :8] = np.frombuffer(b"userhash", dtype=np.uint8)
+    keys[:, 2:18] = digits
+    keys[:, 18:26] = rng.integers(0, 256, size=(n, 8), dtype=np.uint8)
+
+    vlen = 13 + value_size  # v2 header + payload
+    vals = rng.integers(0, 256, size=(n, vlen), dtype=np.uint8)
+    vals[:, 0] = 0x82
+    expire = np.zeros(n, np.uint32)
+    with_ttl = rng.random(n) < 0.10
+    expire[with_ttl] = rng.integers(1, 50, size=int(with_ttl.sum()), dtype=np.uint32)
+    vals[:, 1] = (expire >> 24).astype(np.uint8)
+    vals[:, 2] = (expire >> 16).astype(np.uint8)
+    vals[:, 3] = (expire >> 8).astype(np.uint8)
+    vals[:, 4] = expire.astype(np.uint8)
+    vals[:, 5:13] = 0
+    deleted = rng.random(n) < 0.05
+
+    from pegasus_tpu.base.crc64 import crc64_batch
+
+    hashes = crc64_batch(keys.reshape(-1), np.arange(n, dtype=np.int64) * klen + 2,
+                         np.full(n, 16, np.int64))
+    return KVBlock(
+        key_arena=keys.reshape(-1),
+        key_off=np.arange(n, dtype=np.int64) * klen,
+        key_len=np.full(n, klen, np.int32),
+        val_arena=vals.reshape(-1),
+        val_off=np.arange(n, dtype=np.int64) * vlen,
+        val_len=np.full(n, vlen, np.int32),
+        expire_ts=expire,
+        hash32=(hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        deleted=deleted,
+    )
+
+
+def time_backend(runs, backend: str, reps: int) -> tuple:
+    from pegasus_tpu.ops.compact import CompactOptions, compact_blocks
+
+    opts = CompactOptions(backend=backend, now=100, bottommost=True)
+    # warmup (jit compile for tpu; page-in for cpu)
+    out = compact_blocks(runs, opts)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = compact_blocks(runs, opts)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    n_total = int(os.environ.get("PEGASUS_BENCH_N", 2_000_000))
+    value_size = int(os.environ.get("PEGASUS_BENCH_VALUE", 100))
+    n_runs = int(os.environ.get("PEGASUS_BENCH_RUNS", 4))
+    reps = int(os.environ.get("PEGASUS_BENCH_REPS", 3))
+
+    t0 = time.perf_counter()
+    per = n_total // n_runs
+    runs = [make_run(per, value_size, seed=s, key_space=max(1, n_total // 2))
+            for s in range(n_runs)]
+    fill_s = time.perf_counter() - t0
+
+    cpu_s, cpu_out = time_backend(runs, "cpu", reps)
+    tpu_s, tpu_out = time_backend(runs, "tpu", reps)
+    assert cpu_out.block.n == tpu_out.block.n, "backend outputs diverge"
+
+    speedup = cpu_s / tpu_s
+    recs_per_s = n_total / tpu_s
+    result = {
+        "metric": "fillrandom+compact: tpu-backend compaction speedup vs cpu backend "
+                  f"({n_total} records, {n_runs} runs, value={value_size}B)",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "detail": {
+            "fill_s": round(fill_s, 3),
+            "cpu_compact_s": round(cpu_s, 3),
+            "tpu_compact_s": round(tpu_s, 3),
+            "tpu_records_per_s": int(recs_per_s),
+            "output_records": int(tpu_out.block.n),
+            "platform": _platform(),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _platform() -> str:
+    import jax
+
+    return str(jax.devices()[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+from .block import KVBlock
+from .memtable import Memtable
+from .sstable import SSTable, read_sst, write_sst
+
+__all__ = [
+    "KVBlock",
+    "EngineOptions",
+    "LsmEngine",
+    "WriteBatch",
+    "Memtable",
+    "SSTable",
+    "read_sst",
+    "write_sst",
+]
+
+
+def __getattr__(name):
+    # db imports ops.compact, which imports engine.block via this package;
+    # resolve the engine-level classes lazily to keep the import DAG acyclic
+    if name in ("EngineOptions", "LsmEngine", "WriteBatch"):
+        from . import db
+
+        return getattr(db, name)
+    raise AttributeError(name)

@@ -1,0 +1,164 @@
+"""KVBlock: the columnar record batch the whole engine is built around.
+
+The reference engine hands RocksDB one record at a time (WriteBatch entries,
+compaction-filter callbacks on single KVs — src/server/rocksdb_wrapper.cpp,
+src/server/key_ttl_compaction_filter.h:36). A TPU can't be fed that way: the
+unit of work here is a *block* of records in structure-of-arrays layout —
+byte arenas for variable-length keys/values plus fixed-width numpy columns
+(expire_ts, partition hash, tombstone flag) that stream to HBM without
+per-record host work. Flush sorts a block on device; compaction merges many.
+
+Invariants:
+  - keys are full stored keys (base.key_schema layout), so np-lexicographic
+    byte order == engine key order.
+  - hash32 is the low 32 bits of pegasus_key_hash(key): enough for
+    partition-ownership masks (partition counts are far below 2^32), avoids
+    u64 on device.
+  - `deleted` marks tombstones (the engine-level delete marker; the value
+    arena entry is empty for them).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..base.crc64 import crc64_batch
+from ..base.key_schema import key_hash
+
+
+def _as_arena(chunks) -> tuple:
+    """list[bytes] -> (uint8 arena, int64 offsets, int32 lengths)."""
+    lengths = np.fromiter((len(c) for c in chunks), dtype=np.int32, count=len(chunks))
+    offsets = np.zeros(len(chunks), dtype=np.int64)
+    if len(chunks):
+        np.cumsum(lengths[:-1], out=offsets[1:])
+    arena = np.frombuffer(b"".join(chunks), dtype=np.uint8).copy() if chunks else np.zeros(0, np.uint8)
+    return arena, offsets, lengths
+
+
+def _gather_arena(arena, offsets, lengths, idx):
+    """Vectorized gather of variable-length slices: new compact arena for idx."""
+    sel_off = offsets[idx]
+    sel_len = lengths[idx].astype(np.int64)
+    total = int(sel_len.sum())
+    new_off = np.zeros(len(idx), dtype=np.int64)
+    if len(idx):
+        np.cumsum(sel_len[:-1], out=new_off[1:])
+    if total == 0:
+        return np.zeros(0, np.uint8), new_off, sel_len.astype(np.int32)
+    starts = np.repeat(sel_off, sel_len)
+    within = np.arange(total, dtype=np.int64) - np.repeat(new_off, sel_len)
+    return arena[starts + within], new_off, sel_len.astype(np.int32)
+
+
+@dataclass
+class KVBlock:
+    key_arena: np.ndarray  # uint8[total_key_bytes]
+    key_off: np.ndarray    # int64[n]
+    key_len: np.ndarray    # int32[n]
+    val_arena: np.ndarray  # uint8[total_val_bytes]
+    val_off: np.ndarray    # int64[n]
+    val_len: np.ndarray    # int32[n]
+    expire_ts: np.ndarray  # uint32[n]
+    hash32: np.ndarray     # uint32[n] — low 32 bits of pegasus_key_hash
+    deleted: np.ndarray    # bool[n]
+
+    @property
+    def n(self) -> int:
+        return len(self.key_off)
+
+    @property
+    def key_bytes_total(self) -> int:
+        return int(self.key_len.sum())
+
+    @property
+    def val_bytes_total(self) -> int:
+        return int(self.val_len.sum())
+
+    def key(self, i: int) -> bytes:
+        o, l = self.key_off[i], self.key_len[i]
+        return self.key_arena[o : o + l].tobytes()
+
+    def value(self, i: int) -> bytes:
+        o, l = self.val_off[i], self.val_len[i]
+        return self.val_arena[o : o + l].tobytes()
+
+    def keys(self):
+        for i in range(self.n):
+            yield self.key(i)
+
+    @staticmethod
+    def from_records(records, hashes=None) -> "KVBlock":
+        """records: iterable of (key, value, expire_ts, deleted).
+
+        hashes: optional precomputed full key hashes (uint64 iterable); if
+        absent they are computed with the batched crc64 over the hash_key
+        portion (matching base.key_schema.key_hash).
+        """
+        records = list(records)
+        keys = [r[0] for r in records]
+        vals = [r[1] for r in records]
+        ka, ko, kl = _as_arena(keys)
+        va, vo, vl = _as_arena(vals)
+        expire = np.fromiter((r[2] for r in records), dtype=np.uint32, count=len(records))
+        deleted = np.fromiter((bool(r[3]) for r in records), dtype=np.bool_, count=len(records))
+        if hashes is None:
+            hashes = _batch_key_hashes(ka, ko, kl)
+        else:
+            hashes = np.asarray(hashes, dtype=np.uint64)
+        return KVBlock(ka, ko, kl, va, vo, vl, expire,
+                       (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32), deleted)
+
+    def gather(self, idx) -> "KVBlock":
+        """New block with rows idx (in that order); arenas compacted."""
+        idx = np.asarray(idx, dtype=np.int64)
+        ka, ko, kl = _gather_arena(self.key_arena, self.key_off, self.key_len, idx)
+        va, vo, vl = _gather_arena(self.val_arena, self.val_off, self.val_len, idx)
+        return KVBlock(ka, ko, kl, va, vo, vl,
+                       self.expire_ts[idx], self.hash32[idx], self.deleted[idx])
+
+    @staticmethod
+    def concat(blocks) -> "KVBlock":
+        blocks = [b for b in blocks if b.n]
+        if not blocks:
+            return KVBlock.empty()
+        key_arena = np.concatenate([b.key_arena for b in blocks])
+        val_arena = np.concatenate([b.val_arena for b in blocks])
+        k_shift = np.cumsum([0] + [len(b.key_arena) for b in blocks[:-1]])
+        v_shift = np.cumsum([0] + [len(b.val_arena) for b in blocks[:-1]])
+        return KVBlock(
+            key_arena,
+            np.concatenate([b.key_off + s for b, s in zip(blocks, k_shift)]),
+            np.concatenate([b.key_len for b in blocks]),
+            val_arena,
+            np.concatenate([b.val_off + s for b, s in zip(blocks, v_shift)]),
+            np.concatenate([b.val_len for b in blocks]),
+            np.concatenate([b.expire_ts for b in blocks]),
+            np.concatenate([b.hash32 for b in blocks]),
+            np.concatenate([b.deleted for b in blocks]),
+        )
+
+    @staticmethod
+    def empty() -> "KVBlock":
+        z8, z64, z32 = np.zeros(0, np.uint8), np.zeros(0, np.int64), np.zeros(0, np.int32)
+        return KVBlock(z8, z64, z32, z8.copy(), z64.copy(), z32.copy(),
+                       np.zeros(0, np.uint32), np.zeros(0, np.uint32), np.zeros(0, np.bool_))
+
+
+def _batch_key_hashes(key_arena, key_off, key_len) -> np.ndarray:
+    """pegasus_key_hash over every stored key in an arena, vectorized.
+
+    Mirrors base.key_schema.key_hash (reference
+    src/base/pegasus_key_schema.h:151-167): crc64 over the hash_key portion,
+    or over the sort_key when hash_key_len == 0.
+    """
+    n = len(key_off)
+    if n == 0:
+        return np.zeros(0, np.uint64)
+    # hash_key_len: u16 BE at the key start
+    hi = key_arena[key_off].astype(np.uint16)
+    lo = key_arena[key_off + 1].astype(np.uint16)
+    hklen = ((hi << 8) | lo).astype(np.int64)
+    body_off = key_off + 2
+    body_len = np.where(hklen > 0, hklen, key_len.astype(np.int64) - 2)
+    return crc64_batch(key_arena, body_off, body_len)

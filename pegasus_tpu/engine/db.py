@@ -1,0 +1,414 @@
+"""The LSM engine: memtable + L0 runs + leveled SSTs, device-offloaded
+flush/compaction.
+
+Replaces the reference's RocksDB-behind-rocksdb_wrapper
+(src/server/rocksdb_wrapper.{h,cpp}) with a from-scratch LSM designed around
+KVBlocks: writes land in a dict memtable, flush sorts the block on the
+configured backend, compaction feeds whole levels to ops.compact_blocks.
+There is deliberately NO internal WAL: exactly like the reference (which
+disables RocksDB's WAL), the replication mutation log is the WAL and replays
+into the engine on recovery (SURVEY.md §3.2 note).
+
+Durability/decree bookkeeping mirrors the reference invariants (SURVEY.md §7b):
+  - every committed batch records its decree in the in-memory meta store
+    (reference: LAST_FLUSHED_DECREE put into the meta CF within each
+    WriteBatch, src/server/rocksdb_wrapper.cpp:143);
+  - flush persists that decree into the manifest; `last_durable_decree` is
+    what the manifest holds — the replica learns/replays from there.
+"""
+
+import bisect
+import heapq
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..base.key_schema import key_hash
+from ..base.utils import epoch_now
+from ..base.value_schema import check_if_ts_expired
+from ..runtime.fail_points import fail_point
+from ..ops.compact import CompactOptions, compact_blocks, sort_block
+from .block import KVBlock
+from .memtable import Memtable
+from .sstable import SSTable, write_sst
+
+MANIFEST = "MANIFEST"
+
+# meta-store keys (reference: src/server/meta_store.cpp:29)
+META_DATA_VERSION = "pegasus_data_version"
+META_LAST_FLUSHED_DECREE = "pegasus_last_flushed_decree"
+META_LAST_MANUAL_COMPACT_FINISH_TIME = "pegasus_last_manual_compact_finish_time"
+
+
+@dataclass
+class EngineOptions:
+    memtable_bytes: int = 64 << 20
+    l0_compaction_trigger: int = 4
+    backend: str = "cpu"            # compaction_backend: "cpu" | "tpu"
+    prefix_u32: int = 8
+    data_version: int = 2
+    pidx: int = 0
+    partition_mask: int = 0         # >0 enables split stale-key GC in compaction
+    default_ttl: int = 0            # table-level default_ttl app-env
+    max_levels: int = 2             # L0 + one sorted level this round
+
+
+@dataclass
+class WriteBatch:
+    """Atomic mutation set for one decree (one on_batched_write_requests)."""
+
+    ops: list = field(default_factory=list)  # ("put", key, value, expire) | ("del", key)
+
+    def put(self, key: bytes, value: bytes, expire_ts: int = 0):
+        self.ops.append(("put", key, value, expire_ts))
+        return self
+
+    def delete(self, key: bytes):
+        self.ops.append(("del", key, b"", 0))
+        return self
+
+
+class LsmEngine:
+    def __init__(self, path: str, options: EngineOptions = None):
+        self.path = path
+        self.opts = options or EngineOptions()
+        self._lock = threading.RLock()
+        self._mem = Memtable()
+        self._imm = []          # immutable memtables pending flush, newest first
+        self._l0 = []           # list[SSTable], newest first
+        self._levels = {}       # level(int>=1) -> list[SSTable] sorted by min_key
+        self._meta = {}         # the meta-CF equivalent
+        self._next_file = 1
+        self._last_committed_decree = 0
+        os.makedirs(path, exist_ok=True)
+        self._load_manifest()
+
+    # ------------------------------------------------------------------ meta
+
+    @property
+    def meta_store(self) -> dict:
+        return self._meta
+
+    def last_durable_decree(self) -> int:
+        """Decree covered by on-disk SSTs (manifest's last_flushed_decree)."""
+        return int(self._durable_meta.get(META_LAST_FLUSHED_DECREE, 0))
+
+    def last_committed_decree(self) -> int:
+        return self._last_committed_decree
+
+    def data_version(self) -> int:
+        return int(self._meta.get(META_DATA_VERSION, self.opts.data_version))
+
+    # ----------------------------------------------------------------- write
+
+    def write(self, batch: WriteBatch, decree: int) -> None:
+        """Apply one committed batch; analogue of rocksdb_wrapper::write
+        (src/server/rocksdb_wrapper.cpp:143): data ops + decree meta update,
+        atomically under the engine lock."""
+        if fail_point("db_write"):
+            raise IOError("injected db_write failure")
+        with self._lock:
+            for op in batch.ops:
+                kind, key, value, expire = op
+                if kind == "put":
+                    if fail_point("db_write_batch_put"):
+                        raise IOError("injected db_write_batch_put failure")
+                    self._mem.put(key, value, expire)
+                elif kind == "del":
+                    if fail_point("db_write_batch_delete"):
+                        raise IOError("injected db_write_batch_delete failure")
+                    self._mem.delete(key)
+                else:
+                    raise ValueError(f"unknown op {kind}")
+            self._last_committed_decree = decree
+            self._meta[META_LAST_FLUSHED_DECREE] = decree
+            if self._mem.approximate_bytes >= self.opts.memtable_bytes:
+                self._rotate_memtable_locked()
+
+    def put(self, key: bytes, value: bytes, expire_ts: int = 0, decree: int = None):
+        d = decree if decree is not None else self._last_committed_decree + 1
+        self.write(WriteBatch().put(key, value, expire_ts), d)
+
+    def delete(self, key: bytes, decree: int = None):
+        d = decree if decree is not None else self._last_committed_decree + 1
+        self.write(WriteBatch().delete(key), d)
+
+    # ------------------------------------------------------------------ read
+
+    def get(self, key: bytes, now: int = None):
+        """-> value bytes, or None (missing / deleted / expired).
+
+        Search order = recency: memtable, immutables, L0 newest-first, then
+        sorted levels (analogue of the read path in
+        src/server/pegasus_server_impl.cpp:265-341 over our structure).
+        """
+        if fail_point("db_get"):
+            raise IOError("injected db_get failure")
+        now = epoch_now() if now is None else now
+        with self._lock:
+            hit = self._mem.get(key)
+            if hit is None:
+                for imm in self._imm:
+                    hit = imm.get(key)
+                    if hit is not None:
+                        break
+            sources = list(self._l0)
+            levels = {lv: list(fs) for lv, fs in self._levels.items()}
+        if hit is not None:
+            value, expire, deleted = hit
+            if deleted or check_if_ts_expired(now, expire):
+                return None
+            return value
+        for sst in sources:
+            i = sst.find(key)
+            if i >= 0:
+                return self._record_or_none(sst.block(), i, now)
+        for lv in sorted(levels):
+            files = levels[lv]
+            j = bisect.bisect_right([f.min_key for f in files], key) - 1
+            if j >= 0:
+                i = files[j].find(key)
+                if i >= 0:
+                    return self._record_or_none(files[j].block(), i, now)
+        return None
+
+    @staticmethod
+    def _record_or_none(block: KVBlock, i: int, now: int):
+        if block.deleted[i] or check_if_ts_expired(now, int(block.expire_ts[i])):
+            return None
+        return block.value(i)
+
+    def scan(self, start_key: bytes = b"", stop_key: bytes = None, now: int = None,
+             include_deleted: bool = False):
+        """Merged iterator over [start_key, stop_key): yields (key, value,
+        expire_ts) newest-version-wins, tombstones/expired filtered."""
+        now = epoch_now() if now is None else now
+        with self._lock:
+            mem_snapshot = sorted(
+                (k, v) for k, v in self._mem.items()
+                if k >= start_key and (stop_key is None or k < stop_key)
+            )
+            imm_snapshots = [
+                sorted((k, v) for k, v in imm.items()
+                       if k >= start_key and (stop_key is None or k < stop_key))
+                for imm in self._imm
+            ]
+            ssts = list(self._l0)
+            for lv in sorted(self._levels):
+                ssts.extend(self._levels[lv])
+
+        def mem_source(snap):
+            for k, (v, e, d) in snap:
+                yield k, v, e, d
+
+        def sst_source(sst):
+            if sst.n == 0:
+                return
+            b = sst.block()
+            i = sst.lower_bound(start_key) if start_key else 0
+            while i < b.n:
+                k = b.key(i)
+                if stop_key is not None and k >= stop_key:
+                    return
+                yield k, b.value(i), int(b.expire_ts[i]), bool(b.deleted[i])
+                i += 1
+
+        sources = [mem_source(mem_snapshot)]
+        sources += [mem_source(s) for s in imm_snapshots]
+        sources += [sst_source(s) for s in ssts]
+        # recency rank = position in `sources`; lower wins for equal keys
+        heap = []
+        for rank, src in enumerate(sources):
+            it = iter(src)
+            first = next(it, None)
+            if first is not None:
+                heap.append((first[0], rank, first, it))
+        heapq.heapify(heap)
+        prev_key = None
+        while heap:
+            k, rank, rec, it = heap[0]
+            nxt = next(it, None)
+            if nxt is not None:
+                heapq.heapreplace(heap, (nxt[0], rank, nxt, it))
+            else:
+                heapq.heappop(heap)
+            if k == prev_key:
+                continue  # an older version of a key already emitted/skipped
+            prev_key = k
+            _, v, e, d = rec
+            if not include_deleted:
+                if d or check_if_ts_expired(now, e):
+                    continue
+            yield k, v, e
+
+    # ----------------------------------------------------------- flush/compact
+
+    def flush(self) -> None:
+        """Rotate the memtable and flush every immutable to an L0 SST
+        (device-sorted). Synchronous."""
+        with self._lock:
+            self._rotate_memtable_locked()
+            imms = list(self._imm)
+        for imm in reversed(imms):  # oldest first keeps L0 recency order
+            self._flush_one(imm)
+
+    def _rotate_memtable_locked(self):
+        if len(self._mem) == 0:
+            return
+        self._imm.insert(0, self._mem)
+        self._mem = Memtable()
+
+    def _flush_one(self, imm: Memtable) -> None:
+        block = imm.to_block()
+        opts = CompactOptions(backend=self.opts.backend, prefix_u32=self.opts.prefix_u32)
+        sorted_block = sort_block(block, opts)
+        with self._lock:
+            decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
+            name = self._alloc_file_locked()
+            path = os.path.join(self.path, name)
+        write_sst(path, sorted_block, {"level": 0, "last_flushed_decree": decree})
+        with self._lock:
+            self._l0.insert(0, SSTable(path))
+            self._imm.remove(imm)
+            self._write_manifest_locked()
+        if len(self._l0) >= self.opts.l0_compaction_trigger:
+            self.compact(bottommost=True)
+
+    def compact(self, bottommost: bool = True, now: int = None) -> dict:
+        """Merge all L0 runs + the sorted level into one new sorted run on the
+        configured backend — the CompactRange analogue and the TPU seam
+        (reference executor: src/server/pegasus_server_impl.cpp:2814)."""
+        with self._lock:
+            inputs = list(self._l0)
+            old_level = list(self._levels.get(1, []))
+            input_blocks = [s.block() for s in inputs] + [s.block() for s in old_level]
+            if not input_blocks:
+                return {"input_records": 0, "output_records": 0, "dropped": 0}
+        opts = CompactOptions(
+            now=now,
+            pidx=self.opts.pidx,
+            partition_mask=self.opts.partition_mask,
+            bottommost=bottommost,
+            default_ttl=self.opts.default_ttl,
+            prefix_u32=self.opts.prefix_u32,
+            backend=self.opts.backend,
+        )
+        result = compact_blocks(input_blocks, opts)
+        with self._lock:
+            name = self._alloc_file_locked()
+            path = os.path.join(self.path, name)
+            decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
+        write_sst(path, result.block, {"level": 1, "last_flushed_decree": decree})
+        with self._lock:
+            self._levels[1] = [SSTable(path)]
+            for s in inputs:
+                self._l0.remove(s)
+            self._write_manifest_locked()
+        for s in inputs + old_level:
+            s.release()
+            try:
+                os.unlink(s.path)
+            except OSError:
+                pass
+        return result.stats
+
+    def manual_compact(self, bottommost: bool = True, now: int = None) -> dict:
+        self.flush()
+        stats = self.compact(bottommost=bottommost, now=now)
+        self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
+        with self._lock:
+            self._write_manifest_locked()
+        return stats
+
+    # ------------------------------------------------------------- checkpoint
+
+    def checkpoint(self, dest_dir: str) -> int:
+        """Hardlink-based consistent snapshot: checkpoint.{decree} layout
+        (reference: sync_checkpoint / copy_checkpoint_to_dir_unsafe,
+        src/server/pegasus_server_impl.cpp:1666,1863). Returns the decree."""
+        self.flush()
+        with self._lock:
+            os.makedirs(dest_dir, exist_ok=True)
+            for sst in self._all_ssts_locked():
+                dst = os.path.join(dest_dir, os.path.basename(sst.path))
+                if not os.path.exists(dst):
+                    try:
+                        os.link(sst.path, dst)
+                    except OSError:
+                        import shutil
+
+                        shutil.copy2(sst.path, dst)
+            with open(os.path.join(dest_dir, MANIFEST), "w") as f:
+                json.dump(self._manifest_dict_locked(), f)
+            return self.last_durable_decree()
+
+    # -------------------------------------------------------------- manifest
+
+    def _all_ssts_locked(self):
+        out = list(self._l0)
+        for lv in sorted(self._levels):
+            out.extend(self._levels[lv])
+        return out
+
+    def _alloc_file_locked(self) -> str:
+        name = f"{self._next_file:06d}.sst"
+        self._next_file += 1
+        return name
+
+    def _manifest_dict_locked(self) -> dict:
+        return {
+            "next_file": self._next_file,
+            "l0": [os.path.basename(s.path) for s in self._l0],
+            "levels": {str(lv): [os.path.basename(s.path) for s in fs]
+                       for lv, fs in self._levels.items()},
+            "meta": {k: v for k, v in self._meta.items()},
+        }
+
+    def _write_manifest_locked(self):
+        data = self._manifest_dict_locked()
+        tmp = os.path.join(self.path, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, MANIFEST))
+        self._durable_meta = dict(data["meta"])
+
+    def _load_manifest(self):
+        mpath = os.path.join(self.path, MANIFEST)
+        if not os.path.exists(mpath):
+            self._meta = {META_DATA_VERSION: self.opts.data_version}
+            self._durable_meta = {}
+            self._write_manifest_locked()
+            return
+        with open(mpath) as f:
+            m = json.load(f)
+        self._next_file = m["next_file"]
+        self._l0 = [SSTable(os.path.join(self.path, n)) for n in m["l0"]]
+        self._levels = {int(lv): [SSTable(os.path.join(self.path, n)) for n in fs]
+                        for lv, fs in m["levels"].items()}
+        self._meta = dict(m["meta"])
+        self._durable_meta = dict(m["meta"])
+        self._last_committed_decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
+
+    def close(self):
+        pass
+
+    # ------------------------------------------------------------- statistics
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memtable_records": len(self._mem),
+                "memtable_bytes": self._mem.approximate_bytes,
+                "immutable_memtables": len(self._imm),
+                "l0_files": len(self._l0),
+                "level_files": {lv: len(fs) for lv, fs in self._levels.items()},
+                "total_sst_records": sum(s.n for s in self._all_ssts_locked()),
+                "last_committed_decree": self._last_committed_decree,
+                "last_durable_decree": self.last_durable_decree(),
+            }

@@ -1,0 +1,51 @@
+"""Memtable: the mutable in-memory run.
+
+A plain dict keyed by stored key — PacificA serializes writes per partition
+(one decree at a time, SURVEY.md §3.2), so no concurrent-writer structure is
+needed; newest-write-wins within the dict is exactly RocksDB's
+last-sequence-wins inside one memtable. Sorting is deferred to flush, where
+it runs as one batched device sort (the memtable-flush offload of
+BASELINE.json) instead of RocksDB's per-insert skiplist ordering.
+"""
+
+from .block import KVBlock
+
+
+class Memtable:
+    def __init__(self):
+        self._data = {}  # key -> (value_bytes, expire_ts, deleted)
+        self._bytes = 0
+
+    def __len__(self):
+        return len(self._data)
+
+    @property
+    def approximate_bytes(self) -> int:
+        return self._bytes
+
+    def put(self, key: bytes, value: bytes, expire_ts: int = 0):
+        old = self._data.get(key)
+        if old is not None:
+            self._bytes -= len(key) + len(old[0])
+        self._data[key] = (value, expire_ts, False)
+        self._bytes += len(key) + len(value)
+
+    def delete(self, key: bytes):
+        old = self._data.get(key)
+        if old is not None:
+            self._bytes -= len(key) + len(old[0])
+        self._data[key] = (b"", 0, True)
+        self._bytes += len(key)
+
+    def get(self, key: bytes):
+        """-> (value, expire_ts, deleted) or None if the key was never seen."""
+        return self._data.get(key)
+
+    def to_block(self) -> KVBlock:
+        """Unsorted columnar snapshot; the flush path sorts it on device."""
+        return KVBlock.from_records(
+            (k, v, e, d) for k, (v, e, d) in self._data.items()
+        )
+
+    def items(self):
+        return self._data.items()

@@ -1,0 +1,55 @@
+"""Server-pinned scan sessions (src/server/pegasus_scan_context.h:35-140).
+
+A get_scanner/scan sequence holds state on the server between RPCs. Context
+ids carry random high bits so a stale id from before a restart/failover
+misses instead of resuming someone else's iterator (reference :100-110).
+"""
+
+import random
+import threading
+
+
+class ScanContext:
+    def __init__(self, iterator, request):
+        self.iterator = iterator      # the live generator over the engine
+        self.request = request        # the originating GetScannerRequest
+        self.lock = threading.Lock()  # one scan RPC at a time per context
+
+
+class ScanContextCache:
+    def __init__(self, max_contexts: int = 1000):
+        self._lock = threading.Lock()
+        self._contexts = {}
+        self._order = []
+        self._max = max_contexts
+        self._high_bits = random.getrandbits(16) << 32
+        self._next = 0
+
+    def put(self, ctx: ScanContext) -> int:
+        with self._lock:
+            cid = self._high_bits | self._next
+            self._next += 1
+            self._contexts[cid] = ctx
+            self._order.append(cid)
+            while len(self._order) > self._max:
+                old = self._order.pop(0)
+                self._contexts.pop(old, None)
+            return cid
+
+    def fetch(self, cid: int):
+        """Remove and return (re-inserted after use, like the reference's
+        fetch/put dance that keeps eviction order fresh)."""
+        with self._lock:
+            ctx = self._contexts.pop(cid, None)
+            if ctx is not None:
+                self._order.remove(cid)
+            return ctx
+
+    def remove(self, cid: int):
+        with self._lock:
+            if self._contexts.pop(cid, None) is not None:
+                self._order.remove(cid)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._contexts)

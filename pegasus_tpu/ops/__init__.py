@@ -1,0 +1,13 @@
+from .packing import pack_key_prefixes, compute_suffix_ranks, DEFAULT_PREFIX_U32
+from .compact import CompactOptions, CompactResult, compact_blocks, sort_block, get_backend
+
+__all__ = [
+    "pack_key_prefixes",
+    "compute_suffix_ranks",
+    "DEFAULT_PREFIX_U32",
+    "CompactOptions",
+    "CompactResult",
+    "compact_blocks",
+    "sort_block",
+    "get_backend",
+]

@@ -1,0 +1,64 @@
+"""Bitonic multi-column sort — the TPU-shaped sort primitive.
+
+Why not lax.sort: XLA's TPU sort lowers to a comparator network unrolled per
+input size — compile time grows ~linearly with n (measured ~0.3ms/element on
+v5e: 65k elements = 22s, 1M would be minutes). A compaction engine sorts
+fresh shapes constantly; that cost is fatal.
+
+This implementation emits the classic bitonic network as log2(n)*(log2(n)+1)/2
+*vectorized stages*. Each stage reshapes to [blocks, 2, j] so partners (i,
+i^j) are adjacent slices — pure strided slice/compare/select, no gathers —
+and the whole program is O(log^2 n) HLO ops regardless of n. Runtime is
+HBM-bandwidth bound: ~log^2(n) passes over the column set.
+
+Sorts lexicographically by `key_cols` (uint32, first = most significant),
+carrying `payload` (the record permutation). Ties keep original relative
+pair order per stage; callers guarantee key uniqueness (suffix_rank/key_len
+columns) so stability is irrelevant to the contract.
+
+n must be a power of two (the engine pads to pow2 buckets already).
+"""
+
+import jax.numpy as jnp
+
+
+def _lex_less(a_cols, b_cols):
+    """Strict a < b over column lists, vectorized."""
+    less = jnp.zeros(a_cols[0].shape, dtype=bool)
+    eq = jnp.ones(a_cols[0].shape, dtype=bool)
+    for a, b in zip(a_cols, b_cols):
+        less = less | (eq & (a < b))
+        eq = eq & (a == b)
+    return less
+
+
+def bitonic_sort(key_cols, payload):
+    """-> (sorted key_cols, sorted payload), ascending lexicographic."""
+    n = key_cols[0].shape[0]
+    if n & (n - 1):
+        raise ValueError(f"bitonic_sort needs power-of-two n, got {n}")
+    cols = list(key_cols) + [payload]
+    nk = len(key_cols)
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            blocks = n // (2 * j)
+            r = [c.reshape(blocks, 2, j) for c in cols]
+            a = [rc[:, 0, :] for rc in r]  # slots i (low)
+            b = [rc[:, 1, :] for rc in r]  # partners i^j (high)
+            # direction is constant per 2j-block: ascending iff block_start&k==0
+            starts = jnp.arange(blocks, dtype=jnp.uint32) * jnp.uint32(2 * j)
+            up = ((starts & jnp.uint32(k)) == 0)[:, None]
+            b_less_a = _lex_less(b[:nk], a[:nk])
+            a_less_b = _lex_less(a[:nk], b[:nk])
+            swap = jnp.where(up, b_less_a, a_less_b)
+            cols = [
+                jnp.stack(
+                    [jnp.where(swap, bb, aa), jnp.where(swap, aa, bb)], axis=1
+                ).reshape(n)
+                for aa, bb in zip(a, b)
+            ]
+            j //= 2
+        k *= 2
+    return cols[:nk], cols[nk]

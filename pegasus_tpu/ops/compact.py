@@ -1,0 +1,261 @@
+"""Sort / k-way merge / filter: the compaction_backend={cpu,tpu} kernels.
+
+This is the TPU seam of the whole build (SURVEY.md §2.3, BASELINE.json): the
+work RocksDB does record-at-a-time inside CompactRange — comparator sort,
+level merge, TTL/version dedup filtering (reference:
+src/server/key_ttl_compaction_filter.h:36-115, manual compact executor
+src/server/pegasus_server_impl.cpp:2814) — runs here as one batched kernel
+over KVBlock columns:
+
+  1. lexicographic sort by (prefix lanes, suffix_rank, key_len, run_priority)
+     — full byte order of stored keys, newest run first within equal keys;
+  2. dedup: keep only the first (= newest) version of each key;
+  3. filter: drop expired-TTL records, tombstones at the bottommost level,
+     and keys no longer owned by this partition after a split.
+
+Both backends implement identical semantics on the same columns, so output
+SSTs are byte-stable across cpu/tpu — the determinism requirement that lets
+learner checksums and backup digests agree (SURVEY.md §7 hard part d).
+
+The kernel returns (perm, keep) — the record permutation and survival mask.
+Variable-length key/value bytes never touch the device: the host gathers
+arenas by perm[keep] when writing the output SST.
+"""
+
+import functools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..base.utils import epoch_now
+from ..engine.block import KVBlock
+from .bitonic import bitonic_sort
+from .packing import DEFAULT_PREFIX_U32, compute_suffix_ranks, pack_key_prefixes
+
+_U32_MAX = np.uint32(0xFFFFFFFF)
+
+
+@dataclass
+class CompactOptions:
+    now: int = None                # epoch (2016-based) seconds; default wall clock
+    pidx: int = 0                  # this partition's index
+    partition_mask: int = 0        # partition_version mask; 0 = no split GC
+    bottommost: bool = True        # tombstones may be dropped only at bottom
+    filter: bool = True            # False = flush path (pure sort, no drops)
+    default_ttl: int = 0           # table-level default_ttl app-env (seconds)
+    prefix_u32: int = DEFAULT_PREFIX_U32
+    backend: str = "cpu"           # "cpu" | "tpu"
+
+    def resolved_now(self) -> int:
+        return epoch_now() if self.now is None else self.now
+
+
+@dataclass
+class CompactResult:
+    block: KVBlock
+    stats: dict = field(default_factory=dict)
+
+
+def _next_bucket(n: int) -> int:
+    """Pad to power-of-two buckets >= 1024 to bound jit recompilations."""
+    b = 1024
+    while b < n:
+        b <<= 1
+    return b
+
+
+class CpuBackend:
+    """Vectorized numpy reference — also the honest CPU baseline for bench."""
+
+    name = "cpu"
+
+    def merge(self, cols, rank, klen, prio, expire, deleted, hash32, valid,
+              now, pidx, pmask, bottommost, do_filter):
+        big = _U32_MAX
+        key_cols = [np.where(valid, c, big) for c in cols]
+        key_cols.append(np.where(valid, rank, big))
+        key_cols.append(np.where(valid, klen, big))
+        sort_keys = key_cols + [np.where(valid, prio, big)]
+        # np.lexsort: last key is primary
+        perm = np.lexsort(tuple(reversed(sort_keys))).astype(np.int32)
+        s_key_cols = [c[perm] for c in key_cols]
+        same = np.ones(len(perm), dtype=bool)
+        for c in s_key_cols:
+            same[1:] &= c[1:] == c[:-1]
+        same[0] = False
+        keep = valid[perm] & ~same
+        if do_filter:
+            s_expire = expire[perm]
+            s_deleted = deleted[perm]
+            s_hash = hash32[perm]
+            keep &= ~((s_expire > 0) & (s_expire <= now))
+            if pmask:
+                keep &= (s_hash & np.uint32(pmask)) == np.uint32(pidx)
+            if bottommost:
+                keep &= ~s_deleted
+        return perm, keep
+
+
+class TpuBackend:
+    """JAX implementation; jit-cached per (n_padded, width). Runs on whatever
+    platform JAX is on (TPU in prod, host CPU devices in tests)."""
+
+    name = "tpu"
+
+    def merge(self, cols, rank, klen, prio, expire, deleted, hash32, valid,
+              now, pidx, pmask, bottommost, do_filter):
+        import jax.numpy as jnp
+
+        fn = _jitted_merge(len(cols), len(rank))
+        perm, keep = fn(
+            [jnp.asarray(c) for c in cols],
+            jnp.asarray(rank), jnp.asarray(klen), jnp.asarray(prio),
+            jnp.asarray(expire), jnp.asarray(deleted), jnp.asarray(hash32),
+            jnp.asarray(valid),
+            jnp.uint32(now), jnp.uint32(pidx), jnp.uint32(pmask),
+            jnp.asarray(bottommost), jnp.asarray(do_filter),
+        )
+        return np.asarray(perm), np.asarray(keep)
+
+
+def merge_body(cols, rank, klen, prio, expire, deleted, hash32, valid,
+               now, pidx, pmask, bottommost, do_filter):
+    """The device merge: sort + dedup + filter on jnp arrays of one shard.
+
+    Shared by the single-chip jitted kernel and the shard_map'd multi-chip
+    path (parallel.sharded_compact). Returns (perm, keep) in sorted order.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = rank.shape[0]
+    big = jnp.uint32(0xFFFFFFFF)
+    key_cols = [jnp.where(valid, c, big) for c in cols]
+    key_cols.append(jnp.where(valid, rank, big))
+    key_cols.append(jnp.where(valid, klen, big))
+    sort_ops = key_cols + [jnp.where(valid, prio, big)]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    if n & (n - 1) == 0:
+        # bitonic network: O(log^2 n) HLO regardless of n — lax.sort's TPU
+        # lowering unrolls per element and takes minutes to compile at
+        # engine sizes (see ops.bitonic docstring)
+        sorted_ops, perm = bitonic_sort(sort_ops, iota)
+        s_key_cols = sorted_ops[: len(key_cols)]
+    else:
+        out = lax.sort(tuple(sort_ops) + (iota,), num_keys=len(sort_ops))
+        s_key_cols = out[: len(key_cols)]
+        perm = out[-1]
+    same_tail = functools.reduce(
+        jnp.logical_and, [c[1:] == c[:-1] for c in s_key_cols]
+    )
+    same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
+    keep = valid[perm] & ~same
+    s_expire = expire[perm]
+    s_deleted = deleted[perm]
+    s_hash = hash32[perm]
+    expired = (s_expire > 0) & (s_expire <= now)
+    stale = jnp.where(pmask > 0, (s_hash & pmask) != pidx, False)
+    tomb = s_deleted & bottommost
+    keep_f = keep & ~expired & ~stale & ~tomb
+    keep = jnp.where(do_filter, keep_f, keep)
+    return perm, keep
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_merge(width: int, n: int):
+    import jax
+
+    return jax.jit(merge_body)
+
+
+_BACKENDS = {"cpu": CpuBackend(), "tpu": TpuBackend(), "jax": TpuBackend()}
+
+
+def get_backend(name: str):
+    return _BACKENDS[name]
+
+
+def compact_blocks(blocks, opts: CompactOptions) -> CompactResult:
+    """Merge K runs (newest first) into one sorted, deduped, filtered block.
+
+    blocks[0] is the newest run (e.g. the freshest L0 file), blocks[-1] the
+    oldest — matching LSM level semantics where a version in a newer run
+    shadows the same key in an older one.
+    """
+    runs = [b for b in blocks if b.n]
+    if not runs:
+        return CompactResult(KVBlock.empty(), _stats(0, 0))
+    block = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
+    prio = np.repeat(
+        np.arange(len(runs), dtype=np.uint32),
+        [b.n for b in runs],
+    )
+    n = block.n
+    n_pad = _next_bucket(n)
+    w = opts.prefix_u32
+
+    prefixes = pack_key_prefixes(block.key_arena, block.key_off, block.key_len, w)
+    rank = compute_suffix_ranks(block, w, prefixes)
+
+    def pad(a, fill=0):
+        if n_pad == n:
+            return a
+        out = np.full(n_pad, fill, dtype=a.dtype)
+        out[:n] = a
+        return out
+
+    cols = [pad(np.ascontiguousarray(prefixes[:, j])) for j in range(w)]
+    valid = pad(np.ones(n, dtype=bool), False)
+    now = opts.resolved_now()
+
+    backend = get_backend(opts.backend)
+    perm, keep = backend.merge(
+        cols, pad(rank), pad(block.key_len.astype(np.uint32)), pad(prio),
+        pad(block.expire_ts), pad(block.deleted), pad(block.hash32), valid,
+        now, opts.pidx, opts.partition_mask,
+        bool(opts.bottommost), bool(opts.filter),
+    )
+    out_idx = perm[keep]
+    out = block.gather(out_idx)
+    if opts.filter and opts.default_ttl > 0:
+        _apply_default_ttl(out, now + opts.default_ttl)
+    return CompactResult(out, _stats(n, out.n))
+
+
+def sort_block(block: KVBlock, opts: CompactOptions = None) -> KVBlock:
+    """Flush path: sort one run by key, newest-wins dedup, no filtering
+    (RocksDB flush writes every live memtable record; the reference's TTL
+    filter only runs at compaction)."""
+    opts = opts or CompactOptions()
+    flush_opts = CompactOptions(
+        now=opts.now, prefix_u32=opts.prefix_u32, backend=opts.backend, filter=False
+    )
+    return compact_blocks([block], flush_opts).block
+
+
+def _apply_default_ttl(block: KVBlock, new_expire: int) -> None:
+    """Rewrite expire_ts=0 records to the table default TTL, in place.
+
+    Mirrors KeyWithTTLCompactionFilter's value rewrite when a table-level
+    default_ttl app-env is set (src/server/key_ttl_compaction_filter.h:56-76).
+    expire_ts sits at value offset 0 (v0/v1) or 1 (self-describing v2).
+    """
+    targets = np.nonzero((block.expire_ts == 0) & ~block.deleted)[0]
+    if len(targets) == 0:
+        return
+    off = block.val_off[targets]
+    has_hdr = block.val_len[targets] > 0
+    first = np.where(has_hdr, block.val_arena[np.minimum(off, len(block.val_arena) - 1)], 0)
+    off = off + np.where((first & 0x80) != 0, 1, 0)
+    be = np.array(
+        [(new_expire >> 24) & 0xFF, (new_expire >> 16) & 0xFF,
+         (new_expire >> 8) & 0xFF, new_expire & 0xFF],
+        dtype=np.uint8,
+    )
+    for j in range(4):
+        block.val_arena[off + j] = be[j]
+    block.expire_ts[targets] = np.uint32(new_expire)
+
+
+def _stats(n_in: int, n_out: int) -> dict:
+    return {"input_records": n_in, "output_records": n_out, "dropped": n_in - n_out}

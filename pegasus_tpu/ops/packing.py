@@ -1,0 +1,73 @@
+"""Host-side packing: variable-length keys -> fixed-width device sort keys.
+
+Device sorts need fixed-width keys. A stored key's first `4*W` bytes are
+packed big-endian into W uint32 lanes, so unsigned u32 lexicographic order
+over the lanes == byte order over the prefix (shorter keys zero-pad, and the
+[u16 len] prefix of the key format guarantees a shorter hash_key never
+zero-pad-collides with a longer one's real bytes except when one key is a
+strict prefix of another — exactly the cases `compute_suffix_ranks` breaks).
+
+The full device sort key is (prefix_lanes..., suffix_rank, key_len):
+
+  - suffix_rank breaks ties between *long* keys (> window) sharing a prefix
+    window: collision groups are found on host (rare — needs identical first
+    4*W bytes), full keys compared within the group, and a dense rank
+    assigned. Equal full keys share a rank, which the dedup kernel relies on.
+  - key_len breaks the remaining ties exactly: two short keys with equal
+    padded windows differ only in trailing 0x00 bytes (shorter is
+    byte-smaller), and a short key whose window matches long keys is their
+    strict byte prefix (sorts first; key_len < window bytes < long key_len).
+
+So (window, rank, len) equality <=> full-key equality, and its order is full
+byte order — no host comparisons outside collision groups.
+"""
+
+import numpy as np
+
+DEFAULT_PREFIX_U32 = 8  # 32-byte prefix window
+
+
+def pack_key_prefixes(key_arena, key_off, key_len, width_u32: int = DEFAULT_PREFIX_U32):
+    """-> uint32[n, width_u32], big-endian packed, zero-padded."""
+    n = len(key_off)
+    w_bytes = width_u32 * 4
+    if n == 0:
+        return np.zeros((0, width_u32), np.uint32)
+    pos = np.arange(w_bytes, dtype=np.int64)
+    idx = key_off[:, None] + pos[None, :]
+    valid = pos[None, :] < key_len[:, None]
+    b = np.where(valid, key_arena[np.minimum(idx, len(key_arena) - 1)], 0).astype(np.uint32)
+    b = b.reshape(n, width_u32, 4)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def compute_suffix_ranks(block, width_u32: int = DEFAULT_PREFIX_U32, prefixes=None):
+    """-> uint32[n]: dense order rank among records sharing a prefix window.
+
+    0 for records with a unique prefix (the common case: the loop below only
+    touches collision groups). Equal full keys map to the same rank.
+    """
+    n = block.n
+    ranks = np.zeros(n, np.uint32)
+    over = np.nonzero(block.key_len > width_u32 * 4)[0]
+    if len(over) == 0:
+        return ranks
+    if prefixes is None:
+        prefixes = pack_key_prefixes(block.key_arena, block.key_off, block.key_len, width_u32)
+    # only long keys need ranks: short-key ties are resolved by the key_len
+    # sort column (see module docstring)
+    groups = {}
+    for i in over:
+        groups.setdefault(prefixes[i].tobytes(), []).append(int(i))
+    for g in groups.values():
+        if len(g) < 2:
+            continue
+        keyed = sorted((block.key(i), i) for i in g)
+        rank = 0
+        prev = None
+        for k, i in keyed:
+            if prev is not None and k != prev:
+                rank += 1
+            ranks[i] = rank
+            prev = k
+    return ranks

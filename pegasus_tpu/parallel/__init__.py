@@ -1,0 +1,4 @@
+from .mesh import make_mesh
+from .sharded_compact import sharded_compact
+
+__all__ = ["make_mesh", "sharded_compact"]

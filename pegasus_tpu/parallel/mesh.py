@@ -1,0 +1,20 @@
+"""Device mesh helpers.
+
+The only parallel axis a KV store's compaction needs is the hash-shard axis
+('shard'): partitions are already data-parallel by construction (disjoint
+hash ranges per replica, reference src/base/pegasus_key_schema.h:178), so
+within one partition's compaction we shard records by key-hash across chips
+and exchange with a single all_to_all over ICI (SURVEY.md §5.7c/§5.8).
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int = None, axis: str = "shard") -> Mesh:
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))

@@ -1,0 +1,4 @@
+from . import messages
+from .messages import Status, FilterType, CasCheckType, MutateOperation
+
+__all__ = ["messages", "Status", "FilterType", "CasCheckType", "MutateOperation"]

@@ -7,7 +7,15 @@ multi-node behavior on one machine (onebox, run.sh:480).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the image pre-sets JAX_PLATFORMS=axon (the real TPU tunnel); tests always
+# run on the virtual CPU mesh unless explicitly opted onto hardware
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("PEGASUS_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # something in the image re-asserts the axon platform over the env var;
+    # the config API wins over both
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,194 @@
+"""LSM engine tests: write/read/scan/flush/compact/checkpoint/reopen.
+
+Modeled on the reference's fake-replica unit-test strategy (SURVEY.md §4.1):
+the real engine runs in-process against a temp dir, no replication/network.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key, generate_next_bytes, key_hash
+from pegasus_tpu.base.value_schema import SCHEMAS
+from pegasus_tpu.engine import EngineOptions, LsmEngine, WriteBatch
+from pegasus_tpu.runtime import fail_points as fp
+
+
+def enc(payload: bytes, expire: int = 0) -> bytes:
+    return SCHEMAS[2].generate_value(expire, 0, payload)
+
+
+@pytest.fixture
+def db(tmp_path):
+    eng = LsmEngine(str(tmp_path / "db"), EngineOptions(backend="cpu"))
+    yield eng
+    eng.close()
+
+
+def test_put_get_delete(db):
+    k = generate_key(b"h", b"s")
+    db.put(k, enc(b"v1"))
+    assert db.get(k, now=10) == enc(b"v1")
+    db.put(k, enc(b"v2"))
+    assert db.get(k, now=10) == enc(b"v2")
+    db.delete(k)
+    assert db.get(k, now=10) is None
+    assert db.get(generate_key(b"h", b"missing"), now=10) is None
+
+
+def test_get_respects_ttl(db):
+    k = generate_key(b"h", b"s")
+    db.put(k, enc(b"v", expire=100), expire_ts=100)
+    assert db.get(k, now=99) == enc(b"v", expire=100)
+    assert db.get(k, now=100) is None  # expire_ts <= now
+
+
+def test_read_through_flush_and_compact(db):
+    keys = {}
+    for i in range(200):
+        k = generate_key(f"hk{i % 10}".encode(), f"sk{i:04d}".encode())
+        keys[k] = enc(b"val%d" % i)
+        db.put(k, keys[k])
+    db.flush()
+    assert db.stats()["l0_files"] == 1
+    assert db.stats()["memtable_records"] == 0
+    # overwrite some post-flush, delete others
+    victims = sorted(keys)[:20]
+    for k in victims[:10]:
+        db.put(k, enc(b"NEW"))
+    for k in victims[10:]:
+        db.delete(k)
+    db.flush()
+    stats = db.manual_compact(now=1)
+    assert db.stats()["l0_files"] == 0
+    assert db.stats()["level_files"] == {1: 1}
+    for k, v in keys.items():
+        if k in victims[:10]:
+            assert db.get(k, now=1) == enc(b"NEW")
+        elif k in victims[10:]:
+            assert db.get(k, now=1) is None
+        else:
+            assert db.get(k, now=1) == v
+    assert stats["dropped"] > 0  # shadowed versions + tombstones went away
+
+
+def test_scan_range_and_order(db):
+    for hk in (b"a", b"b", b"c"):
+        for i in range(10):
+            db.put(generate_key(hk, b"sk%02d" % i), enc(b"v"))
+    db.flush()
+    for i in range(5):  # some still in memtable
+        db.put(generate_key(b"b", b"zk%02d" % i), enc(b"m"))
+    start = generate_key(b"b", b"")
+    stop = generate_next_bytes(b"b")
+    got = list(db.scan(start, stop, now=1))
+    assert len(got) == 15
+    ks = [k for k, _, _ in got]
+    assert ks == sorted(ks)
+    for k, _, _ in got:
+        assert start <= k < stop
+
+
+def test_scan_newest_version_wins_across_sources(db):
+    k = generate_key(b"h", b"s")
+    db.put(k, enc(b"old"))
+    db.flush()
+    db.put(k, enc(b"new"))  # newer, still in memtable
+    got = dict((kk, v) for kk, v, _ in db.scan(now=1))
+    assert got[k] == enc(b"new")
+    db.delete(k)
+    assert list(db.scan(now=1)) == []
+
+
+def test_l0_trigger_auto_compacts(tmp_path):
+    eng = LsmEngine(str(tmp_path / "db"),
+                    EngineOptions(backend="cpu", l0_compaction_trigger=2))
+    for r in range(3):
+        for i in range(10):
+            eng.put(generate_key(b"h%d" % r, b"s%d" % i), enc(b"v"))
+        eng.flush()
+    st = eng.stats()
+    assert st["l0_files"] < 2
+    assert st["level_files"].get(1) == 1
+    assert eng.get(generate_key(b"h0", b"s0"), now=1) == enc(b"v")
+
+
+def test_reopen_recovers_durable_state(tmp_path):
+    path = str(tmp_path / "db")
+    eng = LsmEngine(path, EngineOptions(backend="cpu"))
+    k1, k2 = generate_key(b"h", b"flushed"), generate_key(b"h", b"lost")
+    eng.put(k1, enc(b"v1"), decree=5)
+    eng.flush()
+    eng.put(k2, enc(b"v2"), decree=6)  # not flushed: replication log replays it
+    assert eng.last_durable_decree() == 5
+    eng.close()
+    eng2 = LsmEngine(path, EngineOptions(backend="cpu"))
+    assert eng2.get(k1, now=1) == enc(b"v1")
+    assert eng2.get(k2, now=1) is None  # engine has no WAL by design
+    assert eng2.last_durable_decree() == 5
+    assert eng2.data_version() == 2
+
+
+def test_checkpoint_is_consistent_snapshot(tmp_path):
+    eng = LsmEngine(str(tmp_path / "db"), EngineOptions(backend="cpu"))
+    for i in range(50):
+        eng.put(generate_key(b"h", b"s%03d" % i), enc(b"v%d" % i), decree=i + 1)
+    ckpt = str(tmp_path / "checkpoint.50")
+    decree = eng.checkpoint(ckpt)
+    assert decree == 50
+    # mutate after checkpoint
+    eng.put(generate_key(b"h", b"s000"), enc(b"MUTATED"), decree=51)
+    eng.flush()
+    # open the checkpoint as a fresh engine: pre-mutation state
+    snap = LsmEngine(ckpt, EngineOptions(backend="cpu"))
+    assert snap.get(generate_key(b"h", b"s000"), now=1) == enc(b"v0")
+    assert snap.last_durable_decree() == 50
+    assert len(list(snap.scan(now=1))) == 50
+
+
+def test_split_stale_key_gc_on_compact(tmp_path):
+    # partition 1 of 4 keeps only keys hashing to pidx 1 after split
+    eng = LsmEngine(str(tmp_path / "db"),
+                    EngineOptions(backend="cpu", pidx=1, partition_mask=3))
+    n = 64
+    for i in range(n):
+        eng.put(generate_key(b"k%02d" % i, b""), enc(b"v"))
+    eng.manual_compact(now=1)
+    kept = list(eng.scan(now=1))
+    assert 0 < len(kept) < n
+    for k, _, _ in kept:
+        assert key_hash(k) & 3 == 1
+
+
+def test_write_batch_atomic_and_failpoints(db):
+    fp.setup()
+    try:
+        fp.cfg("db_write_batch_put", "return()")
+        with pytest.raises(IOError):
+            db.write(WriteBatch().put(generate_key(b"h", b"x"), enc(b"v"), 0), 1)
+    finally:
+        fp.teardown()
+    batch = WriteBatch().put(generate_key(b"h", b"a"), enc(b"1"), 0)
+    batch.put(generate_key(b"h", b"b"), enc(b"2"), 0)
+    batch.delete(generate_key(b"h", b"a"))
+    db.write(batch, 2)
+    assert db.get(generate_key(b"h", b"a"), now=1) is None
+    assert db.get(generate_key(b"h", b"b"), now=1) == enc(b"2")
+
+
+def test_tpu_backend_engine_end_to_end(tmp_path):
+    """Whole engine on the jax backend; contents equal to cpu-backend run."""
+    outs = {}
+    for backend in ("cpu", "tpu"):
+        eng = LsmEngine(str(tmp_path / backend), EngineOptions(backend=backend))
+        rng = np.random.default_rng(3)
+        for i in range(300):
+            hk = b"u%d" % (i % 37)
+            sk = rng.bytes(int(rng.integers(0, 12)))
+            expire = int(rng.integers(0, 3)) * 80
+            eng.put(generate_key(hk, sk), enc(b"p%d" % i, expire), expire_ts=expire)
+        eng.manual_compact(now=100)
+        outs[backend] = list(eng.scan(now=100))
+    assert outs["cpu"] == outs["tpu"]
+    assert len(outs["cpu"]) > 0
