@@ -47,3 +47,8 @@ ENV_SLOW_QUERY_THRESHOLD = "replica.slow_query_threshold"
 ITERATION_THRESHOLD_TIME_MS = "replica.rocksdb_iteration_threshold_time_ms"
 SPLIT_VALIDATE_PARTITION_HASH = "replica.split.validate_partition_hash"
 USER_SPECIFIED_COMPACTION = "user_specified_compaction"
+
+# range-read limiter thresholds (src/server/range_read_limiter.h flags)
+ROCKSDB_ITERATION_THRESHOLD_COUNT = "replica.rocksdb_max_iteration_count"
+ROCKSDB_ITERATION_THRESHOLD_SIZE = "replica.rocksdb_max_iteration_size"
+ROCKSDB_ITERATION_THRESHOLD_TIME_MS = ITERATION_THRESHOLD_TIME_MS

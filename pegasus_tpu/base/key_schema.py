@@ -39,6 +39,14 @@ def generate_next_bytes(hash_key: bytes, sort_key: bytes = None) -> bytes:
     return bytes(buf[: p + 1])
 
 
+def expire_ts_from_ttl(ttl_seconds: int) -> int:
+    """TTL seconds -> absolute expire timestamp (2016-based epoch); 0 = none
+    (reference: pegasus_value_schema.h expire encoding on the client path)."""
+    from .utils import epoch_now
+
+    return epoch_now() + int(ttl_seconds) if ttl_seconds > 0 else 0
+
+
 def restore_key(key: bytes) -> tuple:
     """(hash_key, sort_key) from a stored key (src/base/pegasus_key_schema.h:101-122)."""
     if len(key) < 2:

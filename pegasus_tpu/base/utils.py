@@ -1,6 +1,20 @@
 """Base utilities (src/base/pegasus_utils.{h,cpp})."""
 
+import os
 import time
+
+
+def enable_compile_cache(repo_root: str = None) -> None:
+    """Point jax's persistent compilation cache at <repo>/.jax_cache — the
+    sort/merge networks compile per shape-set and this makes every process
+    (tests, bench, driver hooks, servers) reuse them."""
+    import jax
+
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    jax.config.update("jax_compilation_cache_dir", os.path.join(root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 # TTL timestamps are seconds since 2016-01-01 00:00:00 GMT
 # (src/base/pegasus_utils.h:34-36)

@@ -1,0 +1,6 @@
+from .client import PegasusClient, PegasusError, Scanner, StaticResolver
+
+__all__ = ["PegasusClient", "PegasusError", "Scanner", "StaticResolver"]
+from .meta_resolver import MetaResolver  # noqa: E402
+
+__all__.append("MetaResolver")

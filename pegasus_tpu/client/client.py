@@ -1,0 +1,318 @@
+"""Pegasus client: hashkey/sortkey API with partition-hash routing.
+
+The pegasus_client surface (src/include/pegasus/client.h:40-380) over this
+build's RPC transport: every call encodes (hash_key, sort_key) into a stored
+key (base.key_schema), computes partition_hash = pegasus_key_hash(key)
+(reference: src/client_lib/pegasus_client_impl.cpp:106), resolves
+pidx = hash % partition_count, and calls the partition's serving node.
+
+Partition resolution is pluggable: a StaticResolver pins a fixed
+pidx -> address map (onebox tests); the meta-server resolver queries and
+caches the routing table and retries once on reconfiguration
+(the partition_resolver role, src/include/rrdb/rrdb.client.h:41-52).
+"""
+
+import time
+
+from ..base import key_schema
+from ..rpc import codec
+from ..rpc import messages as msg
+from ..rpc.messages import Status
+from ..rpc.transport import (ConnectionPool, ERR_INVALID_STATE,
+                             ERR_NETWORK_FAILURE, ERR_OBJECT_NOT_FOUND,
+                             ERR_TIMEOUT, RpcError)
+from ..engine import replica_service as codes
+from ..engine.server_impl import (RPC_CHECK_AND_MUTATE, RPC_CHECK_AND_SET,
+                                  RPC_INCR, RPC_MULTI_PUT, RPC_MULTI_REMOVE,
+                                  RPC_PUT, RPC_REMOVE)
+
+
+class PegasusError(Exception):
+    def __init__(self, status, text=""):
+        super().__init__(f"pegasus error {status}: {text}")
+        self.status = status
+
+
+class StaticResolver:
+    """Fixed pidx -> (host, port) map (single-node / onebox)."""
+
+    def __init__(self, app_id: int, addresses):
+        self.app_id = app_id
+        self._addresses = list(addresses)
+
+    @property
+    def partition_count(self) -> int:
+        return len(self._addresses)
+
+    def resolve(self, pidx: int, refresh: bool = False):
+        return self._addresses[pidx]
+
+
+class PegasusClient:
+    """Synchronous client for one table (app)."""
+
+    def __init__(self, resolver, pool: ConnectionPool = None,
+                 timeout: float = 10.0):
+        self.resolver = resolver
+        self.pool = pool or ConnectionPool()
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ internals
+
+    def _route(self, key: bytes):
+        h = key_schema.key_hash(key)
+        pidx = h % self.resolver.partition_count
+        return pidx, h
+
+    def _call(self, code: str, pidx: int, phash: int, req_obj, resp_cls):
+        body = codec.encode(req_obj)
+        last = None
+        for attempt in range(2):
+            addr = self.resolver.resolve(pidx, refresh=attempt > 0)
+            try:
+                conn = self.pool.get(addr)
+                _, rbody = conn.call(code, body, app_id=self.resolver.app_id,
+                                     partition_index=pidx, partition_hash=phash,
+                                     timeout=self.timeout)
+                return codec.decode(resp_cls, rbody) if resp_cls else None
+            except RpcError as e:
+                last = e
+                if e.err in (ERR_NETWORK_FAILURE, ERR_TIMEOUT,
+                             ERR_OBJECT_NOT_FOUND, ERR_INVALID_STATE):
+                    self.pool.invalidate(addr)
+                    continue  # re-resolve (reconfiguration / failover)
+                raise PegasusError(Status.IO_ERROR, str(e))
+        raise PegasusError(Status.TRY_AGAIN, str(last))
+
+    def _key_call(self, code, hash_key, sort_key, resp_cls):
+        key = key_schema.generate_key(hash_key, sort_key)
+        pidx, h = self._route(key)
+        return self._call(code, pidx, h, msg.KeyRequest(key), resp_cls)
+
+    def _hash_call(self, code, hash_key, req_obj, resp_cls):
+        key = key_schema.generate_key(hash_key, b"")
+        pidx, h = self._route(key)
+        return self._call(code, pidx, h, req_obj, resp_cls)
+
+    @staticmethod
+    def _ok(resp, *accept):
+        if resp.error not in (Status.OK, *accept):
+            raise PegasusError(resp.error)
+        return resp
+
+    # ------------------------------------------------------------- data ops
+
+    def set(self, hash_key: bytes, sort_key: bytes, value: bytes,
+            ttl_seconds: int = 0) -> None:
+        key = key_schema.generate_key(hash_key, sort_key)
+        pidx, h = self._route(key)
+        expire = key_schema.expire_ts_from_ttl(ttl_seconds)
+        resp = self._call(RPC_PUT, pidx, h,
+                          msg.UpdateRequest(key, value, expire),
+                          msg.UpdateResponse)
+        self._ok(resp)
+
+    def get(self, hash_key: bytes, sort_key: bytes):
+        """-> value bytes or None when absent."""
+        resp = self._key_call(codes.RPC_GET, hash_key, sort_key, msg.ReadResponse)
+        if resp.error == Status.NOT_FOUND:
+            return None
+        self._ok(resp)
+        return resp.value
+
+    def exist(self, hash_key: bytes, sort_key: bytes) -> bool:
+        return self.get(hash_key, sort_key) is not None
+
+    def delete(self, hash_key: bytes, sort_key: bytes) -> None:
+        resp = self._key_call(RPC_REMOVE, hash_key, sort_key, msg.UpdateResponse)
+        self._ok(resp)
+
+    # del is reserved; keep the reference's name too
+    def del_(self, hash_key: bytes, sort_key: bytes) -> None:
+        self.delete(hash_key, sort_key)
+
+    def ttl(self, hash_key: bytes, sort_key: bytes):
+        """-> remaining seconds, -1 if no ttl, None if absent."""
+        resp = self._key_call(codes.RPC_TTL, hash_key, sort_key, msg.TTLResponse)
+        if resp.error == Status.NOT_FOUND:
+            return None
+        self._ok(resp)
+        return resp.ttl_seconds
+
+    def incr(self, hash_key: bytes, sort_key: bytes, increment: int,
+             ttl_seconds: int = 0) -> int:
+        key = key_schema.generate_key(hash_key, sort_key)
+        pidx, h = self._route(key)
+        expire = (key_schema.expire_ts_from_ttl(ttl_seconds)
+                  if ttl_seconds > 0 else ttl_seconds)
+        resp = self._call(RPC_INCR, pidx, h,
+                          msg.IncrRequest(key, increment, expire),
+                          msg.IncrResponse)
+        self._ok(resp)
+        return resp.new_value
+
+    def multi_set(self, hash_key: bytes, kvs: dict, ttl_seconds: int = 0) -> None:
+        req = msg.MultiPutRequest(
+            hash_key,
+            [msg.KeyValue(sk, v) for sk, v in kvs.items()],
+            key_schema.expire_ts_from_ttl(ttl_seconds),
+        )
+        resp = self._hash_call(RPC_MULTI_PUT, hash_key, req, msg.UpdateResponse)
+        self._ok(resp)
+
+    def multi_get(self, hash_key: bytes, sort_keys=None, max_kv_count: int = 0,
+                  max_kv_size: int = 0, **range_opts):
+        """-> (complete, {sort_key: value}). With sort_keys=None fetches the
+        (optionally bounded) range under hash_key."""
+        req = msg.MultiGetRequest(hash_key, list(sort_keys or []),
+                                  max_kv_count, max_kv_size, **range_opts)
+        resp = self._hash_call(codes.RPC_MULTI_GET, hash_key, req,
+                               msg.MultiGetResponse)
+        self._ok(resp, Status.INCOMPLETE)
+        return resp.error == Status.OK, {kv.key: kv.value for kv in resp.kvs}
+
+    def multi_del(self, hash_key: bytes, sort_keys) -> int:
+        req = msg.MultiRemoveRequest(hash_key, list(sort_keys))
+        resp = self._hash_call(RPC_MULTI_REMOVE, hash_key, req,
+                               msg.MultiRemoveResponse)
+        self._ok(resp)
+        return resp.count
+
+    def sortkey_count(self, hash_key: bytes) -> int:
+        key = key_schema.generate_key(hash_key, b"")
+        pidx, h = self._route(key)
+        resp = self._call(codes.RPC_SORTKEY_COUNT, pidx, h,
+                          msg.KeyRequest(hash_key), msg.CountResponse)
+        self._ok(resp, Status.INCOMPLETE)
+        return resp.count
+
+    def check_and_set(self, hash_key: bytes, check_sort_key: bytes,
+                      check_type: int, check_operand: bytes,
+                      set_sort_key: bytes, set_value: bytes,
+                      set_ttl_seconds: int = 0, return_check_value: bool = False):
+        req = msg.CheckAndSetRequest(
+            hash_key, check_sort_key, check_type, check_operand,
+            set_diff_sort_key=set_sort_key != check_sort_key,
+            set_sort_key=set_sort_key, set_value=set_value,
+            set_expire_ts_seconds=key_schema.expire_ts_from_ttl(set_ttl_seconds),
+            return_check_value=return_check_value)
+        resp = self._hash_call(RPC_CHECK_AND_SET, hash_key, req,
+                               msg.CheckAndSetResponse)
+        if resp.error not in (Status.OK, Status.TRY_AGAIN):
+            raise PegasusError(resp.error)
+        return resp
+
+    def check_and_mutate(self, hash_key: bytes, check_sort_key: bytes,
+                         check_type: int, check_operand: bytes,
+                         mutations, return_check_value: bool = False):
+        """mutations: list of ("set", sort_key, value, ttl) | ("del", sort_key)."""
+        ml = []
+        for m in mutations:
+            if m[0] == "set":
+                _, sk, v, ttl = m
+                ml.append(msg.Mutate(msg.MutateOperation.PUT, sk, v,
+                                     key_schema.expire_ts_from_ttl(ttl)))
+            else:
+                ml.append(msg.Mutate(msg.MutateOperation.DELETE, m[1]))
+        req = msg.CheckAndMutateRequest(hash_key, check_sort_key, check_type,
+                                        check_operand, ml, return_check_value)
+        resp = self._hash_call(RPC_CHECK_AND_MUTATE, hash_key, req,
+                               msg.CheckAndMutateResponse)
+        if resp.error not in (Status.OK, Status.TRY_AGAIN):
+            raise PegasusError(resp.error)
+        return resp
+
+    # --------------------------------------------------------------- scans
+
+    def get_scanner(self, hash_key: bytes = b"", start_sort_key: bytes = b"",
+                    stop_sort_key: bytes = b"", batch_size: int = 1000,
+                    **opts):
+        """Scanner over one hash_key's range (hash scanner). For a full-table
+        scan use get_unordered_scanners."""
+        if hash_key:
+            start = key_schema.generate_key(hash_key, start_sort_key)
+            stop = (key_schema.generate_key(hash_key, stop_sort_key)
+                    if stop_sort_key else key_schema.generate_next_bytes(hash_key))
+            pidx, h = self._route(start)
+            return Scanner(self, [pidx], start, stop, batch_size, phash=h, **opts)
+        return Scanner(self, list(range(self.resolver.partition_count)),
+                       b"", b"", batch_size, **opts)
+
+    def get_unordered_scanners(self, max_split_count: int = 0):
+        """One scanner per partition group (full-table scan,
+        reference client.h:322-380)."""
+        n = self.resolver.partition_count
+        return [Scanner(self, [p], b"", b"", 1000) for p in range(n)]
+
+    def close(self):
+        self.pool.close()
+
+
+class Scanner:
+    """Iterates (hash_key, sort_key, value) across partitions sequentially
+    (reference pegasus_scanner_impl walks partitions in order)."""
+
+    def __init__(self, client: PegasusClient, pidxs, start_key, stop_key,
+                 batch_size, phash: int = 0, **opts):
+        self.client = client
+        self.pidxs = list(pidxs)
+        self.start_key = start_key
+        self.stop_key = stop_key
+        self.batch_size = batch_size
+        self.phash = phash
+        self.opts = opts
+        self._cur = 0
+        self._ctx = None
+        self._batch = []
+        self._bi = 0
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._bi < len(self._batch):
+                kv = self._batch[self._bi]
+                self._bi += 1
+                hk, sk = key_schema.restore_key(kv.key)
+                return hk, sk, kv.value
+            if self._done:
+                raise StopIteration
+            self._fetch()
+
+    def _fetch(self):
+        from ..base import consts
+
+        if self._cur >= len(self.pidxs):
+            self._done = True
+            return
+        pidx = self.pidxs[self._cur]
+        if self._ctx is None:
+            req = msg.GetScannerRequest(
+                start_key=self.start_key, stop_key=self.stop_key,
+                batch_size=self.batch_size,
+                validate_partition_hash=False, **self.opts)
+            resp = self.client._call(codes.RPC_GET_SCANNER, pidx, self.phash,
+                                     req, msg.ScanResponse)
+        else:
+            resp = self.client._call(codes.RPC_SCAN, pidx, self.phash,
+                                     msg.ScanRequest(self._ctx), msg.ScanResponse)
+        if resp.error not in (Status.OK,):
+            raise PegasusError(resp.error)
+        self._batch = resp.kvs
+        self._bi = 0
+        if resp.context_id == consts.SCAN_CONTEXT_ID_COMPLETED or not resp.kvs:
+            self._ctx = None
+            self._cur += 1
+        else:
+            self._ctx = resp.context_id
+
+    def close(self):
+        if self._ctx is not None and self._cur < len(self.pidxs):
+            try:
+                self.client._call(codes.RPC_CLEAR_SCANNER, self.pidxs[self._cur],
+                                  self.phash, msg.ScanRequest(self._ctx), None)
+            except (PegasusError, RpcError):
+                pass
+            self._ctx = None

@@ -1,0 +1,65 @@
+"""Meta-backed partition resolver: query, cache, refresh on reconfiguration.
+
+The partition_resolver role (src/include/rrdb/rrdb.client.h:41-52): the
+client asks the meta server for the app's partition table once, caches it,
+and re-queries when a call fails with a routing error — which is how the
+client survives primary failover transparently.
+"""
+
+import threading
+
+from ..meta import messages as mm
+from ..meta.meta_server import RPC_CM_QUERY_CONFIG
+from ..rpc import codec
+from ..rpc.transport import ConnectionPool, RpcError
+
+
+class MetaResolver:
+    def __init__(self, meta_addrs, app_name: str, pool: ConnectionPool = None):
+        self.meta_addrs = list(meta_addrs)
+        self.app_name = app_name
+        self.pool = pool or ConnectionPool()
+        self._lock = threading.Lock()
+        self._app = None
+        self._partitions = None
+        self._refresh()
+
+    @property
+    def app_id(self) -> int:
+        with self._lock:
+            return self._app.app_id
+
+    @property
+    def partition_count(self) -> int:
+        with self._lock:
+            return self._app.partition_count
+
+    def resolve(self, pidx: int, refresh: bool = False):
+        if refresh:
+            self._refresh()
+        with self._lock:
+            primary = self._partitions[pidx].primary
+        if not primary:
+            raise RpcError(4, f"partition {pidx} unassigned")
+        host, _, port = primary.rpartition(":")
+        return (host, int(port))
+
+    def _refresh(self):
+        last = None
+        for meta in self.meta_addrs:
+            host, _, port = meta.rpartition(":")
+            try:
+                conn = self.pool.get((host, int(port)))
+                _, body = conn.call(RPC_CM_QUERY_CONFIG,
+                                    codec.encode(mm.QueryConfigRequest(self.app_name)),
+                                    timeout=5.0)
+                resp = codec.decode(mm.QueryConfigResponse, body)
+                if resp.error:
+                    raise RpcError(resp.error, resp.error_text)
+                with self._lock:
+                    self._app = resp.app
+                    self._partitions = resp.partitions
+                return
+            except (RpcError, OSError) as e:
+                last = e
+        raise RpcError(7, f"no meta server reachable: {last}")

@@ -38,6 +38,17 @@ def _as_arena(chunks) -> tuple:
 
 def _gather_arena(arena, offsets, lengths, idx):
     """Vectorized gather of variable-length slices: new compact arena for idx."""
+    n = len(lengths)
+    if n and len(idx):
+        # uniform-length fast path (common: fixed-size records): 2D reshape
+        # gather is a straight memcpy per row instead of repeat/cumsum work
+        l0 = int(lengths[0])
+        if l0 > 0 and int(lengths.min()) == l0 == int(lengths.max()) \
+                and len(arena) == n * l0 \
+                and offsets[0] == 0 and int(offsets[-1]) == (n - 1) * l0:
+            out = arena.reshape(n, l0)[idx].reshape(-1)
+            new_off = np.arange(len(idx), dtype=np.int64) * l0
+            return out, new_off, np.full(len(idx), l0, np.int32)
     sel_off = offsets[idx]
     sel_len = lengths[idx].astype(np.int64)
     total = int(sel_len.sum())

@@ -4,23 +4,36 @@ flush/compaction.
 Replaces the reference's RocksDB-behind-rocksdb_wrapper
 (src/server/rocksdb_wrapper.{h,cpp}) with a from-scratch LSM designed around
 KVBlocks: writes land in a dict memtable, flush sorts the block on the
-configured backend, compaction feeds whole levels to ops.compact_blocks.
+configured backend, compaction feeds sorted runs to ops.compact_blocks.
 There is deliberately NO internal WAL: exactly like the reference (which
 disables RocksDB's WAL), the replication mutation log is the WAL and replays
-into the engine on recovery (SURVEY.md §3.2 note).
+into the engine on recovery (SURVEY.md §3.2 note; replication.mutation_log).
+
+Structure:
+  - L0: overlapping whole-keyspace runs, newest first (flush outputs).
+  - L1..max_levels: runs of non-overlapping range-partitioned files sorted
+    by min_key; compaction output is split at target_file_size_bytes so a
+    later ranged compaction touches a bounded byte budget, not the whole DB.
+  - L0 threshold merges L0 + overlapping L1 files into L1; size-ratio
+    overflow cascades one file (+ overlap) per step into the next level.
 
 Durability/decree bookkeeping mirrors the reference invariants (SURVEY.md §7b):
   - every committed batch records its decree in the in-memory meta store
     (reference: LAST_FLUSHED_DECREE put into the meta CF within each
     WriteBatch, src/server/rocksdb_wrapper.cpp:143);
-  - flush persists that decree into the manifest; `last_durable_decree` is
-    what the manifest holds — the replica learns/replays from there.
+  - the manifest's last_flushed_decree only advances to decrees whose data
+    is FULLY covered by on-disk SSTs: each memtable records the last decree
+    it contains at rotation, and flushing (oldest-first) advances durability
+    to that memtable's decree — never to decrees still sitting in younger
+    memtables (the reference reads the meta CF with kPersistedTier for the
+    same reason, src/server/meta_store.cpp:129).
 """
 
 import bisect
 import heapq
 import json
 import os
+import shutil
 import threading
 import time
 from dataclasses import dataclass, field
@@ -37,6 +50,7 @@ from .memtable import Memtable
 from .sstable import SSTable, write_sst
 
 MANIFEST = "MANIFEST"
+CHECKPOINT_PREFIX = "checkpoint."
 
 # meta-store keys (reference: src/server/meta_store.cpp:29)
 META_DATA_VERSION = "pegasus_data_version"
@@ -54,7 +68,13 @@ class EngineOptions:
     pidx: int = 0
     partition_mask: int = 0         # >0 enables split stale-key GC in compaction
     default_ttl: int = 0            # table-level default_ttl app-env
-    max_levels: int = 2             # L0 + one sorted level this round
+    max_levels: int = 3             # L0 + sorted levels 1..max_levels
+    target_file_size_bytes: int = 64 << 20   # split compaction output files
+    level_base_bytes: int = 256 << 20        # L1 budget; Ln = base * ratio^(n-1)
+    level_size_ratio: int = 10
+    checkpoint_reserve_min_count: int = 2
+    checkpoint_reserve_time_seconds: int = 0  # 0 = no time-based retention
+    user_ops: tuple = ()            # parsed user-specified compaction rules
 
 
 @dataclass
@@ -72,6 +92,35 @@ class WriteBatch:
         return self
 
 
+class _RevBytes:
+    """bytes wrapper with inverted ordering, for descending heap merges."""
+
+    __slots__ = ("k",)
+
+    def __init__(self, k: bytes):
+        self.k = k
+
+    def __lt__(self, other):
+        return self.k > other.k
+
+    def __eq__(self, other):
+        return self.k == other.k
+
+
+def _fail(name: str):
+    """FAIL_POINT_INJECT_F call-site helper: only the 'return' verb injects
+    a failure; 'print' logs and continues (ADVICE r1: a print-armed point
+    must not raise)."""
+    fp = fail_point(name)
+    if fp is None:
+        return False
+    verb, arg = fp
+    if verb == "print":
+        print(f"[fail_point] {name}: print({arg})")
+        return False
+    return True
+
+
 class LsmEngine:
     def __init__(self, path: str, options: EngineOptions = None):
         self.path = path
@@ -81,9 +130,11 @@ class LsmEngine:
         self._imm = []          # immutable memtables pending flush, newest first
         self._l0 = []           # list[SSTable], newest first
         self._levels = {}       # level(int>=1) -> list[SSTable] sorted by min_key
-        self._meta = {}         # the meta-CF equivalent
+        self._meta = {}         # the meta-CF equivalent (live, unflushed view)
         self._next_file = 1
         self._last_committed_decree = 0
+        self._durable_decree = 0
+        self._compact_round = {}  # level -> round-robin cursor for cascades
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -109,23 +160,24 @@ class LsmEngine:
         """Apply one committed batch; analogue of rocksdb_wrapper::write
         (src/server/rocksdb_wrapper.cpp:143): data ops + decree meta update,
         atomically under the engine lock."""
-        if fail_point("db_write"):
+        if _fail("db_write"):
             raise IOError("injected db_write failure")
         with self._lock:
             for op in batch.ops:
                 kind, key, value, expire = op
                 if kind == "put":
-                    if fail_point("db_write_batch_put"):
+                    if _fail("db_write_batch_put"):
                         raise IOError("injected db_write_batch_put failure")
                     self._mem.put(key, value, expire)
                 elif kind == "del":
-                    if fail_point("db_write_batch_delete"):
+                    if _fail("db_write_batch_delete"):
                         raise IOError("injected db_write_batch_delete failure")
                     self._mem.delete(key)
                 else:
                     raise ValueError(f"unknown op {kind}")
             self._last_committed_decree = decree
             self._meta[META_LAST_FLUSHED_DECREE] = decree
+            self._mem.last_decree = decree
             if self._mem.approximate_bytes >= self.opts.memtable_bytes:
                 self._rotate_memtable_locked()
 
@@ -145,10 +197,13 @@ class LsmEngine:
         Search order = recency: memtable, immutables, L0 newest-first, then
         sorted levels (analogue of the read path in
         src/server/pegasus_server_impl.cpp:265-341 over our structure).
+        Point reads prune files by key range and hashkey bloom filter
+        (reference: hashkey_transform.h prefix bloom) before loading data.
         """
-        if fail_point("db_get"):
+        if _fail("db_get"):
             raise IOError("injected db_get failure")
         now = epoch_now() if now is None else now
+        h32 = np.uint32(key_hash(key) & 0xFFFFFFFF)
         with self._lock:
             hit = self._mem.get(key)
             if hit is None:
@@ -164,13 +219,15 @@ class LsmEngine:
                 return None
             return value
         for sst in sources:
+            if not sst.maybe_contains_hash(h32):
+                continue
             i = sst.find(key)
             if i >= 0:
                 return self._record_or_none(sst.block(), i, now)
         for lv in sorted(levels):
             files = levels[lv]
             j = bisect.bisect_right([f.min_key for f in files], key) - 1
-            if j >= 0:
+            if j >= 0 and files[j].maybe_contains_hash(h32):
                 i = files[j].find(key)
                 if i >= 0:
                     return self._record_or_none(files[j].block(), i, now)
@@ -183,9 +240,12 @@ class LsmEngine:
         return block.value(i)
 
     def scan(self, start_key: bytes = b"", stop_key: bytes = None, now: int = None,
-             include_deleted: bool = False):
+             include_deleted: bool = False, reverse: bool = False):
         """Merged iterator over [start_key, stop_key): yields (key, value,
-        expire_ts) newest-version-wins, tombstones/expired filtered."""
+        expire_ts) newest-version-wins, tombstones/expired filtered.
+        reverse=True iterates the same range descending (the engine-level
+        Prev() the reference's reverse multi_get uses), so a bounded reader
+        sees the TAIL of the range first."""
         now = epoch_now() if now is None else now
         with self._lock:
             mem_snapshot = sorted(
@@ -202,38 +262,44 @@ class LsmEngine:
                 ssts.extend(self._levels[lv])
 
         def mem_source(snap):
-            for k, (v, e, d) in snap:
+            it = reversed(snap) if reverse else snap
+            for k, (v, e, d) in it:
                 yield k, v, e, d
 
         def sst_source(sst):
             if sst.n == 0:
                 return
+            if stop_key is not None and sst.min_key and sst.min_key >= stop_key:
+                return
+            if start_key and sst.max_key and sst.max_key < start_key:
+                return
             b = sst.block()
-            i = sst.lower_bound(start_key) if start_key else 0
-            while i < b.n:
-                k = b.key(i)
-                if stop_key is not None and k >= stop_key:
-                    return
-                yield k, b.value(i), int(b.expire_ts[i]), bool(b.deleted[i])
-                i += 1
+            lo = sst.lower_bound(start_key) if start_key else 0
+            hi = sst.lower_bound(stop_key) if stop_key is not None else b.n
+            rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
+            for i in rng:
+                yield b.key(i), b.value(i), int(b.expire_ts[i]), bool(b.deleted[i])
 
         sources = [mem_source(mem_snapshot)]
         sources += [mem_source(s) for s in imm_snapshots]
         sources += [sst_source(s) for s in ssts]
-        # recency rank = position in `sources`; lower wins for equal keys
+        # recency rank = position in `sources`; lower wins for equal keys.
+        # descending merges invert the key order, not the recency order.
+        hk = (lambda k: _RevBytes(k)) if reverse else (lambda k: k)
         heap = []
         for rank, src in enumerate(sources):
             it = iter(src)
             first = next(it, None)
             if first is not None:
-                heap.append((first[0], rank, first, it))
+                heap.append((hk(first[0]), rank, first, it))
         heapq.heapify(heap)
         prev_key = None
         while heap:
-            k, rank, rec, it = heap[0]
+            _, rank, rec, it = heap[0]
+            k = rec[0]
             nxt = next(it, None)
             if nxt is not None:
-                heapq.heapreplace(heap, (nxt[0], rank, nxt, it))
+                heapq.heapreplace(heap, (hk(nxt[0]), rank, nxt, it))
             else:
                 heapq.heappop(heap)
             if k == prev_key:
@@ -249,11 +315,12 @@ class LsmEngine:
 
     def flush(self) -> None:
         """Rotate the memtable and flush every immutable to an L0 SST
-        (device-sorted). Synchronous."""
+        (device-sorted). Synchronous; oldest-first keeps both L0 recency
+        order and the durable-decree invariant."""
         with self._lock:
             self._rotate_memtable_locked()
             imms = list(self._imm)
-        for imm in reversed(imms):  # oldest first keeps L0 recency order
+        for imm in reversed(imms):
             self._flush_one(imm)
 
     def _rotate_memtable_locked(self):
@@ -261,33 +328,92 @@ class LsmEngine:
             return
         self._imm.insert(0, self._mem)
         self._mem = Memtable()
+        self._mem.last_decree = self._last_committed_decree
 
     def _flush_one(self, imm: Memtable) -> None:
         block = imm.to_block()
         opts = CompactOptions(backend=self.opts.backend, prefix_u32=self.opts.prefix_u32)
         sorted_block = sort_block(block, opts)
         with self._lock:
-            decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
             name = self._alloc_file_locked()
             path = os.path.join(self.path, name)
-        write_sst(path, sorted_block, {"level": 0, "last_flushed_decree": decree})
+        write_sst(path, sorted_block, {"level": 0,
+                                       "last_flushed_decree": imm.last_decree})
         with self._lock:
             self._l0.insert(0, SSTable(path))
             self._imm.remove(imm)
+            # durability advances exactly to this memtable's decree: every
+            # older memtable has already flushed (oldest-first), younger ones
+            # hold strictly later decrees (ADVICE r1 high)
+            self._durable_decree = max(self._durable_decree, imm.last_decree)
             self._write_manifest_locked()
         if len(self._l0) >= self.opts.l0_compaction_trigger:
-            self.compact(bottommost=True)
+            self.compact()
 
-    def compact(self, bottommost: bool = True, now: int = None) -> dict:
-        """Merge all L0 runs + the sorted level into one new sorted run on the
-        configured backend — the CompactRange analogue and the TPU seam
-        (reference executor: src/server/pegasus_server_impl.cpp:2814)."""
+    def _bottommost(self, target_level: int) -> bool:
+        """Tombstones may only drop when no lower level could hold the key."""
+        deeper = any(self._levels.get(lv) for lv in
+                     range(target_level + 1, self.opts.max_levels + 1))
+        return not deeper
+
+    def compact(self, bottommost: bool = None, now: int = None) -> dict:
+        """L0 compaction: merge all L0 runs with the overlapping L1 files
+        into range-partitioned L1 output — the CompactRange analogue and the
+        TPU seam (reference executor: src/server/pegasus_server_impl.cpp:2814).
+        Cascades size-triggered single-file compactions down the levels."""
         with self._lock:
             inputs = list(self._l0)
-            old_level = list(self._levels.get(1, []))
-            input_blocks = [s.block() for s in inputs] + [s.block() for s in old_level]
-            if not input_blocks:
+            nonzero = [s for s in inputs if s.n]
+            if not nonzero:
                 return {"input_records": 0, "output_records": 0, "dropped": 0}
+            lo = min(s.min_key for s in nonzero)
+            hi = max(s.max_key for s in nonzero)
+            overlap = self._overlapping_locked(1, lo, hi)
+        bm = self._bottommost(1) if bottommost is None else bottommost
+        stats = self._merge_to_level(inputs, overlap, target_level=1,
+                                     bottommost=bm, now=now)
+        self._maybe_cascade(now)
+        return stats
+
+    def _overlapping_locked(self, level: int, lo: bytes, hi: bytes):
+        out = []
+        for f in self._levels.get(level, []):
+            if f.n == 0 or lo is None:
+                out.append(f)
+            elif not (f.max_key < lo or f.min_key > hi):
+                out.append(f)
+        return out
+
+    def _maybe_cascade(self, now=None):
+        """While a level exceeds its byte budget, push one file (plus the
+        next level's overlap) down — bounded-input leveled compaction."""
+        for lv in range(1, self.opts.max_levels):
+            while True:
+                with self._lock:
+                    files = list(self._levels.get(lv, []))
+                    if not files or self._level_bytes(lv) <= self._level_budget(lv):
+                        break
+                    cursor = self._compact_round.get(lv, 0) % len(files)
+                    self._compact_round[lv] = cursor + 1
+                    victim = files[cursor]
+                    overlap = self._overlapping_locked(
+                        lv + 1, victim.min_key, victim.max_key)
+                self._merge_to_level([victim], overlap, target_level=lv + 1,
+                                     bottommost=self._bottommost(lv + 1),
+                                     now=now)
+
+    def _level_bytes(self, lv: int) -> int:
+        return sum(s.data_bytes for s in self._levels.get(lv, []))
+
+    def _level_budget(self, lv: int) -> int:
+        return self.opts.level_base_bytes * (self.opts.level_size_ratio ** (lv - 1))
+
+    def _merge_to_level(self, newer_files, older_files, target_level: int,
+                        bottommost: bool, now=None) -> dict:
+        """Merge newer_files (recency order) over older_files into
+        target_level, splitting output at target_file_size_bytes."""
+        input_blocks = ([s.block() for s in newer_files]
+                        + [s.block() for s in older_files])
         opts = CompactOptions(
             now=now,
             pidx=self.opts.pidx,
@@ -296,29 +422,62 @@ class LsmEngine:
             default_ttl=self.opts.default_ttl,
             prefix_u32=self.opts.prefix_u32,
             backend=self.opts.backend,
+            runs_sorted=True,
+            user_ops=tuple(self.opts.user_ops),
         )
         result = compact_blocks(input_blocks, opts)
+        out_blocks = _split_block(result.block, self.opts.target_file_size_bytes)
+        new_ssts = []
+        for ob in out_blocks:
+            with self._lock:
+                path = os.path.join(self.path, self._alloc_file_locked())
+            write_sst(path, ob, {"level": target_level,
+                                 "last_flushed_decree": self._durable_decree})
+            new_ssts.append(SSTable(path))
         with self._lock:
-            name = self._alloc_file_locked()
-            path = os.path.join(self.path, name)
-            decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
-        write_sst(path, result.block, {"level": 1, "last_flushed_decree": decree})
-        with self._lock:
-            self._levels[1] = [SSTable(path)]
-            for s in inputs:
-                self._l0.remove(s)
+            # swap the new files in and every input file out atomically —
+            # inputs may come from L0 and any level (manual compact); readers
+            # that snapshotted before this keep their (cached) SSTables
+            gone = set(id(f) for f in list(newer_files) + list(older_files))
+            level = [f for f in self._levels.get(target_level, [])
+                     if id(f) not in gone]
+            level.extend(new_ssts)
+            level.sort(key=lambda s: s.min_key or b"")
+            self._levels[target_level] = level
+            self._l0 = [f for f in self._l0 if id(f) not in gone]
+            for lv in list(self._levels):
+                if lv != target_level:
+                    self._levels[lv] = [f for f in self._levels[lv]
+                                        if id(f) not in gone]
             self._write_manifest_locked()
-        for s in inputs + old_level:
-            s.release()
+        for s in list(newer_files) + list(older_files):
+            # keep the loaded block cached: a reader that snapshotted this
+            # SSTable before we unlink must not re-read the dead path
+            # (ADVICE r1 medium); the object drops with its last reference.
             try:
                 os.unlink(s.path)
             except OSError:
                 pass
         return result.stats
 
-    def manual_compact(self, bottommost: bool = True, now: int = None) -> dict:
+    def manual_compact(self, bottommost: bool = True, now: int = None,
+                       target_level: int = None) -> dict:
+        """Full compaction: everything merged into one run at target_level
+        (default: the bottommost configured level)."""
         self.flush()
-        stats = self.compact(bottommost=bottommost, now=now)
+        tl = target_level or self.opts.max_levels
+        with self._lock:
+            newer = list(self._l0)
+            for lv in sorted(self._levels):
+                if lv < tl:
+                    newer.extend(self._levels.get(lv, []))
+            older = list(self._levels.get(tl, []))
+        stats = {"input_records": 0, "output_records": 0, "dropped": 0}
+        if newer or older:
+            # inputs stay visible to readers until _merge_to_level swaps the
+            # output in; a failed merge leaves the level structure untouched
+            stats = self._merge_to_level(newer, older, target_level=tl,
+                                         bottommost=bottommost, now=now)
         self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
         with self._lock:
             self._write_manifest_locked()
@@ -327,7 +486,7 @@ class LsmEngine:
     # ------------------------------------------------------------- checkpoint
 
     def checkpoint(self, dest_dir: str) -> int:
-        """Hardlink-based consistent snapshot: checkpoint.{decree} layout
+        """Hardlink-based consistent snapshot into dest_dir
         (reference: sync_checkpoint / copy_checkpoint_to_dir_unsafe,
         src/server/pegasus_server_impl.cpp:1666,1863). Returns the decree."""
         self.flush()
@@ -339,12 +498,77 @@ class LsmEngine:
                     try:
                         os.link(sst.path, dst)
                     except OSError:
-                        import shutil
-
                         shutil.copy2(sst.path, dst)
             with open(os.path.join(dest_dir, MANIFEST), "w") as f:
                 json.dump(self._manifest_dict_locked(), f)
             return self.last_durable_decree()
+
+    def sync_checkpoint(self) -> int:
+        """Create <path>/checkpoint.{decree}; GC old ones. Returns decree."""
+        decree = self.checkpoint(os.path.join(
+            self.path, f"{CHECKPOINT_PREFIX}tmp"))
+        final = os.path.join(self.path, f"{CHECKPOINT_PREFIX}{decree}")
+        tmp = os.path.join(self.path, f"{CHECKPOINT_PREFIX}tmp")
+        if os.path.exists(final):
+            shutil.rmtree(tmp)
+        else:
+            os.replace(tmp, final)
+        self.gc_checkpoints()
+        return decree
+
+    def list_checkpoints(self) -> list:
+        """Sorted decrees of existing checkpoint.{decree} dirs
+        (reference parse_checkpoints, pegasus_server_impl.cpp:81)."""
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith(CHECKPOINT_PREFIX):
+                suffix = name[len(CHECKPOINT_PREFIX):]
+                if suffix.isdigit():
+                    out.append(int(suffix))
+        return sorted(out)
+
+    def gc_checkpoints(self) -> int:
+        """Drop checkpoints beyond the count/time reserves
+        (reference gc_checkpoints, pegasus_server_impl.cpp:120-253)."""
+        decrees = self.list_checkpoints()
+        keep_min = max(1, self.opts.checkpoint_reserve_min_count)
+        dropped = 0
+        now = time.time()
+        for d in decrees[:-keep_min] if len(decrees) > keep_min else []:
+            cdir = os.path.join(self.path, f"{CHECKPOINT_PREFIX}{d}")
+            if self.opts.checkpoint_reserve_time_seconds > 0:
+                age = now - os.path.getmtime(cdir)
+                if age < self.opts.checkpoint_reserve_time_seconds:
+                    continue
+            shutil.rmtree(cdir, ignore_errors=True)
+            dropped += 1
+        return dropped
+
+    def get_checkpoint_dir(self, decree: int = None) -> str:
+        """Latest (or specific) checkpoint dir for learner shipping
+        (reference get_checkpoint, pegasus_server_impl.cpp:1941)."""
+        decrees = self.list_checkpoints()
+        if not decrees:
+            raise FileNotFoundError("no checkpoints")
+        d = decree if decree is not None else decrees[-1]
+        return os.path.join(self.path, f"{CHECKPOINT_PREFIX}{d}")
+
+    @classmethod
+    def apply_checkpoint(cls, checkpoint_dir: str, dest_path: str,
+                         options: "EngineOptions" = None) -> "LsmEngine":
+        """Replace dest_path's data with the checkpoint and open it
+        (reference storage_apply_checkpoint, pegasus_server_impl.cpp:1970)."""
+        if os.path.exists(dest_path):
+            shutil.rmtree(dest_path)
+        os.makedirs(dest_path)
+        for name in os.listdir(checkpoint_dir):
+            src = os.path.join(checkpoint_dir, name)
+            if os.path.isfile(src):
+                try:
+                    os.link(src, os.path.join(dest_path, name))
+                except OSError:
+                    shutil.copy2(src, os.path.join(dest_path, name))
+        return cls(dest_path, options)
 
     # -------------------------------------------------------------- manifest
 
@@ -360,12 +584,14 @@ class LsmEngine:
         return name
 
     def _manifest_dict_locked(self) -> dict:
+        meta = {k: v for k, v in self._meta.items()}
+        meta[META_LAST_FLUSHED_DECREE] = self._durable_decree
         return {
             "next_file": self._next_file,
             "l0": [os.path.basename(s.path) for s in self._l0],
             "levels": {str(lv): [os.path.basename(s.path) for s in fs]
                        for lv, fs in self._levels.items()},
-            "meta": {k: v for k, v in self._meta.items()},
+            "meta": meta,
         }
 
     def _write_manifest_locked(self):
@@ -393,7 +619,9 @@ class LsmEngine:
                         for lv, fs in m["levels"].items()}
         self._meta = dict(m["meta"])
         self._durable_meta = dict(m["meta"])
-        self._last_committed_decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
+        self._durable_decree = int(self._meta.get(META_LAST_FLUSHED_DECREE, 0))
+        self._last_committed_decree = self._durable_decree
+        self._mem.last_decree = self._last_committed_decree
 
     def close(self):
         pass
@@ -407,8 +635,36 @@ class LsmEngine:
                 "memtable_bytes": self._mem.approximate_bytes,
                 "immutable_memtables": len(self._imm),
                 "l0_files": len(self._l0),
-                "level_files": {lv: len(fs) for lv, fs in self._levels.items()},
+                "level_files": {lv: len(fs) for lv, fs in self._levels.items() if fs},
+                "level_bytes": {lv: self._level_bytes(lv)
+                                for lv in self._levels if self._levels[lv]},
                 "total_sst_records": sum(s.n for s in self._all_ssts_locked()),
                 "last_committed_decree": self._last_committed_decree,
                 "last_durable_decree": self.last_durable_decree(),
             }
+
+
+def _split_block(block: KVBlock, target_bytes: int) -> list:
+    """Split a sorted block into chunks of ~target_bytes (key+value arenas),
+    preserving order; every output chunk holds a disjoint key range."""
+    if block.n == 0:
+        return [block]
+    total = block.key_bytes_total + block.val_bytes_total
+    if total <= target_bytes:
+        return [block]
+    sizes = block.key_len.astype(np.int64) + block.val_len.astype(np.int64)
+    cum = np.cumsum(sizes)
+    bounds = []
+    start = 0
+    base = 0
+    for _ in range(int(total // target_bytes) + 1):
+        cut = np.searchsorted(cum, base + target_bytes, side="left") + 1
+        cut = min(int(cut), block.n)
+        if cut <= start:
+            cut = start + 1
+        bounds.append((start, cut))
+        if cut >= block.n:
+            break
+        start = cut
+        base = int(cum[cut - 1])
+    return [block.gather(np.arange(s, e, dtype=np.int64)) for s, e in bounds]

@@ -15,6 +15,7 @@ class Memtable:
     def __init__(self):
         self._data = {}  # key -> (value_bytes, expire_ts, deleted)
         self._bytes = 0
+        self.last_decree = 0  # highest decree contained; stamped per write
 
     def __len__(self):
         return len(self._data)

@@ -1,0 +1,143 @@
+"""Replica serverlet: binds rrdb task codes to a PegasusServer per partition.
+
+The reference's pegasus_read_service registration glue + pegasus_service_app
+(src/server/pegasus_read_service.h:36-84, pegasus_service_app.h): one
+process serves many (app_id, partition) replicas; each RPC is routed by the
+header's (app_id, partition_index) and the key's partition hash is sanity-
+checked against the partition the way pegasus_server_write does
+(src/server/pegasus_server_write.cpp per-request hash check).
+
+Standalone mode commits writes locally with a monotonically increasing
+decree (one writer per partition — PacificA's per-partition serialization).
+When a replication.ReplicaStub hosts the partition, writes are routed
+through PacificA 2PC instead (write_router hook).
+"""
+
+import threading
+import time
+
+from ..rpc import codec
+from ..rpc import messages as msg
+from ..rpc.transport import (ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError)
+from . import server_impl
+from .server_impl import PegasusServer
+
+# read task codes (src/include/rrdb/rrdb.code.definition.h)
+RPC_GET = "RPC_RRDB_RRDB_GET"
+RPC_MULTI_GET = "RPC_RRDB_RRDB_MULTI_GET"
+RPC_SORTKEY_COUNT = "RPC_RRDB_RRDB_SORTKEY_COUNT"
+RPC_TTL = "RPC_RRDB_RRDB_TTL"
+RPC_GET_SCANNER = "RPC_RRDB_RRDB_GET_SCANNER"
+RPC_SCAN = "RPC_RRDB_RRDB_SCAN"
+RPC_CLEAR_SCANNER = "RPC_RRDB_RRDB_CLEAR_SCANNER"
+
+WRITE_CODES = {
+    server_impl.RPC_PUT: (msg.UpdateRequest, msg.UpdateResponse),
+    server_impl.RPC_REMOVE: (msg.KeyRequest, msg.UpdateResponse),
+    server_impl.RPC_MULTI_PUT: (msg.MultiPutRequest, msg.UpdateResponse),
+    server_impl.RPC_MULTI_REMOVE: (msg.MultiRemoveRequest, msg.MultiRemoveResponse),
+    server_impl.RPC_INCR: (msg.IncrRequest, msg.IncrResponse),
+    server_impl.RPC_CHECK_AND_SET: (msg.CheckAndSetRequest, msg.CheckAndSetResponse),
+    server_impl.RPC_CHECK_AND_MUTATE: (msg.CheckAndMutateRequest,
+                                       msg.CheckAndMutateResponse),
+}
+
+
+class ReplicaService:
+    """Hosts PegasusServer replicas; register with RpcServer.register_serverlet."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = {}     # (app_id, pidx) -> PegasusServer
+        self._wlocks = {}       # (app_id, pidx) -> per-partition write lock
+        self._partition_counts = {}  # app_id -> partition count
+        self._write_router = None    # set by replication to intercept writes
+
+    def add_replica(self, server: PegasusServer, partition_count: int) -> None:
+        with self._lock:
+            self._replicas[(server.app_id, server.pidx)] = server
+            self._wlocks[(server.app_id, server.pidx)] = threading.Lock()
+            self._partition_counts[server.app_id] = partition_count
+
+    def remove_replica(self, app_id: int, pidx: int) -> None:
+        with self._lock:
+            self._replicas.pop((app_id, pidx), None)
+            self._wlocks.pop((app_id, pidx), None)
+
+    def set_write_router(self, fn) -> None:
+        """fn(server, code, req) -> response; replaces local commit (PacificA)."""
+        self._write_router = fn
+
+    def _replica(self, header) -> PegasusServer:
+        srv = self._replicas.get((header.app_id, header.partition_index))
+        if srv is None:
+            raise RpcError(ERR_OBJECT_NOT_FOUND,
+                           f"partition {header.app_id}.{header.partition_index} "
+                           f"not served here")
+        n = self._partition_counts.get(header.app_id, 1)
+        if n > 0 and header.partition_hash \
+                and header.partition_index != header.partition_hash % n:
+            raise RpcError(ERR_INVALID_STATE,
+                           f"partition hash routes to "
+                           f"{header.partition_hash % n}, not {header.partition_index}")
+        return srv
+
+    # --------------------------------------------------------------- handlers
+
+    def rpc_handlers(self) -> dict:
+        h = {
+            RPC_GET: self._on_get,
+            RPC_MULTI_GET: self._on_multi_get,
+            RPC_SORTKEY_COUNT: self._on_sortkey_count,
+            RPC_TTL: self._on_ttl,
+            RPC_GET_SCANNER: self._on_get_scanner,
+            RPC_SCAN: self._on_scan,
+            RPC_CLEAR_SCANNER: self._on_clear_scanner,
+        }
+        for code in WRITE_CODES:
+            h[code] = self._on_write
+        return h
+
+    def _on_get(self, header, body) -> bytes:
+        req = codec.decode(msg.KeyRequest, body)
+        return codec.encode(self._replica(header).on_get(req.key))
+
+    def _on_multi_get(self, header, body) -> bytes:
+        req = codec.decode(msg.MultiGetRequest, body)
+        return codec.encode(self._replica(header).on_multi_get(req))
+
+    def _on_sortkey_count(self, header, body) -> bytes:
+        req = codec.decode(msg.KeyRequest, body)
+        return codec.encode(self._replica(header).on_sortkey_count(req.key))
+
+    def _on_ttl(self, header, body) -> bytes:
+        req = codec.decode(msg.KeyRequest, body)
+        return codec.encode(self._replica(header).on_ttl(req.key))
+
+    def _on_get_scanner(self, header, body) -> bytes:
+        req = codec.decode(msg.GetScannerRequest, body)
+        return codec.encode(self._replica(header).on_get_scanner(req))
+
+    def _on_scan(self, header, body) -> bytes:
+        req = codec.decode(msg.ScanRequest, body)
+        return codec.encode(self._replica(header).on_scan(req))
+
+    def _on_clear_scanner(self, header, body) -> bytes:
+        req = codec.decode(msg.ScanRequest, body)
+        self._replica(header).on_clear_scanner(req.context_id)
+        return b""
+
+    def _on_write(self, header, body) -> bytes:
+        req_cls, _ = WRITE_CODES[header.code]
+        req = codec.decode(req_cls, body)
+        srv = self._replica(header)
+        router = self._write_router
+        if router is not None:
+            resp = router(srv, header.code, req)
+        else:
+            with self._wlocks[(srv.app_id, srv.pidx)]:
+                decree = srv.engine.last_committed_decree() + 1
+                resps = srv.on_batched_write_requests(
+                    decree, int(time.time() * 1e6), [(header.code, req)])
+                resp = resps[0]
+        return codec.encode(resp)

@@ -3,52 +3,51 @@
 A get_scanner/scan sequence holds state on the server between RPCs. Context
 ids carry random high bits so a stale id from before a restart/failover
 misses instead of resuming someone else's iterator (reference :100-110).
+One session keeps ONE id for its whole life (the reference's fetch/put dance
+re-inserts under the same id, :86-140); eviction is LRU, O(1) per op.
 """
 
 import random
 import threading
+from collections import OrderedDict
 
 
 class ScanContext:
     def __init__(self, iterator, request):
         self.iterator = iterator      # the live generator over the engine
         self.request = request        # the originating GetScannerRequest
+        self.id = None                # assigned by the cache at first put
         self.lock = threading.Lock()  # one scan RPC at a time per context
 
 
 class ScanContextCache:
     def __init__(self, max_contexts: int = 1000):
         self._lock = threading.Lock()
-        self._contexts = {}
-        self._order = []
+        self._contexts = OrderedDict()  # cid -> ScanContext, LRU order
         self._max = max_contexts
         self._high_bits = random.getrandbits(16) << 32
         self._next = 0
 
     def put(self, ctx: ScanContext) -> int:
+        """Insert (or re-insert after a fetch) keeping the session's id."""
         with self._lock:
-            cid = self._high_bits | self._next
-            self._next += 1
-            self._contexts[cid] = ctx
-            self._order.append(cid)
-            while len(self._order) > self._max:
-                old = self._order.pop(0)
-                self._contexts.pop(old, None)
-            return cid
+            if ctx.id is None:
+                ctx.id = self._high_bits | self._next
+                self._next += 1
+            self._contexts[ctx.id] = ctx
+            self._contexts.move_to_end(ctx.id)
+            while len(self._contexts) > self._max:
+                self._contexts.popitem(last=False)
+            return ctx.id
 
     def fetch(self, cid: int):
-        """Remove and return (re-inserted after use, like the reference's
-        fetch/put dance that keeps eviction order fresh)."""
+        """Remove and return (re-inserted after use via put, same id)."""
         with self._lock:
-            ctx = self._contexts.pop(cid, None)
-            if ctx is not None:
-                self._order.remove(cid)
-            return ctx
+            return self._contexts.pop(cid, None)
 
     def remove(self, cid: int):
         with self._lock:
-            if self._contexts.pop(cid, None) is not None:
-                self._order.remove(cid)
+            self._contexts.pop(cid, None)
 
     def __len__(self):
         with self._lock:

@@ -19,6 +19,7 @@ from ..runtime.perf_counters import counters
 from ..rpc import messages as msg
 from ..rpc.messages import FilterType, Status, match_filter
 from .db import EngineOptions, LsmEngine
+from .range_read_limiter import RangeReadLimiter
 from .scan_context import ScanContext, ScanContextCache
 from .write_service import WriteService
 
@@ -57,6 +58,9 @@ class PegasusServer:
         self._app_envs = {}
         self._default_ttl = 0
         self._pfx = f"app.{app_id}.{pidx}."
+        from .manual_compact_service import ManualCompactService
+
+        self.manual_compact_service = ManualCompactService(self)
         if app_envs:
             self.update_app_envs(app_envs)
 
@@ -72,9 +76,17 @@ class PegasusServer:
         backend = envs.get(consts.COMPACTION_BACKEND_KEY)
         if backend in ("cpu", "tpu"):
             self.engine.opts.backend = backend
+        if consts.USER_SPECIFIED_COMPACTION in envs:
+            from .compaction_rules import parse_user_specified_compaction
+
+            self.engine.opts.user_ops = tuple(parse_user_specified_compaction(
+                envs[consts.USER_SPECIFIED_COMPACTION]))
         scenario = envs.get(consts.ENV_USAGE_SCENARIO_KEY)
         if scenario:
             self.set_usage_scenario(scenario)
+        if any(k.startswith(consts.MANUAL_COMPACT_KEY_PREFIX) for k in envs):
+            self.manual_compact_service.start_manual_compact_if_needed(
+                self._app_envs)
 
     def set_usage_scenario(self, scenario: str) -> bool:
         """normal / prefer_write / bulk_load tuning profiles
@@ -100,19 +112,35 @@ class PegasusServer:
     def app_envs(self) -> dict:
         return dict(self._app_envs)
 
+    def _make_limiter(self, count_only: bool = False) -> RangeReadLimiter:
+        """Per-RPC iteration budget (src/server/range_read_limiter.h:29-100);
+        thresholds come from app-envs with the reference's defaults."""
+        envs = self._app_envs
+        return RangeReadLimiter(
+            max_iteration_count=int(envs.get(
+                consts.ROCKSDB_ITERATION_THRESHOLD_COUNT, 1000)),
+            max_iteration_size=0 if count_only else int(envs.get(
+                consts.ROCKSDB_ITERATION_THRESHOLD_SIZE, 4 << 20)),
+            max_duration_ms=int(envs.get(
+                consts.ROCKSDB_ITERATION_THRESHOLD_TIME_MS, 5000)),
+        )
+
     # ------------------------------------------------------------ write path
 
-    def on_batched_write_requests(self, decree: int, timestamp_us: int, requests):
+    def on_batched_write_requests(self, decree: int, timestamp_us: int, requests,
+                                  now: int = None):
         """The replication->engine boundary
         (src/server/pegasus_server_write.cpp:39): `requests` is a list of
         (code, request) already committed at `decree`. Returns responses in
-        order. Consecutive PUT/REMOVE coalesce into one engine write."""
+        order. Consecutive PUT/REMOVE coalesce into one engine write.
+        `now` injects the read-modify-write clock for tests (the reference's
+        PEGASUS_UNIT_TEST mock-time hook)."""
         if not requests:
             self.write_service.empty_put(decree)
             return []
         if len(requests) == 1 and requests[0][0] not in BATCHABLE:
             code, req = requests[0]
-            return [self._dispatch_single(decree, timestamp_us, code, req)]
+            return [self._dispatch_single(decree, timestamp_us, code, req, now)]
         # batch path: only batchable codes may be grouped (the reference
         # asserts non-batchable codes never arrive in a multi-request batch)
         responses = []
@@ -124,7 +152,7 @@ class PegasusServer:
                 responses.append(ws._fill(msg.UpdateResponse(), decree))
                 counters.rate(self._pfx + "put_qps").increment()
             elif code == RPC_REMOVE:
-                ws.batch_remove(req)
+                ws.batch_remove(req.key)
                 responses.append(ws._fill(msg.UpdateResponse(), decree))
                 counters.rate(self._pfx + "remove_qps").increment()
             else:
@@ -133,14 +161,14 @@ class PegasusServer:
         ws.batch_commit(decree)
         return responses
 
-    def _dispatch_single(self, decree, timestamp_us, code, req):
+    def _dispatch_single(self, decree, timestamp_us, code, req, now=None):
         ws = self.write_service
         if code == RPC_PUT:
             counters.rate(self._pfx + "put_qps").increment()
             return ws.put(decree, req, timestamp_us)
         if code == RPC_REMOVE:
             counters.rate(self._pfx + "remove_qps").increment()
-            return ws.remove(decree, req)
+            return ws.remove(decree, req.key)
         if code == RPC_MULTI_PUT:
             counters.rate(self._pfx + "multi_put_qps").increment()
             return ws.multi_put(decree, req, timestamp_us)
@@ -149,13 +177,13 @@ class PegasusServer:
             return ws.multi_remove(decree, req)
         if code == RPC_INCR:
             counters.rate(self._pfx + "incr_qps").increment()
-            return ws.incr(decree, req)
+            return ws.incr(decree, req, now=now)
         if code == RPC_CHECK_AND_SET:
             counters.rate(self._pfx + "check_and_set_qps").increment()
-            return ws.check_and_set(decree, req)
+            return ws.check_and_set(decree, req, now=now)
         if code == RPC_CHECK_AND_MUTATE:
             counters.rate(self._pfx + "check_and_mutate_qps").increment()
-            return ws.check_and_mutate(decree, req)
+            return ws.check_and_mutate(decree, req, now=now)
         raise ValueError(f"unknown write code {code}")
 
     # ------------------------------------------------------------- read path
@@ -178,7 +206,9 @@ class PegasusServer:
 
     def on_multi_get(self, req: msg.MultiGetRequest, now: int = None) -> msg.MultiGetResponse:
         """src/server/pegasus_server_impl.cpp:343: specified sort_keys, or a
-        bounded+filtered range under the hash_key."""
+        bounded+filtered range under the hash_key. reverse=True keeps the
+        LAST max_kv_count/size items of the range and returns them in
+        descending sort_key order (the reference iterates with Prev())."""
         now = epoch_now() if now is None else now
         resp = msg.MultiGetResponse(app_id=self.app_id, partition_index=self.pidx,
                                     server=self.server)
@@ -197,34 +227,46 @@ class PegasusServer:
         else:
             stop = key_schema.generate_next_bytes(req.hash_key)
 
+        # reverse iterates the engine descending (the reference's Prev()
+        # from the stop key), so bounded reads return the range's TAIL and
+        # the limiter budget is spent at the correct end
+        limiter = self._make_limiter()
         out, complete = [], True
         size = 0
-        for k, raw, _ in self.engine.scan(start, None, now=now):
-            if k >= stop:
-                if req.stop_inclusive and k == stop:
-                    pass  # still include the stop key itself
-                else:
+        if req.reverse:
+            scan_hi = stop + b"\x00" if req.stop_inclusive else stop
+            it = self.engine.scan(start, scan_hi, now=now, reverse=True)
+        else:
+            it = self.engine.scan(start, None, now=now)
+        for k, raw, _ in it:
+            if req.reverse:
+                if k == start and not req.start_inclusive:
                     break
-            if not req.start_inclusive and k == start:
-                continue
+            else:
+                if k >= stop:
+                    if req.stop_inclusive and k == stop:
+                        pass  # still include the stop key itself
+                    else:
+                        break
+                if not req.start_inclusive and k == start:
+                    continue
+            limiter.add_count()
+            if not limiter.valid():
+                complete = False
+                break
             _, sk = key_schema.restore_key(k)
             if not match_filter(req.sort_key_filter_type, req.sort_key_filter_pattern, sk):
                 continue
             data = b"" if req.no_value else self._schema.extract_user_data(raw)
             out.append(msg.KeyValue(sk, data))
             size += len(sk) + len(data)
+            limiter.add_size(len(sk) + len(data))
             if (req.max_kv_count > 0 and len(out) > req.max_kv_count) or (
                 req.max_kv_size > 0 and size > req.max_kv_size
             ):
                 out.pop()
                 complete = False
                 break
-        if req.reverse:
-            out.reverse()
-            if not complete:
-                # reverse semantics: the limit should trim from the front of
-                # the ascending range, i.e. keep the LAST max_kv_count items
-                pass
         resp.kvs = out
         resp.error = Status.OK if complete else Status.INCOMPLETE
         return resp
@@ -236,7 +278,15 @@ class PegasusServer:
                                  server=self.server)
         start = key_schema.generate_key(hash_key, b"")
         stop = key_schema.generate_next_bytes(hash_key)
-        resp.count = sum(1 for _ in self.engine.scan(start, stop, now=now))
+        limiter = self._make_limiter(count_only=True)
+        count = 0
+        for _ in self.engine.scan(start, stop, now=now):
+            limiter.add_count()
+            if not limiter.valid():
+                resp.error = Status.INCOMPLETE
+                break
+            count += 1
+        resp.count = count
         counters.rate(self._pfx + "scan_qps").increment()
         return resp
 
@@ -264,17 +314,17 @@ class PegasusServer:
 
         start = req.start_key
         stop = req.stop_key if req.stop_key else None
-        # prefix-filtered full scans can narrow the range like the reference
-        # narrows by hash-key filter (:961-978)
+        # hash-key prefix filter narrows the LOWER bound like the reference
+        # (:961-978): keys encode [u16 hashkey_len][hash_key][sort_key], and
+        # any hash_key with this prefix has len >= len(pattern), so its
+        # encoded key sorts >= [len(pattern)][pattern] — a valid lower bound.
+        # (No tight upper bound exists: longer hash_keys sort by the leading
+        # length field, not contiguously after the pattern range.)
         if (req.hash_key_filter_type == FilterType.MATCH_PREFIX
                 and req.hash_key_filter_pattern):
             pstart = key_schema.generate_key(req.hash_key_filter_pattern, b"")
-            pstop = key_schema.generate_next_bytes(req.hash_key_filter_pattern)
-            # widen to prefix-length keys: any hash_key with this prefix sorts
-            # within [len-prefixed pattern, next(pattern)) only for equal
-            # lengths, so only narrow when the range is wider
-            if start < pstart[:2]:
-                pass  # conservative: keep caller range
+            if pstart > start:
+                start = pstart
         it = self.engine.scan(start, stop, now=now)
 
         def filtered():

@@ -34,6 +34,28 @@ _COLUMNS = [
 ]
 
 
+_BLOOM_SALTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1)
+
+
+def _bloom_build(hash32: np.ndarray) -> tuple:
+    """Bloom filter over the per-record hashkey hash (the reference's
+    hashkey prefix bloom, src/server/hashkey_transform.h:31-60: one probe
+    set per hash_key, shared by all its sort_keys). ~10 bits/distinct-hash,
+    k=5; returns (bits bytes, log2_m)."""
+    uniq = np.unique(hash32)
+    m = 64
+    while m < len(uniq) * 10:
+        m <<= 1
+    log2m = m.bit_length() - 1
+    bits = np.zeros(m // 8, dtype=np.uint8)
+    h = uniq.astype(np.uint64)
+    for salt in _BLOOM_SALTS:
+        pos = ((h * np.uint64(salt)) & np.uint64(0xFFFFFFFF)) >> np.uint64(32 - log2m)
+        np.bitwise_or.at(bits, (pos >> np.uint64(3)).astype(np.int64),
+                         (np.uint8(1) << (pos & np.uint64(7)).astype(np.uint8)))
+    return bits.tobytes(), log2m
+
+
 def write_sst(path: str, block: KVBlock, meta: dict = None) -> dict:
     """Write atomically (tmp+rename). Returns the header dict."""
     sections = {}
@@ -46,12 +68,19 @@ def write_sst(path: str, block: KVBlock, meta: dict = None) -> dict:
                           "shape": list(arr.shape)}
         payload.append(raw)
         offset += len(raw)
+    bloom_hex, bloom_log2m = "", 0
+    if block.n:
+        bloom_bits, bloom_log2m = _bloom_build(block.hash32)
+        bloom_hex = bloom_bits.hex()
     header = {
         "sections": sections,
         "meta": dict(meta or {}),
         "n": block.n,
         "min_key": block.key(0).hex() if block.n else None,
         "max_key": block.key(block.n - 1).hex() if block.n else None,
+        "data_bytes": block.key_bytes_total + block.val_bytes_total,
+        "bloom": bloom_hex,
+        "bloom_log2m": bloom_log2m,
     }
     hdr = json.dumps(header).encode()
     tmp = path + ".tmp"
@@ -105,10 +134,35 @@ class SSTable:
         self.path = path
         self.header = read_header(path)
         self._block = None
+        self._bloom = None
+        if self.header.get("bloom"):
+            self._bloom = np.frombuffer(
+                bytes.fromhex(self.header["bloom"]), dtype=np.uint8)
+        self._bloom_log2m = int(self.header.get("bloom_log2m", 0))
 
     @property
     def n(self) -> int:
         return self.header["n"]
+
+    @property
+    def data_bytes(self) -> int:
+        return int(self.header.get(
+            "data_bytes",
+            self.header["sections"]["key_arena"]["nbytes"]
+            + self.header["sections"]["val_arena"]["nbytes"]))
+
+    def maybe_contains_hash(self, h32) -> bool:
+        """Hashkey bloom probe; False = definitely absent (no disk read)."""
+        if self._bloom is None:
+            return self.n > 0
+        h = np.uint64(h32)
+        for salt in _BLOOM_SALTS:
+            pos = ((h * np.uint64(salt)) & np.uint64(0xFFFFFFFF)) \
+                >> np.uint64(32 - self._bloom_log2m)
+            if not (self._bloom[int(pos >> np.uint64(3))]
+                    >> np.uint8(pos & np.uint64(7))) & 1:
+                return False
+        return True
 
     @property
     def min_key(self):

@@ -134,9 +134,9 @@ class WriteService:
                     self.empty_put(decree)
                     return resp
                 new_value = old_int + req.increment
-                if (req.increment > 0 and new_value < old_int) or (
-                    req.increment < 0 and new_value > old_int
-                ):
+                # int64 overflow rejection (impl.h:137-143); explicit range
+                # check because python ints never wrap
+                if not (-(1 << 63) <= new_value < (1 << 63)):
                     resp.error = Status.INVALID_ARGUMENT
                     resp.new_value = old_int
                     self.empty_put(decree)

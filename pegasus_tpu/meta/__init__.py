@@ -1,0 +1,3 @@
+from .meta_server import MetaServer
+
+__all__ = ["MetaServer"]

@@ -1,0 +1,204 @@
+"""Meta-server wire messages (the replication_ddl_client / meta surface).
+
+Mirrors the rDSN meta contract Pegasus consumes (SURVEY.md §2.4 'Meta
+server'): table DDL, partition-config queries, app-envs, and the beacon
+failure detector (config.ini:232-238). Addresses travel as "host:port"
+strings.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class PartitionConfig:
+    pidx: int = 0
+    ballot: int = 0
+    primary: str = ""                 # "" = unassigned
+    secondaries: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AppInfo:
+    app_name: str = ""
+    app_id: int = 0
+    partition_count: int = 0
+    replica_count: int = 3
+    status: str = "AS_AVAILABLE"
+    envs_json: str = "{}"
+
+
+@dataclass
+class CreateAppRequest:
+    app_name: str = ""
+    partition_count: int = 8
+    replica_count: int = 3
+    envs_json: str = "{}"
+
+
+@dataclass
+class CreateAppResponse:
+    error: int = 0
+    error_text: str = ""
+    app_id: int = 0
+
+
+@dataclass
+class DropAppRequest:
+    app_name: str = ""
+
+
+@dataclass
+class DropAppResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+@dataclass
+class ListAppsRequest:
+    pass
+
+
+@dataclass
+class ListAppsResponse:
+    error: int = 0
+    apps: List[AppInfo] = field(default_factory=list)
+
+
+@dataclass
+class QueryConfigRequest:
+    app_name: str = ""
+
+
+@dataclass
+class QueryConfigResponse:
+    error: int = 0
+    error_text: str = ""
+    app: AppInfo = field(default_factory=AppInfo)
+    partitions: List[PartitionConfig] = field(default_factory=list)
+
+
+@dataclass
+class SetAppEnvsRequest:
+    app_name: str = ""
+    envs_json: str = "{}"
+
+
+@dataclass
+class SetAppEnvsResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+@dataclass
+class BeaconRequest:
+    node: str = ""                    # replica node address
+    alive_replicas: List[str] = field(default_factory=list)  # "app_id.pidx"
+
+
+@dataclass
+class BeaconResponse:
+    error: int = 0
+    allowed: bool = True              # lease granted
+
+
+@dataclass
+class NodeInfo:
+    address: str = ""
+    alive: bool = True
+    last_beacon_ms: int = 0
+    replica_count: int = 0
+
+
+@dataclass
+class ListNodesRequest:
+    pass
+
+
+@dataclass
+class ListNodesResponse:
+    error: int = 0
+    nodes: List[NodeInfo] = field(default_factory=list)
+
+
+# --- meta -> replica node commands ---
+
+@dataclass
+class OpenReplicaRequest:
+    app_name: str = ""
+    app_id: int = 0
+    pidx: int = 0
+    ballot: int = 0
+    primary: str = ""
+    secondaries: List[str] = field(default_factory=list)
+    learn_from: str = ""              # non-empty: seed from this node first
+    envs_json: str = "{}"
+
+
+@dataclass
+class OpenReplicaResponse:
+    error: int = 0
+    error_text: str = ""
+    last_committed: int = 0
+    last_prepared: int = 0
+
+
+@dataclass
+class CloseReplicaRequest:
+    app_id: int = 0
+    pidx: int = 0
+
+
+@dataclass
+class ReplicaStateRequest:
+    app_id: int = 0
+    pidx: int = 0
+
+
+@dataclass
+class ReplicaStateResponse:
+    error: int = 0
+    status: str = ""
+    ballot: int = 0
+    last_committed: int = 0
+    last_prepared: int = 0
+    last_durable: int = 0
+
+
+# --- replica <-> replica (2PC + learn) ---
+
+@dataclass
+class PrepareRequest:
+    app_id: int = 0
+    pidx: int = 0
+    ballot: int = 0
+    committed_decree: int = 0
+    mutation: bytes = b""             # codec-encoded LogMutation
+
+
+@dataclass
+class PrepareResponse:
+    error: int = 0
+    reason: str = ""                  # "", "gap", "stale_ballot"
+    last_prepared: int = 0
+
+
+@dataclass
+class FileBlob:
+    name: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class LearnRequest:
+    app_id: int = 0
+    pidx: int = 0
+
+
+@dataclass
+class LearnResponse:
+    error: int = 0
+    files: List[FileBlob] = field(default_factory=list)
+    tail: List[bytes] = field(default_factory=list)   # encoded LogMutations
+    last_committed: int = 0
+    ballot: int = 0

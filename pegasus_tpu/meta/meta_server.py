@@ -1,0 +1,323 @@
+"""Meta server: table DDL, partition->replica mapping, beacon FD, failover.
+
+The rDSN meta-server role this build re-provides (SURVEY.md §2.4 'Meta
+server' + 'Failure detector'): app state and partition configs live here
+(persisted to a JSON state file standing in for the ZooKeeper-backed
+meta_state_service), replica nodes register via beacons with lease/grace
+semantics (fd_lease_seconds/fd_grace_seconds, config.ini:232-238), and node
+death triggers reconfiguration: promote a surviving secondary, then rebuild
+replica count by seeding a learner on an under-loaded node — the
+greedy_load_balancer's simplest move set.
+
+Serverlet codes: RPC_CM_* (client/DDL) + RPC_FD_BEACON (nodes), matching
+the reference's task-code families.
+"""
+
+import json
+import os
+import threading
+import time
+
+from ..rpc import codec
+from ..rpc.transport import ConnectionPool, ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError
+from . import messages as mm
+
+RPC_CM_CREATE_APP = "RPC_CM_START_CREATE_APP"
+RPC_CM_DROP_APP = "RPC_CM_START_DROP_APP"
+RPC_CM_LIST_APPS = "RPC_CM_LIST_APPS"
+RPC_CM_QUERY_CONFIG = "RPC_CM_QUERY_PARTITION_CONFIG_BY_INDEX"
+RPC_CM_SET_APP_ENVS = "RPC_CM_UPDATE_APP_ENV"
+RPC_CM_LIST_NODES = "RPC_CM_LIST_NODES"
+RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
+
+# meta -> replica node
+RPC_OPEN_REPLICA = "RPC_CONFIG_PROPOSAL_OPEN_REPLICA"
+RPC_CLOSE_REPLICA = "RPC_CONFIG_PROPOSAL_CLOSE_REPLICA"
+RPC_REPLICA_STATE = "RPC_QUERY_REPLICA_STATE"
+
+
+class MetaServer:
+    def __init__(self, state_path: str, fd_grace_seconds: float = 22.0,
+                 replica_count: int = 3):
+        self.state_path = state_path
+        self.fd_grace = fd_grace_seconds
+        self.default_replica_count = replica_count
+        self._lock = threading.RLock()
+        self._apps = {}          # name -> AppInfo
+        self._parts = {}         # app_id -> list[PartitionConfig]
+        self._nodes = {}         # addr -> last_beacon_monotonic
+        self._next_app_id = 1
+        self.pool = ConnectionPool()
+        self._load()
+
+    # ----------------------------------------------------------- serverlet
+
+    def rpc_handlers(self) -> dict:
+        return {
+            RPC_CM_CREATE_APP: self._on_create_app,
+            RPC_CM_DROP_APP: self._on_drop_app,
+            RPC_CM_LIST_APPS: self._on_list_apps,
+            RPC_CM_QUERY_CONFIG: self._on_query_config,
+            RPC_CM_SET_APP_ENVS: self._on_set_app_envs,
+            RPC_CM_LIST_NODES: self._on_list_nodes,
+            RPC_FD_BEACON: self._on_beacon,
+        }
+
+    # ----------------------------------------------------------------- DDL
+
+    def _on_create_app(self, header, body) -> bytes:
+        req = codec.decode(mm.CreateAppRequest, body)
+        with self._lock:
+            if req.app_name in self._apps:
+                app = self._apps[req.app_name]
+                return codec.encode(mm.CreateAppResponse(app_id=app.app_id))
+            alive = self._alive_nodes_locked()
+            if not alive:
+                return codec.encode(mm.CreateAppResponse(
+                    error=1, error_text="no alive replica nodes"))
+            app = mm.AppInfo(app_name=req.app_name, app_id=self._next_app_id,
+                             partition_count=req.partition_count,
+                             replica_count=min(req.replica_count, len(alive)),
+                             envs_json=req.envs_json)
+            self._next_app_id += 1
+            self._apps[req.app_name] = app
+            parts = []
+            for pidx in range(req.partition_count):
+                members = self._pick_nodes_locked(app.replica_count, pidx)
+                pc = mm.PartitionConfig(pidx=pidx, ballot=1,
+                                        primary=members[0],
+                                        secondaries=members[1:])
+                parts.append(pc)
+            self._parts[app.app_id] = parts
+            self._persist_locked()
+        for pc in parts:
+            self._install_partition(app, pc, learners=())
+        return codec.encode(mm.CreateAppResponse(app_id=app.app_id))
+
+    def _on_drop_app(self, header, body) -> bytes:
+        req = codec.decode(mm.DropAppRequest, body)
+        with self._lock:
+            app = self._apps.pop(req.app_name, None)
+            if app is None:
+                return codec.encode(mm.DropAppResponse(
+                    error=1, error_text="no such app"))
+            parts = self._parts.pop(app.app_id, [])
+            self._persist_locked()
+        for pc in parts:
+            for node in [pc.primary] + pc.secondaries:
+                self._send_to_node(node, RPC_CLOSE_REPLICA,
+                                   mm.CloseReplicaRequest(app.app_id, pc.pidx),
+                                   ignore_errors=True)
+        return codec.encode(mm.DropAppResponse())
+
+    def _on_list_apps(self, header, body) -> bytes:
+        with self._lock:
+            return codec.encode(mm.ListAppsResponse(
+                apps=list(self._apps.values())))
+
+    def _on_query_config(self, header, body) -> bytes:
+        req = codec.decode(mm.QueryConfigRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.QueryConfigResponse(
+                    error=1, error_text=f"no app {req.app_name}"))
+            return codec.encode(mm.QueryConfigResponse(
+                app=app, partitions=list(self._parts[app.app_id])))
+
+    def _on_set_app_envs(self, header, body) -> bytes:
+        req = codec.decode(mm.SetAppEnvsRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.SetAppEnvsResponse(
+                    error=1, error_text="no such app"))
+            envs = json.loads(app.envs_json)
+            envs.update(json.loads(req.envs_json))
+            app.envs_json = json.dumps(envs)
+            parts = list(self._parts[app.app_id])
+            self._persist_locked()
+        # push to every serving node (reference: meta spreads app envs to
+        # replicas which hot-apply them, pegasus_server_impl.cpp:2406)
+        for pc in parts:
+            for node in [pc.primary] + pc.secondaries:
+                self._send_to_node(node, RPC_OPEN_REPLICA, mm.OpenReplicaRequest(
+                    app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+                    ballot=pc.ballot, primary=pc.primary,
+                    secondaries=pc.secondaries, envs_json=app.envs_json),
+                    ignore_errors=True)
+        return codec.encode(mm.SetAppEnvsResponse())
+
+    def _on_list_nodes(self, header, body) -> bytes:
+        with self._lock:
+            nodes = []
+            now = time.monotonic()
+            for addr, last in self._nodes.items():
+                nodes.append(mm.NodeInfo(
+                    address=addr, alive=(now - last) < self.fd_grace,
+                    last_beacon_ms=int(last * 1000),
+                    replica_count=sum(
+                        1 for parts in self._parts.values() for pc in parts
+                        if pc.primary == addr or addr in pc.secondaries)))
+            return codec.encode(mm.ListNodesResponse(nodes=nodes))
+
+    # ------------------------------------------------------------------- FD
+
+    def _on_beacon(self, header, body) -> bytes:
+        req = codec.decode(mm.BeaconRequest, body)
+        with self._lock:
+            known = req.node in self._nodes
+            self._nodes[req.node] = time.monotonic()
+        if not known:
+            self._persist()
+        return codec.encode(mm.BeaconResponse(allowed=True))
+
+    def check_leases(self) -> list:
+        """Expire dead nodes and reconfigure their partitions. Returns the
+        list of nodes declared dead. Call from a timer (or tests)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [a for a, last in self._nodes.items()
+                    if (now - last) >= self.fd_grace]
+        for node in dead:
+            self._handle_node_death(node)
+        return dead
+
+    def mark_node_dead(self, addr: str) -> None:
+        """Force-expire (tests / admin)."""
+        with self._lock:
+            if addr in self._nodes:
+                self._nodes[addr] = -1e18
+        self._handle_node_death(addr)
+
+    # ---------------------------------------------------------- failover
+
+    def _handle_node_death(self, node: str) -> None:
+        with self._lock:
+            moves = []
+            for app in self._apps.values():
+                for pc in self._parts[app.app_id]:
+                    if pc.primary == node or node in pc.secondaries:
+                        moves.append((app, pc))
+        for app, pc in moves:
+            self._reconfigure_partition(app, pc, dead=node)
+
+    def _reconfigure_partition(self, app: mm.AppInfo, pc: mm.PartitionConfig,
+                               dead: str) -> None:
+        with self._lock:
+            members = [m for m in [pc.primary] + pc.secondaries if m != dead]
+            if not members:
+                pc.primary = ""
+                pc.secondaries = []
+                self._persist_locked()
+                return
+            pc.ballot += 1
+            if pc.primary == dead:
+                # promote the secondary with the longest prepared log
+                best, best_state = None, (-1, -1)
+                for m in members:
+                    st = self._query_replica_state(m, app.app_id, pc.pidx)
+                    if st is not None and (st.ballot, st.last_prepared) > best_state:
+                        best, best_state = m, (st.ballot, st.last_prepared)
+                pc.primary = best or members[0]
+            pc.secondaries = [m for m in members if m != pc.primary]
+            # rebuild replica count on a fresh node
+            learners = []
+            alive = self._alive_nodes_locked()
+            candidates = [n for n in alive if n not in members]
+            if len(members) < app.replica_count and candidates:
+                new_node = min(candidates, key=self._node_load_locked)
+                learners = [new_node]
+            self._persist_locked()
+        self._install_partition(app, pc, learners=learners)
+        with self._lock:
+            for ln in learners:
+                if ln not in pc.secondaries:
+                    pc.secondaries.append(ln)
+            self._persist_locked()
+
+    def _install_partition(self, app, pc: mm.PartitionConfig, learners=()):
+        """Push the view to every member (primary first), seed learners."""
+        req = mm.OpenReplicaRequest(
+            app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+            ballot=pc.ballot, primary=pc.primary, secondaries=pc.secondaries,
+            envs_json=app.envs_json)
+        for node in [pc.primary] + pc.secondaries:
+            if node:
+                self._send_to_node(node, RPC_OPEN_REPLICA, req,
+                                   ignore_errors=True)
+        for node in learners:
+            lreq = mm.OpenReplicaRequest(
+                app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+                ballot=pc.ballot, primary=pc.primary,
+                secondaries=pc.secondaries + [node],
+                learn_from=pc.primary, envs_json=app.envs_json)
+            self._send_to_node(node, RPC_OPEN_REPLICA, lreq, ignore_errors=True)
+
+    # ------------------------------------------------------------- helpers
+
+    def _query_replica_state(self, node, app_id, pidx):
+        try:
+            body = self._send_to_node(node, RPC_REPLICA_STATE,
+                                      mm.ReplicaStateRequest(app_id, pidx))
+            return codec.decode(mm.ReplicaStateResponse, body)
+        except (RpcError, OSError):
+            return None
+
+    def _send_to_node(self, node: str, code: str, req, ignore_errors=False):
+        host, _, port = node.rpartition(":")
+        try:
+            conn = self.pool.get((host, int(port)))
+            _, body = conn.call(code, codec.encode(req), timeout=10.0)
+            return body
+        except (RpcError, OSError):
+            if ignore_errors:
+                return None
+            raise
+
+    def _alive_nodes_locked(self) -> list:
+        now = time.monotonic()
+        return sorted(a for a, last in self._nodes.items()
+                      if (now - last) < self.fd_grace)
+
+    def _node_load_locked(self, addr: str) -> int:
+        return sum(1 for parts in self._parts.values() for pc in parts
+                   if pc.primary == addr or addr in pc.secondaries)
+
+    def _pick_nodes_locked(self, count: int, seed: int) -> list:
+        alive = self._alive_nodes_locked()
+        ordered = sorted(alive, key=lambda a: (self._node_load_locked(a), a))
+        rot = ordered[seed % len(ordered):] + ordered[:seed % len(ordered)]
+        return rot[:count]
+
+    # ------------------------------------------------------------ persistence
+
+    def _persist(self):
+        with self._lock:
+            self._persist_locked()
+
+    def _persist_locked(self):
+        state = {
+            "next_app_id": self._next_app_id,
+            "apps": {n: vars(a) for n, a in self._apps.items()},
+            "parts": {str(aid): [vars(pc) for pc in parts]
+                      for aid, parts in self._parts.items()},
+            "nodes": list(self._nodes),
+        }
+        tmp = self.state_path + ".tmp"
+        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.state_path)
+
+    def _load(self):
+        if not os.path.exists(self.state_path):
+            return
+        with open(self.state_path) as f:
+            state = json.load(f)
+        self._next_app_id = state["next_app_id"]
+        self._apps = {n: mm.AppInfo(**a) for n, a in state["apps"].items()}
+        self._parts = {int(aid): [mm.PartitionConfig(**pc) for pc in parts]
+                       for aid, parts in state["parts"].items()}
+        # nodes must re-beacon after a meta restart
+        self._nodes = {}

@@ -4,22 +4,32 @@ This is the TPU seam of the whole build (SURVEY.md §2.3, BASELINE.json): the
 work RocksDB does record-at-a-time inside CompactRange — comparator sort,
 level merge, TTL/version dedup filtering (reference:
 src/server/key_ttl_compaction_filter.h:36-115, manual compact executor
-src/server/pegasus_server_impl.cpp:2814) — runs here as one batched kernel
+src/server/pegasus_server_impl.cpp:2814) — runs here as batched kernels
 over KVBlock columns:
 
-  1. lexicographic sort by (prefix lanes, suffix_rank, key_len, run_priority)
-     — full byte order of stored keys, newest run first within equal keys;
+  1. k-way merge of already-sorted runs into full byte order of stored
+     keys, newest run first within equal keys. Compaction inputs are
+     sorted (SSTs are written sorted), so both backends merge — they do
+     not re-sort: the CPU backend computes the merge permutation with
+     vectorized binary search (np.searchsorted per run pair), the TPU
+     backend with log2(n)-stage bitonic merge networks (ops.device_sort).
   2. dedup: keep only the first (= newest) version of each key;
   3. filter: drop expired-TTL records, tombstones at the bottommost level,
      and keys no longer owned by this partition after a split.
 
-Both backends implement identical semantics on the same columns, so output
-SSTs are byte-stable across cpu/tpu — the determinism requirement that lets
-learner checksums and backup digests agree (SURVEY.md §7 hard part d).
+Both backends implement identical semantics on the same total order, so
+output SSTs are byte-stable across cpu/tpu — the determinism requirement
+that lets learner checksums and backup digests agree (SURVEY.md §7 hard
+part d). tests/test_compact_ops.py asserts byte equality, and bench.py
+asserts it at bench scale.
 
-The kernel returns (perm, keep) — the record permutation and survival mask.
-Variable-length key/value bytes never touch the device: the host gathers
-arenas by perm[keep] when writing the output SST.
+The kernels return the survivor indices (into the concatenated input) in
+sorted order. Variable-length key/value bytes never touch the device: the
+host gathers arenas by those indices when writing the output SST.
+
+Uniqueness contract: within one run, keys are unique (LSM invariant — a
+memtable is a map, an SST is a deduped flush/compaction output). Across
+runs, duplicates are expected and resolved newest-run-first.
 """
 
 import functools
@@ -29,10 +39,10 @@ import numpy as np
 
 from ..base.utils import epoch_now
 from ..engine.block import KVBlock
-from .bitonic import bitonic_sort
-from .packing import DEFAULT_PREFIX_U32, compute_suffix_ranks, pack_key_prefixes
+from .packing import DEFAULT_PREFIX_U32, compute_suffix_ranks, pack_key_prefixes, pack_sbytes
 
 _U32_MAX = np.uint32(0xFFFFFFFF)
+_MIN_BUCKET = 256  # pad runs to pow2 buckets >= this to bound jit recompiles
 
 
 @dataclass
@@ -43,8 +53,10 @@ class CompactOptions:
     bottommost: bool = True        # tombstones may be dropped only at bottom
     filter: bool = True            # False = flush path (pure sort, no drops)
     default_ttl: int = 0           # table-level default_ttl app-env (seconds)
-    prefix_u32: int = DEFAULT_PREFIX_U32
+    prefix_u32: int = DEFAULT_PREFIX_U32   # max prefix window, in u32 lanes
     backend: str = "cpu"           # "cpu" | "tpu"
+    runs_sorted: bool = None       # None = detect; True skips the host check
+    user_ops: tuple = ()           # parsed engine.compaction_rules Operations
 
     def resolved_now(self) -> int:
         return epoch_now() if self.now is None else self.now
@@ -56,116 +68,244 @@ class CompactResult:
     stats: dict = field(default_factory=dict)
 
 
-def _next_bucket(n: int) -> int:
-    """Pad to power-of-two buckets >= 1024 to bound jit recompilations."""
-    b = 1024
+def _pow2ceil(n: int, floor: int = 1) -> int:
+    b = floor
     while b < n:
         b <<= 1
     return b
 
 
+@dataclass
+class PackedRuns:
+    """Host-side packed state for one compaction: per-run fixed-width sort
+    columns plus the concatenated auxiliary columns the filters need.
+    Runs are newest-first; each run is ascending by key after packing
+    (unsorted inputs are locally argsorted here, remapping gidx)."""
+
+    w: int                      # prefix lanes actually used
+    has_rank: bool
+    cols: list                  # per run: list of w uint32[n_i] prefix cols
+    rank: list                  # per run: uint32[n_i] or None
+    klen: list                  # per run: uint32[n_i]
+    gidx: list                  # per run: int32[n_i] global concat index
+    sbytes: list                # per run: S-dtype[n_i] (lazy; may hold None)
+    lens: tuple                 # per run real lengths
+    expire: np.ndarray          # concatenated, global-index order
+    deleted: np.ndarray
+    hash32: np.ndarray
+
+
+def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
+    max_klen = max(int(b.key_len.max()) for b in runs)
+    if max_klen >= 1 << 24:
+        raise ValueError("keys >= 16MiB unsupported")
+    w = max(1, min(-(-min(max_klen, 4 * opts.prefix_u32) // 4), opts.prefix_u32))
+    has_rank = max_klen > 4 * w
+    ranks_all = None
+    if has_rank:
+        concat = KVBlock.concat(runs)
+        ranks_all = compute_suffix_ranks(concat, w)
+    offsets = np.cumsum([0] + [b.n for b in runs])
+    cols, rank_l, klen_l, gidx_l, sb_l = [], [], [], [], []
+    sorted_known = bool(opts.runs_sorted)
+    for i, b in enumerate(runs):
+        pref = pack_key_prefixes(b.key_arena, b.key_off, b.key_len, w)
+        kl = b.key_len.astype(np.uint32)
+        rk = ranks_all[offsets[i] : offsets[i + 1]] if has_rank else None
+        gi = np.arange(offsets[i], offsets[i + 1], dtype=np.int32)
+        sb = None
+        if need_sbytes or not sorted_known:
+            sb = pack_sbytes([pref[:, j] for j in range(w)], kl, rk)
+            if not sorted_known and not _is_sorted(sb):
+                order = np.argsort(sb, kind="stable")
+                pref, kl, gi, sb = pref[order], kl[order], gi[order], sb[order]
+                if rk is not None:
+                    rk = rk[order]
+        cols.append([np.ascontiguousarray(pref[:, j]) for j in range(w)])
+        rank_l.append(rk)
+        klen_l.append(kl)
+        gidx_l.append(gi)
+        sb_l.append(sb)
+    return PackedRuns(
+        w=w, has_rank=has_rank, cols=cols, rank=rank_l, klen=klen_l,
+        gidx=gidx_l, sbytes=sb_l, lens=tuple(b.n for b in runs),
+        expire=np.concatenate([b.expire_ts for b in runs]),
+        deleted=np.concatenate([b.deleted for b in runs]),
+        hash32=np.concatenate([b.hash32 for b in runs]),
+    )
+
+
+def _is_sorted(sb: np.ndarray) -> bool:
+    return bool(np.all(sb[1:] >= sb[:-1])) if len(sb) > 1 else True
+
+
+def _filter_keep(keep, gidx, packed: PackedRuns, now, pidx, pmask, bottommost):
+    expire = packed.expire[gidx]
+    keep &= ~((expire > 0) & (expire <= now))
+    if pmask:
+        keep &= (packed.hash32[gidx] & np.uint32(pmask)) == np.uint32(pidx)
+    if bottommost:
+        keep &= ~packed.deleted[gidx]
+    return keep
+
+
 class CpuBackend:
-    """Vectorized numpy reference — also the honest CPU baseline for bench."""
+    """Vectorized numpy merge — the honest CPU baseline for bench. Exploits
+    run-sortedness exactly like RocksDB's heap merge does, but batched:
+    each record's merged rank = own position + count of smaller records in
+    every other run (binary search), then a scatter materializes the merge.
+    """
 
     name = "cpu"
 
-    def merge(self, cols, rank, klen, prio, expire, deleted, hash32, valid,
-              now, pidx, pmask, bottommost, do_filter):
-        big = _U32_MAX
-        key_cols = [np.where(valid, c, big) for c in cols]
-        key_cols.append(np.where(valid, rank, big))
-        key_cols.append(np.where(valid, klen, big))
-        sort_keys = key_cols + [np.where(valid, prio, big)]
-        # np.lexsort: last key is primary
-        perm = np.lexsort(tuple(reversed(sort_keys))).astype(np.int32)
-        s_key_cols = [c[perm] for c in key_cols]
-        same = np.ones(len(perm), dtype=bool)
-        for c in s_key_cols:
-            same[1:] &= c[1:] == c[:-1]
-        same[0] = False
-        keep = valid[perm] & ~same
+    def survivors(self, packed: PackedRuns, now, pidx, pmask, bottommost,
+                  do_filter) -> np.ndarray:
+        K = len(packed.lens)
+        if K == 1:
+            merged_sb, merged_gidx = packed.sbytes[0], packed.gidx[0]
+        else:
+            total = sum(packed.lens)
+            merged_sb = np.empty(total, dtype=packed.sbytes[0].dtype)
+            merged_gidx = np.empty(total, dtype=np.int32)
+            for i in range(K):
+                r = np.arange(packed.lens[i], dtype=np.int64)
+                for j in range(K):
+                    if j == i:
+                        continue
+                    # equal keys order newest-run (lowest index) first
+                    side = "right" if j < i else "left"
+                    r += np.searchsorted(packed.sbytes[j], packed.sbytes[i],
+                                         side=side)
+                merged_sb[r] = packed.sbytes[i]
+                merged_gidx[r] = packed.gidx[i]
+        same = np.zeros(len(merged_sb), dtype=bool)
+        same[1:] = merged_sb[1:] == merged_sb[:-1]
+        keep = ~same
         if do_filter:
-            s_expire = expire[perm]
-            s_deleted = deleted[perm]
-            s_hash = hash32[perm]
-            keep &= ~((s_expire > 0) & (s_expire <= now))
-            if pmask:
-                keep &= (s_hash & np.uint32(pmask)) == np.uint32(pidx)
-            if bottommost:
-                keep &= ~s_deleted
-        return perm, keep
+            keep = _filter_keep(keep, merged_gidx, packed, now, pidx, pmask,
+                                bottommost)
+        return merged_gidx[keep]
+
+
+@dataclass
+class DevicePacked:
+    """Device-resident compaction inputs. In the engine's hot path these
+    live in HBM across the LSM lifecycle — uploaded once when a run is
+    born (flush / previous compaction output), so compaction reads HBM,
+    not PCIe (SURVEY.md §5.7c 'HBM-resident key blocks')."""
+
+    run_cols: tuple   # per run: (w [+rank] prefix cols, klen, gidx) jax arrays
+    aux: tuple        # (expire, deleted, hash32) jax arrays, concat order
+    padded_lens: tuple
+    w: int
+    has_rank: bool
 
 
 class TpuBackend:
-    """JAX implementation; jit-cached per (n_padded, width). Runs on whatever
-    platform JAX is on (TPU in prod, host CPU devices in tests)."""
+    """JAX device pipeline; jit-cached per (padded run lengths, width)."""
 
     name = "tpu"
 
-    def merge(self, cols, rank, klen, prio, expire, deleted, hash32, valid,
-              now, pidx, pmask, bottommost, do_filter):
+    def prepare(self, packed: PackedRuns) -> DevicePacked:
         import jax.numpy as jnp
 
-        fn = _jitted_merge(len(cols), len(rank))
-        perm, keep = fn(
-            [jnp.asarray(c) for c in cols],
-            jnp.asarray(rank), jnp.asarray(klen), jnp.asarray(prio),
-            jnp.asarray(expire), jnp.asarray(deleted), jnp.asarray(hash32),
-            jnp.asarray(valid),
+        padded_lens = tuple(_pow2ceil(n, _MIN_BUCKET) for n in packed.lens)
+        run_cols = []
+        for i in range(len(packed.lens)):
+            arrays = list(packed.cols[i])
+            if packed.has_rank:
+                arrays.append(packed.rank[i])
+            arrays.append(packed.klen[i])
+            arrays.append(packed.gidx[i])
+            run_cols.append(tuple(
+                jnp.asarray(_pad_to(a, padded_lens[i])) for a in arrays
+            ))
+        aux = (jnp.asarray(packed.expire), jnp.asarray(packed.deleted),
+               jnp.asarray(packed.hash32))
+        return DevicePacked(tuple(run_cols), aux, padded_lens,
+                            packed.w, packed.has_rank)
+
+    def survivors(self, packed, now, pidx, pmask, bottommost,
+                  do_filter) -> np.ndarray:
+        import jax.numpy as jnp
+
+        prep = packed if isinstance(packed, DevicePacked) else self.prepare(packed)
+        fn = _compiled_pipeline(prep.padded_lens, prep.w, prep.has_rank)
+        out_idx, count = fn(
+            prep.run_cols, prep.aux,
             jnp.uint32(now), jnp.uint32(pidx), jnp.uint32(pmask),
-            jnp.asarray(bottommost), jnp.asarray(do_filter),
+            jnp.asarray(bool(bottommost)), jnp.asarray(bool(do_filter)),
         )
-        return np.asarray(perm), np.asarray(keep)
+        n_keep = int(count)
+        return np.asarray(out_idx[:n_keep])
 
 
-def merge_body(cols, rank, klen, prio, expire, deleted, hash32, valid,
-               now, pidx, pmask, bottommost, do_filter):
-    """The device merge: sort + dedup + filter on jnp arrays of one shard.
+def _pad_to(a: np.ndarray, n: int) -> np.ndarray:
+    if len(a) == n:
+        return a
+    fill = -1 if a.dtype == np.int32 else _U32_MAX
+    out = np.full(n, fill, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
 
-    Shared by the single-chip jitted kernel and the shard_map'd multi-chip
-    path (parallel.sharded_compact). Returns (perm, keep) in sorted order.
+
+@functools.lru_cache(maxsize=256)
+def _compiled_pipeline(padded_lens: tuple, w: int, has_rank: bool):
+    """Jitted merge→dedup→filter→compact pipeline for one static shape set.
+
+    Sort key per record: (w prefix lanes, [suffix rank,] klen<<8|prio).
+    Pads carry 0xFFFFFFFF keys / idx -1 and sort to the tail of every
+    merge; they are excluded by the idx >= 0 guard at the end.
     """
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n = rank.shape[0]
-    big = jnp.uint32(0xFFFFFFFF)
-    key_cols = [jnp.where(valid, c, big) for c in cols]
-    key_cols.append(jnp.where(valid, rank, big))
-    key_cols.append(jnp.where(valid, klen, big))
-    sort_ops = key_cols + [jnp.where(valid, prio, big)]
-    iota = jnp.arange(n, dtype=jnp.int32)
-    if n & (n - 1) == 0:
-        # bitonic network: O(log^2 n) HLO regardless of n — lax.sort's TPU
-        # lowering unrolls per element and takes minutes to compile at
-        # engine sizes (see ops.bitonic docstring)
-        sorted_ops, perm = bitonic_sort(sort_ops, iota)
-        s_key_cols = sorted_ops[: len(key_cols)]
-    else:
-        out = lax.sort(tuple(sort_ops) + (iota,), num_keys=len(sort_ops))
-        s_key_cols = out[: len(key_cols)]
-        perm = out[-1]
-    same_tail = functools.reduce(
-        jnp.logical_and, [c[1:] == c[:-1] for c in s_key_cols]
-    )
-    same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
-    keep = valid[perm] & ~same
-    s_expire = expire[perm]
-    s_deleted = deleted[perm]
-    s_hash = hash32[perm]
-    expired = (s_expire > 0) & (s_expire <= now)
-    stale = jnp.where(pmask > 0, (s_hash & pmask) != pidx, False)
-    tomb = s_deleted & bottommost
-    keep_f = keep & ~expired & ~stale & ~tomb
-    keep = jnp.where(do_filter, keep_f, keep)
-    return perm, keep
+    from .device_sort import merge_two_sorted
 
+    nk = w + (1 if has_rank else 0) + 1
 
-@functools.lru_cache(maxsize=64)
-def _jitted_merge(width: int, n: int):
-    import jax
+    def fn(run_cols, aux, now, pidx, pmask, bottommost, do_filter):
+        items = []
+        for i, rc in enumerate(run_cols):
+            *kcols, klen, idx = rc
+            kp = (klen << jnp.uint32(8)) | jnp.uint32(i)
+            items.append((padded_lens[i], list(kcols) + [kp, idx]))
+        pad_fill = tuple([_U32_MAX] * nk + [np.int32(-1)])
+        while len(items) > 1:
+            items.sort(key=lambda t: t[0])
+            (la, a), (lb, b) = items[0], items[1]
+            merged = merge_two_sorted(a, b, nk, pad_fill)
+            lm = _pow2ceil(la + lb)
+            if lm > la + lb:
+                merged = [c[: la + lb] for c in merged]
+            items = items[2:] + [(la + lb, merged)]
+        _, cols = items[0]
+        idx = cols[-1]
+        kp = cols[nk - 1]
+        key_eq_cols = cols[: nk - 1] + [kp >> jnp.uint32(8)]
+        same_tail = functools.reduce(
+            jnp.logical_and, [c[1:] == c[:-1] for c in key_eq_cols]
+        )
+        same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
+        valid = idx >= 0
+        keep = valid & ~same
+        safe_idx = jnp.maximum(idx, 0)
+        expire = jnp.take(aux[0], safe_idx)
+        deleted = jnp.take(aux[1], safe_idx)
+        hash32 = jnp.take(aux[2], safe_idx)
+        expired = (expire > 0) & (expire <= now)
+        stale = jnp.where(pmask > 0, (hash32 & pmask) != pidx, False)
+        tomb = deleted & bottommost
+        keep = jnp.where(do_filter, keep & ~expired & ~stale & ~tomb, keep)
+        n = idx.shape[0]
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        count = pos[-1] + 1
+        tgt = jnp.where(keep, pos, n)
+        out_idx = jnp.full((n,), -1, jnp.int32).at[tgt].set(idx, mode="drop")
+        return out_idx, count
 
-    return jax.jit(merge_body)
+    return jax.jit(fn)
 
 
 _BACKENDS = {"cpu": CpuBackend(), "tpu": TpuBackend(), "jax": TpuBackend()}
@@ -185,38 +325,32 @@ def compact_blocks(blocks, opts: CompactOptions) -> CompactResult:
     runs = [b for b in blocks if b.n]
     if not runs:
         return CompactResult(KVBlock.empty(), _stats(0, 0))
-    block = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-    prio = np.repeat(
-        np.arange(len(runs), dtype=np.uint32),
-        [b.n for b in runs],
-    )
-    n = block.n
-    n_pad = _next_bucket(n)
-    w = opts.prefix_u32
-
-    prefixes = pack_key_prefixes(block.key_arena, block.key_off, block.key_len, w)
-    rank = compute_suffix_ranks(block, w, prefixes)
-
-    def pad(a, fill=0):
-        if n_pad == n:
-            return a
-        out = np.full(n_pad, fill, dtype=a.dtype)
-        out[:n] = a
-        return out
-
-    cols = [pad(np.ascontiguousarray(prefixes[:, j])) for j in range(w)]
-    valid = pad(np.ones(n, dtype=bool), False)
-    now = opts.resolved_now()
-
+    # run priority travels in 8 bits of the packed (klen<<8 | prio) sort
+    # column; wider merges pre-combine the newest runs (no filtering — only
+    # the final merge may drop tombstones/expired) to stay within it
+    while len(runs) > 255:
+        head = compact_blocks(runs[:200], CompactOptions(
+            now=opts.now, prefix_u32=opts.prefix_u32, backend=opts.backend,
+            filter=False, runs_sorted=opts.runs_sorted))
+        runs = [head.block] + runs[200:]
     backend = get_backend(opts.backend)
-    perm, keep = backend.merge(
-        cols, pad(rank), pad(block.key_len.astype(np.uint32)), pad(prio),
-        pad(block.expire_ts), pad(block.deleted), pad(block.hash32), valid,
-        now, opts.pidx, opts.partition_mask,
+    packed = pack_runs(runs, opts, need_sbytes=backend.name == "cpu")
+    now = opts.resolved_now()
+    survivors = backend.survivors(
+        packed, now, opts.pidx, opts.partition_mask,
         bool(opts.bottommost), bool(opts.filter),
     )
-    out_idx = perm[keep]
-    out = block.gather(out_idx)
+    n = sum(packed.lens)
+    concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
+    out = concat.gather(survivors)
+    if opts.filter and opts.user_ops:
+        # user-specified compaction rules run before the TTL rewrite, like
+        # KeyWithTTLCompactionFilter runs user ops first (:36-105)
+        from ..engine.compaction_rules import apply_operations
+
+        drop, _ = apply_operations(out, opts.user_ops, now)
+        if drop.any():
+            out = out.gather(np.nonzero(~drop)[0])
     if opts.filter and opts.default_ttl > 0:
         _apply_default_ttl(out, now + opts.default_ttl)
     return CompactResult(out, _stats(n, out.n))
@@ -228,9 +362,50 @@ def sort_block(block: KVBlock, opts: CompactOptions = None) -> KVBlock:
     filter only runs at compaction)."""
     opts = opts or CompactOptions()
     flush_opts = CompactOptions(
-        now=opts.now, prefix_u32=opts.prefix_u32, backend=opts.backend, filter=False
+        now=opts.now, prefix_u32=opts.prefix_u32, backend=opts.backend,
+        filter=False, runs_sorted=False,
     )
     return compact_blocks([block], flush_opts).block
+
+
+def merge_body(cols, rank, klen, prio, expire, deleted, hash32, valid,
+               now, pidx, pmask, bottommost, do_filter):
+    """Single-array device merge: full sort + dedup + filter on jnp arrays.
+
+    Used by the shard_map'd multi-chip path (parallel.sharded_compact),
+    whose all_to_all routing scrambles run order, and by the driver's
+    single-chip compile check. Returns (perm, keep) in sorted order.
+    Input length must be a power of two (callers pad).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .device_sort import sort_network
+
+    n = rank.shape[0]
+    big = jnp.uint32(0xFFFFFFFF)
+    key_cols = [jnp.where(valid, c, big) for c in cols]
+    key_cols.append(jnp.where(valid, rank, big))
+    key_cols.append(jnp.where(valid, klen, big))
+    sort_ops = key_cols + [jnp.where(valid, prio, big)]
+    iota = lax.iota(jnp.int32, n)
+    out = sort_network(sort_ops + [iota], nk=len(sort_ops))
+    s_key_cols = out[: len(key_cols)]
+    perm = out[-1]
+    same_tail = functools.reduce(
+        jnp.logical_and, [c[1:] == c[:-1] for c in s_key_cols]
+    )
+    same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
+    keep = valid[perm] & ~same
+    s_expire = expire[perm]
+    s_deleted = deleted[perm]
+    s_hash = hash32[perm]
+    expired = (s_expire > 0) & (s_expire <= now)
+    stale = jnp.where(pmask > 0, (s_hash & pmask) != pidx, False)
+    tomb = s_deleted & bottommost
+    keep_f = keep & ~expired & ~stale & ~tomb
+    keep = jnp.where(do_filter, keep_f, keep)
+    return perm, keep
 
 
 def _apply_default_ttl(block: KVBlock, new_expire: int) -> None:

@@ -27,6 +27,23 @@ import numpy as np
 DEFAULT_PREFIX_U32 = 8  # 32-byte prefix window
 
 
+def pack_sbytes(prefix_cols, klen, rank=None):
+    """Fixed-width big-endian byte string per record: (prefix cols..,
+    [rank,] klen) -> numpy 'S' array whose memcmp order equals the device
+    sort order (prio excluded — callers order equal keys by run priority).
+
+    numpy 'S' comparison strips trailing NULs then compares
+    lexicographically, which for equal itemsize is memcmp-equivalent
+    (first differing byte decides either way; all-equal iff identical).
+    """
+    cols = list(prefix_cols) + ([rank] if rank is not None else []) + [klen]
+    n = len(klen)
+    packed = np.zeros((n, len(cols)), dtype=">u4")
+    for i, c in enumerate(cols):
+        packed[:, i] = c
+    return packed.view(f"S{4 * len(cols)}").ravel()
+
+
 def pack_key_prefixes(key_arena, key_off, key_len, width_u32: int = DEFAULT_PREFIX_U32):
     """-> uint32[n, width_u32], big-endian packed, zero-padded."""
     n = len(key_off)

@@ -23,8 +23,12 @@ import functools
 import numpy as np
 
 from ..engine.block import KVBlock
-from ..ops.compact import CompactOptions, CompactResult, _apply_default_ttl, _next_bucket, merge_body
+from ..ops.compact import CompactOptions, CompactResult, _apply_default_ttl, _pow2ceil, merge_body
 from ..ops.packing import compute_suffix_ranks, pack_key_prefixes
+
+
+def _next_bucket(n: int) -> int:
+    return _pow2ceil(n, 1024)
 
 
 def _shard_map():

@@ -1,0 +1,9 @@
+from .group import ReplicaGroup
+from .mutation_log import LogMutation, MutationLog
+from .replica import (GroupView, LEARNER, PRIMARY, PrepareRejected, Replica,
+                      ReplicaError, SECONDARY)
+
+__all__ = [
+    "ReplicaGroup", "LogMutation", "MutationLog", "GroupView", "Replica",
+    "ReplicaError", "PrepareRejected", "PRIMARY", "SECONDARY", "LEARNER",
+]

@@ -1,0 +1,101 @@
+"""Replica-group controller: the meta server's reconfiguration role,
+in-process.
+
+Drives PacificA view changes over a set of Replicas: promote the live
+replica with the highest (ballot, last_prepared) — which PacificA's quorum
+rule guarantees holds every committed mutation — rebuild dead members as
+learners, and re-install views. The kill-test harness (tests/test_kill
+pattern, reference src/test/kill_test) runs against exactly this surface;
+the standalone meta server drives the same transitions over RPC.
+"""
+
+import os
+import threading
+
+from ..engine import EngineOptions
+from .replica import GroupView, Replica, ReplicaError
+
+
+class ReplicaGroup:
+    def __init__(self, root: str, n: int = 3, app_id: int = 1, pidx: int = 0,
+                 options_factory=None, quorum: int = 2):
+        self.root = root
+        self.names = [f"r{i}" for i in range(n)]
+        self.app_id = app_id
+        self.pidx = pidx
+        self.quorum = quorum
+        self.options_factory = options_factory or (lambda: EngineOptions(backend="cpu"))
+        self._lock = threading.RLock()
+        self.alive = {}     # name -> Replica
+        self.ballot = 0
+        self.primary = None
+        for name in self.names:
+            self.alive[name] = self._open(name)
+        self.elect()
+
+    def _open(self, name: str) -> Replica:
+        return Replica(name, os.path.join(self.root, name), self.app_id,
+                       self.pidx, self.options_factory(), peers=self._peer,
+                       quorum=self.quorum)
+
+    def _peer(self, name: str):
+        r = self.alive.get(name)
+        if r is None:
+            raise ConnectionError(name)
+        return r
+
+    # ------------------------------------------------------------- control
+
+    def elect(self) -> Replica:
+        """Install a new view: best live replica becomes primary."""
+        with self._lock:
+            if not self.alive:
+                raise ReplicaError("no live replicas")
+            best = max(self.alive.values(),
+                       key=lambda r: (r.ballot, r.last_prepared))
+            self.ballot = max(self.ballot, best.ballot) + 1
+            self.primary = best.name
+            secondaries = [n for n in self.alive if n != best.name]
+            view = GroupView(self.ballot, best.name, secondaries)
+            best.assume_view(view)
+            for n in secondaries:
+                self.alive[n].assume_view(view)
+            return best
+
+    def kill(self, name: str) -> None:
+        """Hard-kill: drop the object without flushing (data loss beyond the
+        log is the point of the test)."""
+        with self._lock:
+            r = self.alive.pop(name, None)
+            if r:
+                r.plog.close()
+            if name == self.primary and self.alive:
+                self.elect()
+
+    def restart(self, name: str) -> Replica:
+        """Reopen from disk; rejoin as learner unless it wins the election
+        (e.g. after a full-group crash)."""
+        with self._lock:
+            r = self._open(name)
+            self.alive[name] = r
+            if self.primary in self.alive and self.primary != name:
+                r.learn_from(self.alive[self.primary])
+                self.alive[self.primary].view.secondaries.append(name)
+                r.assume_view(GroupView(self.ballot, self.primary,
+                                        self.alive[self.primary].view.secondaries))
+            else:
+                self.elect()
+            return r
+
+    def primary_replica(self) -> Replica:
+        return self.alive[self.primary]
+
+    def write(self, code: str, req, now=None):
+        return self.primary_replica().client_write(code, req, now=now)
+
+    def read(self, key: bytes, now=None):
+        return self.primary_replica().server.on_get(key, now=now)
+
+    def close(self):
+        for r in self.alive.values():
+            r.close()

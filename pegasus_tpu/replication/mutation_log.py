@@ -1,0 +1,172 @@
+"""Private mutation log (plog): the replica's WAL.
+
+The rDSN mutation log this build re-provides (SURVEY.md §2.4 'Mutation
+logs'; config.ini log_private_*): every prepared mutation appends here
+BEFORE it is acknowledged, and replay-on-open re-applies committed-but-
+unflushed mutations to the engine — the engine itself deliberately has no
+WAL (engine/db.py docstring), exactly like the reference runs RocksDB with
+WAL disabled because this log is the WAL.
+
+File format: segments log.{start_decree} of framed records
+    [u32 len][u32 crc32][payload]
+payload = codec-encoded LogMutation. Torn tails (crash mid-append) are
+detected by length/crc and truncated at recovery, like mutation_log's
+replay cursor. Segments roll at `segment_bytes`; GC drops whole segments
+whose decrees are all <= the durable decree.
+"""
+
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import List
+
+from ..rpc import codec
+
+_FRAME = struct.Struct("<II")
+
+
+@dataclass
+class LogMutation:
+    """One decree's mutation batch as it travels prepare->log->apply."""
+
+    decree: int = 0
+    ballot: int = 0
+    timestamp_us: int = 0
+    requests: List[tuple] = field(default_factory=list)  # unused; see codes/bodies
+
+    # codec has no Tuple support; parallel lists keep the frame simple
+    codes: List[str] = field(default_factory=list)
+    bodies: List[bytes] = field(default_factory=list)
+
+
+class MutationLog:
+    def __init__(self, log_dir: str, segment_bytes: int = 32 << 20,
+                 fsync: bool = False):
+        self.dir = log_dir
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._file = None
+        self._file_start = None
+        self._file_bytes = 0
+        self.last_decree = 0
+        os.makedirs(log_dir, exist_ok=True)
+        self._segments = self._scan_segments()
+        if self._segments:
+            self.last_decree = self._tail_decree()
+
+    # ----------------------------------------------------------------- write
+
+    def append(self, m: LogMutation) -> None:
+        payload = codec.encode(m)
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            if self._file is None or self._file_bytes >= self.segment_bytes:
+                self._roll_locked(m.decree)
+            self._file.write(frame)
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self._file_bytes += len(frame)
+            self.last_decree = max(self.last_decree, m.decree)
+
+    def _roll_locked(self, start_decree: int) -> None:
+        if self._file:
+            self._file.close()
+        name = f"log.{start_decree}"
+        path = os.path.join(self.dir, name)
+        self._file = open(path, "ab")
+        self._file_start = start_decree
+        self._file_bytes = self._file.tell()
+        if start_decree not in self._segments:
+            self._segments.append(start_decree)
+            self._segments.sort()
+
+    # ------------------------------------------------------------------ read
+
+    def replay(self, from_decree: int = 0):
+        """Yield LogMutations with decree > from_decree, in append order.
+        Stops (and truncates) at the first torn record."""
+        with self._lock:
+            segments = list(self._segments)
+            if self._file:
+                self._file.flush()
+        for i, start in enumerate(segments):
+            # skip segments that end before the replay point
+            if i + 1 < len(segments) and segments[i + 1] <= from_decree + 1:
+                continue
+            path = os.path.join(self.dir, f"log.{start}")
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + _FRAME.size <= len(data):
+                length, crc = _FRAME.unpack_from(data, off)
+                body = data[off + _FRAME.size : off + _FRAME.size + length]
+                if len(body) < length or zlib.crc32(body) != crc:
+                    self._truncate_torn(path, off)
+                    return
+                off += _FRAME.size + length
+                m = codec.decode(LogMutation, body)
+                if m.decree > from_decree:
+                    yield m
+
+    def _truncate_torn(self, path: str, valid_bytes: int) -> None:
+        with self._lock:
+            if self._file and os.path.join(self.dir, f"log.{self._file_start}") == path:
+                self._file.truncate(valid_bytes)
+            else:
+                with open(path, "r+b") as f:
+                    f.truncate(valid_bytes)
+
+    # -------------------------------------------------------------------- gc
+
+    def gc(self, durable_decree: int) -> int:
+        """Drop whole segments strictly older than the segment containing
+        durable_decree+1 (reference: log GC after checkpoint)."""
+        with self._lock:
+            dropped = 0
+            while len(self._segments) > 1 and self._segments[1] <= durable_decree + 1:
+                start = self._segments.pop(0)
+                try:
+                    os.unlink(os.path.join(self.dir, f"log.{start}"))
+                except OSError:
+                    pass
+                dropped += 1
+            return dropped
+
+    def reset(self) -> None:
+        """Wipe everything (learner re-seed from checkpoint)."""
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+            for start in self._segments:
+                try:
+                    os.unlink(os.path.join(self.dir, f"log.{start}"))
+                except OSError:
+                    pass
+            self._segments = []
+            self.last_decree = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+    # ---------------------------------------------------------------- helpers
+
+    def _scan_segments(self) -> list:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("log.") and name[4:].isdigit():
+                out.append(int(name[4:]))
+        return sorted(out)
+
+    def _tail_decree(self) -> int:
+        last = 0
+        for m in self.replay(0):
+            last = max(last, m.decree)
+        return last

@@ -1,0 +1,272 @@
+"""PacificA replica: prepare/ack/commit 2PC over the mutation log + engine.
+
+The rDSN replication core this build re-provides (SURVEY.md §2.4
+'PacificA replication'; knobs config.ini:205-215): one primary serializes
+writes per partition; each mutation gets a decree, appends to the private
+log, and is sent RPC_PREPARE to every secondary; the primary commits (=
+applies to the storage engine via on_batched_write_requests) once
+`mutation_2pc_min_replica_count` replicas (incl. itself) hold it in their
+logs. Commit points piggyback on later prepares. PacificA invariants kept:
+
+  - prepares apply in decree order; a secondary acks decree d only when its
+    log holds every decree <= d (so last_prepared is contiguous coverage);
+  - committed(d) => d is in the logs of a quorum => after any crash, the
+    live replica with the highest (ballot, last_prepared) holds every
+    committed mutation; failover promotes it and commits its whole prepare
+    list ("prepared implies eventually committed");
+  - a rejoining replica re-seeds as a learner: engine checkpoint copy +
+    log tail from the current primary (reference learn flow, SURVEY §3.5).
+
+Engine replay-on-open closes the WAL gap: committed-but-unflushed
+mutations are re-applied from the plog before serving.
+"""
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..engine import EngineOptions
+from ..engine.replica_service import WRITE_CODES
+from ..engine.server_impl import PegasusServer
+from ..rpc import codec
+from .mutation_log import LogMutation, MutationLog
+
+INACTIVE = "INACTIVE"
+PRIMARY = "PRIMARY"
+SECONDARY = "SECONDARY"
+LEARNER = "POTENTIAL_SECONDARY"
+ERROR = "ERROR"
+
+
+class ReplicaError(Exception):
+    pass
+
+
+class PrepareRejected(ReplicaError):
+    def __init__(self, reason, last_prepared=0):
+        super().__init__(reason)
+        self.reason = reason
+        self.last_prepared = last_prepared
+
+
+@dataclass
+class GroupView:
+    """What the (meta-server stand-in) controller tells members."""
+
+    ballot: int
+    primary: str
+    secondaries: list
+
+
+class Replica:
+    """One partition replica. `peers` is a callable transport:
+    peers(name) -> Replica-like proxy (direct object in-process; an RPC stub
+    across processes). Raises ConnectionError for dead nodes."""
+
+    def __init__(self, name: str, path: str, app_id: int = 1, pidx: int = 0,
+                 options: EngineOptions = None, peers=None,
+                 quorum: int = 2, fsync: bool = False):
+        self.name = name
+        self.path = path
+        self.app_id = app_id
+        self.pidx = pidx
+        self.quorum = quorum
+        self.peers = peers or (lambda n: (_ for _ in ()).throw(ConnectionError(n)))
+        self._lock = threading.RLock()
+        self.status = INACTIVE
+        self.ballot = 0
+        self.view = None
+        self.server = PegasusServer(os.path.join(path, "data"), app_id=app_id,
+                                    pidx=pidx, options=options, server=name)
+        self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
+        self._uncommitted = {}   # decree -> LogMutation (prepared, not applied)
+        self.last_committed = self.server.engine.last_committed_decree()
+        self.last_prepared = self.last_committed
+        self._recover_from_log()
+
+    # ----------------------------------------------------------- recovery
+
+    def _recover_from_log(self):
+        """Re-stage every logged mutation after the engine's committed point.
+        They stay uncommitted until a view tells us our role (a new primary
+        commits them all; a learner discards and re-seeds)."""
+        for m in self.plog.replay(0):
+            if m.decree > self.last_committed:
+                self._uncommitted[m.decree] = m
+                self.last_prepared = max(self.last_prepared, m.decree)
+            self.ballot = max(self.ballot, m.ballot)
+
+    # --------------------------------------------------------------- views
+
+    def assume_view(self, view: GroupView):
+        """Controller-installed configuration (meta server's reconfiguration)."""
+        with self._lock:
+            self.view = view
+            self.ballot = max(self.ballot, view.ballot)
+            if view.primary == self.name:
+                self.status = PRIMARY
+                # PacificA failover rule: commit the entire prepare list
+                self._apply_up_to(self.last_prepared)
+            elif self.name in view.secondaries:
+                self.status = SECONDARY
+
+    # -------------------------------------------------------------- primary
+
+    def client_write(self, code: str, req, now: int = None):
+        """The write path: 2PC from the primary (SURVEY §3.2 hot path)."""
+        with self._lock:
+            if self.status != PRIMARY:
+                raise ReplicaError(f"{self.name} is not primary")
+            decree = self.last_prepared + 1
+            m = LogMutation(decree=decree, ballot=self.ballot,
+                            timestamp_us=int(time.time() * 1e6),
+                            codes=[code], bodies=[codec.encode(req)])
+            self.plog.append(m)
+            self.last_prepared = decree
+            self._uncommitted[decree] = m
+            acks = 1
+            alive = []
+            for peer_name in self.view.secondaries:
+                if self._send_prepare(peer_name, m):
+                    acks += 1
+                    alive.append(peer_name)
+            if acks < self.quorum:
+                # cannot commit; leave prepared (a later view change decides)
+                raise ReplicaError(
+                    f"quorum lost: {acks}/{self.quorum} for decree {decree}")
+            resp = self._apply_up_to(decree, now=now)
+            return resp
+
+    def _send_prepare(self, peer_name: str, m: LogMutation) -> bool:
+        try:
+            peer = self.peers(peer_name)
+            try:
+                peer.on_prepare(self.ballot, m, self.last_committed)
+                return True
+            except PrepareRejected as rej:
+                if rej.reason == "gap":
+                    return self._catch_up_peer(peer, rej.last_prepared, m)
+                return False
+        except ConnectionError:
+            return False
+
+    def _catch_up_peer(self, peer, peer_prepared: int, m: LogMutation) -> bool:
+        """Stream the missing decrees from our log, then retry."""
+        try:
+            for lm in self.plog.replay(peer_prepared):
+                if lm.decree >= m.decree:
+                    break
+                peer.on_prepare(self.ballot, lm, self.last_committed)
+            peer.on_prepare(self.ballot, m, self.last_committed)
+            return True
+        except (PrepareRejected, ConnectionError):
+            return False
+
+    # ------------------------------------------------------------ secondary
+
+    def on_prepare(self, ballot: int, m: LogMutation, committed_decree: int):
+        with self._lock:
+            if ballot < self.ballot:
+                raise PrepareRejected("stale_ballot", self.last_prepared)
+            self.ballot = ballot
+            if m.decree <= self.last_prepared:
+                # duplicate (catch-up overlap): keep newest copy staged
+                self._uncommitted.setdefault(m.decree, m)
+            elif m.decree == self.last_prepared + 1:
+                self.plog.append(m)
+                self.last_prepared = m.decree
+                self._uncommitted[m.decree] = m
+            else:
+                raise PrepareRejected("gap", self.last_prepared)
+            self._apply_up_to(min(committed_decree, self.last_prepared))
+
+    # ---------------------------------------------------------------- apply
+
+    def _apply_up_to(self, decree: int, now: int = None):
+        """Commit staged mutations in order through the storage engine."""
+        last_resp = None
+        while self.last_committed < decree:
+            d = self.last_committed + 1
+            m = self._uncommitted.pop(d, None)
+            if m is None:
+                raise ReplicaError(f"{self.name}: commit gap at decree {d}")
+            reqs = []
+            for code, body in zip(m.codes, m.bodies):
+                req_cls, _ = WRITE_CODES[code]
+                reqs.append((code, codec.decode(req_cls, body)))
+            resps = self.server.on_batched_write_requests(
+                d, m.timestamp_us, reqs, now=now)
+            last_resp = resps[0] if resps else None
+            self.last_committed = d
+        return last_resp
+
+    # --------------------------------------------------------------- learner
+
+    def learn_from(self, primary):
+        """Re-seed from the primary: checkpoint copy + log tail
+        (reference learn flow: get_checkpoint -> storage_apply_checkpoint ->
+        replay private log, SURVEY §3.5). `primary` is anything exposing
+        fetch_learn_state() — a local Replica or an RPC peer proxy (the
+        NFS-like learn file copy of config.ini:64-73)."""
+        with self._lock:
+            self.status = LEARNER
+            self._uncommitted.clear()
+            state = primary.fetch_learn_state()
+            self.server.close()
+            ckpt_dir = os.path.join(self.path, "learn_ckpt")
+            if os.path.exists(ckpt_dir):
+                import shutil
+
+                shutil.rmtree(ckpt_dir)
+            os.makedirs(ckpt_dir)
+            for fname, blob in state["files"]:
+                with open(os.path.join(ckpt_dir, fname), "wb") as f:
+                    f.write(blob)
+            from ..engine.db import LsmEngine
+
+            engine = LsmEngine.apply_checkpoint(
+                ckpt_dir, os.path.join(self.path, "data"),
+                self.server.engine.opts)
+            self.server = PegasusServer.__new__(PegasusServer)
+            self.server.__init__(os.path.join(self.path, "data"),
+                                 app_id=self.app_id, pidx=self.pidx,
+                                 options=engine.opts, server=self.name)
+            self.plog.reset()
+            self.last_committed = self.server.engine.last_committed_decree()
+            self.last_prepared = self.last_committed
+            # pull the tail beyond the checkpoint
+            for m in state["tail"]:
+                if m.decree <= self.last_prepared:
+                    continue
+                self.plog.append(m)
+                self.last_prepared = m.decree
+                self._uncommitted[m.decree] = m
+            self._apply_up_to(min(state["last_committed"], self.last_prepared))
+            self.ballot = max(self.ballot, state["ballot"])
+            self.status = SECONDARY
+
+    def fetch_learn_state(self) -> dict:
+        """Primary side of learn: checkpoint files + log tail + watermarks."""
+        with self._lock:
+            self.server.engine.sync_checkpoint()
+            ckpt = self.server.engine.get_checkpoint_dir()
+            files = []
+            for fname in sorted(os.listdir(ckpt)):
+                p = os.path.join(ckpt, fname)
+                if os.path.isfile(p):
+                    with open(p, "rb") as f:
+                        files.append((fname, f.read()))
+            tail = list(self.plog.replay(self.server.engine.last_durable_decree()))
+            return {"files": files, "tail": tail,
+                    "last_committed": self.last_committed, "ballot": self.ballot}
+
+    # ------------------------------------------------------------- plumbing
+
+    def gc_log(self):
+        self.server.engine.flush()
+        self.plog.gc(self.server.engine.last_durable_decree())
+
+    def close(self):
+        self.plog.close()
+        self.server.close()

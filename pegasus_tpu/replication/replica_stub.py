@@ -1,0 +1,237 @@
+"""Replica node: hosts PacificA replicas, beacons to meta, serves clients.
+
+The rDSN replica_stub + pegasus_replication_service_app role (SURVEY.md
+§2.4 'Service-app container', §3.1 boot path): one process = one node
+address; the meta server opens/closes replicas here (RPC_CONFIG_PROPOSAL_*),
+client writes route through the local replica's PacificA 2PC
+(replica.client_write), prepares arrive from peer nodes over RPC, learners
+pull checkpoint+log-tail state, and a beacon thread keeps the meta lease.
+"""
+
+import json
+import os
+import threading
+import time
+
+from ..engine import EngineOptions
+from ..engine.replica_service import ReplicaService, WRITE_CODES
+from ..meta import messages as mm
+from ..meta.meta_server import (RPC_CLOSE_REPLICA, RPC_FD_BEACON,
+                                RPC_OPEN_REPLICA, RPC_REPLICA_STATE)
+from ..rpc import codec
+from ..rpc.transport import (ConnectionPool, ERR_INVALID_STATE,
+                             ERR_OBJECT_NOT_FOUND, RpcError, RpcServer)
+from .mutation_log import LogMutation
+from .replica import GroupView, PRIMARY, PrepareRejected, Replica, ReplicaError
+
+RPC_PREPARE = "RPC_PREPARE"
+RPC_LEARN = "RPC_LEARN"
+
+
+class _RemotePeer:
+    """Peer-node proxy with the Replica peer interface (on_prepare,
+    fetch_learn_state) over the RPC transport."""
+
+    def __init__(self, stub: "ReplicaStub", addr: str, app_id: int, pidx: int):
+        self.stub = stub
+        self.addr = addr
+        self.app_id = app_id
+        self.pidx = pidx
+
+    def _call(self, code, req):
+        host, _, port = self.addr.rpartition(":")
+        try:
+            conn = self.stub.pool.get((host, int(port)))
+            _, body = conn.call(code, codec.encode(req), timeout=10.0)
+            return body
+        except (RpcError, OSError) as e:
+            raise ConnectionError(str(e))
+
+    def on_prepare(self, ballot, m: LogMutation, committed_decree: int):
+        body = self._call(RPC_PREPARE, mm.PrepareRequest(
+            app_id=self.app_id, pidx=self.pidx, ballot=ballot,
+            committed_decree=committed_decree, mutation=codec.encode(m)))
+        resp = codec.decode(mm.PrepareResponse, body)
+        if resp.error:
+            raise PrepareRejected(resp.reason, resp.last_prepared)
+
+    def fetch_learn_state(self) -> dict:
+        body = self._call(RPC_LEARN, mm.LearnRequest(self.app_id, self.pidx))
+        resp = codec.decode(mm.LearnResponse, body)
+        if resp.error:
+            raise ConnectionError("learn failed")
+        return {
+            "files": [(f.name, f.data) for f in resp.files],
+            "tail": [codec.decode(LogMutation, t) for t in resp.tail],
+            "last_committed": resp.last_committed,
+            "ballot": resp.ballot,
+        }
+
+
+class ReplicaStub:
+    def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
+                 port: int = 0, options_factory=None):
+        self.root = root
+        self.meta_addrs = list(meta_addrs)
+        self.options_factory = options_factory or (lambda: EngineOptions(backend="cpu"))
+        self.pool = ConnectionPool()
+        self._lock = threading.RLock()
+        self._replicas = {}      # (app_id, pidx) -> Replica
+        self._service = ReplicaService()
+        self._service.set_write_router(self._route_write)
+        self.rpc = RpcServer(host, port)
+        self.rpc.register_serverlet(self._service)
+        self.rpc.register(RPC_OPEN_REPLICA, self._on_open_replica)
+        self.rpc.register(RPC_CLOSE_REPLICA, self._on_close_replica)
+        self.rpc.register(RPC_REPLICA_STATE, self._on_replica_state)
+        self.rpc.register(RPC_PREPARE, self._on_prepare)
+        self.rpc.register(RPC_LEARN, self._on_learn)
+        self.rpc.start()
+        self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
+        self._stop = threading.Event()
+        self._beacon_thread = threading.Thread(target=self._beacon_loop,
+                                               daemon=True)
+
+    def start(self, beacon_interval: float = 1.0) -> "ReplicaStub":
+        self._beacon_interval = beacon_interval
+        self.send_beacon()
+        self._beacon_thread.start()
+        return self
+
+    # ------------------------------------------------------------- beacons
+
+    def _beacon_loop(self):
+        while not self._stop.wait(self._beacon_interval):
+            try:
+                self.send_beacon()
+            except (RpcError, OSError):
+                pass
+
+    def send_beacon(self):
+        with self._lock:
+            alive = [f"{a}.{p}" for (a, p) in self._replicas]
+        req = mm.BeaconRequest(node=self.address, alive_replicas=alive)
+        for meta in self.meta_addrs:
+            host, _, port = meta.rpartition(":")
+            try:
+                conn = self.pool.get((host, int(port)))
+                conn.call(RPC_FD_BEACON, codec.encode(req), timeout=5.0)
+                return
+            except (RpcError, OSError):
+                continue
+
+    # ------------------------------------------------- meta-driven lifecycle
+
+    def _on_open_replica(self, header, body) -> bytes:
+        req = codec.decode(mm.OpenReplicaRequest, body)
+        key = (req.app_id, req.pidx)
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is None:
+                path = os.path.join(self.root, f"{req.app_id}.{req.pidx}")
+                rep = Replica(f"{self.address}", path, req.app_id, req.pidx,
+                              self.options_factory(),
+                              peers=self._peer_factory(req.app_id, req.pidx))
+                self._replicas[key] = rep
+                self._service.add_replica(rep.server, self._partition_count(req))
+        if req.learn_from and req.learn_from != self.address:
+            peer = _RemotePeer(self, req.learn_from, req.app_id, req.pidx)
+            rep.learn_from(peer)
+            with self._lock:
+                self._service.remove_replica(req.app_id, req.pidx)
+                self._service.add_replica(rep.server, self._partition_count(req))
+        rep.assume_view(GroupView(req.ballot, req.primary, req.secondaries))
+        envs = json.loads(req.envs_json or "{}")
+        if envs:
+            rep.server.update_app_envs(envs)
+        return codec.encode(mm.OpenReplicaResponse(
+            last_committed=rep.last_committed, last_prepared=rep.last_prepared))
+
+    @staticmethod
+    def _partition_count(req: mm.OpenReplicaRequest) -> int:
+        # partition count isn't in the open request; the hash check happens
+        # on the client-facing path where the resolver supplies pidx. Use a
+        # safe upper bound by disabling the modulo check (0 -> skip).
+        return 0
+
+    def _on_close_replica(self, header, body) -> bytes:
+        req = codec.decode(mm.CloseReplicaRequest, body)
+        with self._lock:
+            rep = self._replicas.pop((req.app_id, req.pidx), None)
+            self._service.remove_replica(req.app_id, req.pidx)
+        if rep:
+            rep.close()
+        return b""
+
+    def _on_replica_state(self, header, body) -> bytes:
+        req = codec.decode(mm.ReplicaStateRequest, body)
+        with self._lock:
+            rep = self._replicas.get((req.app_id, req.pidx))
+        if rep is None:
+            return codec.encode(mm.ReplicaStateResponse(error=1))
+        return codec.encode(mm.ReplicaStateResponse(
+            status=rep.status, ballot=rep.ballot,
+            last_committed=rep.last_committed, last_prepared=rep.last_prepared,
+            last_durable=rep.server.engine.last_durable_decree()))
+
+    # ------------------------------------------------------- replication RPC
+
+    def _peer_factory(self, app_id, pidx):
+        def peers(addr: str):
+            if addr == self.address:
+                raise ConnectionError("self")
+            return _RemotePeer(self, addr, app_id, pidx)
+
+        return peers
+
+    def _on_prepare(self, header, body) -> bytes:
+        req = codec.decode(mm.PrepareRequest, body)
+        with self._lock:
+            rep = self._replicas.get((req.app_id, req.pidx))
+        if rep is None:
+            return codec.encode(mm.PrepareResponse(error=1, reason="no_replica"))
+        m = codec.decode(LogMutation, req.mutation)
+        try:
+            rep.on_prepare(req.ballot, m, req.committed_decree)
+            return codec.encode(mm.PrepareResponse(last_prepared=rep.last_prepared))
+        except PrepareRejected as rej:
+            return codec.encode(mm.PrepareResponse(
+                error=1, reason=rej.reason, last_prepared=rej.last_prepared))
+
+    def _on_learn(self, header, body) -> bytes:
+        req = codec.decode(mm.LearnRequest, body)
+        with self._lock:
+            rep = self._replicas.get((req.app_id, req.pidx))
+        if rep is None:
+            return codec.encode(mm.LearnResponse(error=1))
+        state = rep.fetch_learn_state()
+        return codec.encode(mm.LearnResponse(
+            files=[mm.FileBlob(n, d) for n, d in state["files"]],
+            tail=[codec.encode(m) for m in state["tail"]],
+            last_committed=state["last_committed"], ballot=state["ballot"]))
+
+    # ------------------------------------------------------------ write path
+
+    def _route_write(self, server, code, req):
+        with self._lock:
+            rep = self._replicas.get((server.app_id, server.pidx))
+        if rep is None:
+            raise RpcError(ERR_OBJECT_NOT_FOUND, "replica closed")
+        if rep.status != PRIMARY:
+            raise RpcError(ERR_INVALID_STATE, f"not primary ({rep.status})")
+        try:
+            return rep.client_write(code, req)
+        except ReplicaError as e:
+            raise RpcError(ERR_INVALID_STATE, str(e))
+
+    # -------------------------------------------------------------- control
+
+    def stop(self):
+        self._stop.set()
+        self.rpc.stop()
+        with self._lock:
+            reps = list(self._replicas.values())
+            self._replicas.clear()
+        for r in reps:
+            r.close()
+        self.pool.close()

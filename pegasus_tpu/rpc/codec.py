@@ -1,0 +1,167 @@
+"""Compact binary codec for the rpc.messages dataclasses.
+
+The reference serializes rrdb structs with thrift binary protocol
+(src/idl/rrdb.thrift -> src/base/rrdb_types.cpp). This build keeps the same
+struct/field shapes (rpc.messages mirrors the .thrift declarations) but
+derives the wire format from the dataclass type annotations instead of
+generated code:
+
+    int        -> zigzag varint
+    bool       -> 1 byte
+    bytes      -> varint length + raw
+    str        -> varint length + utf-8
+    Optional[X]-> presence byte + X
+    List[X]    -> varint count + X...
+    dataclass  -> varint field count + fields in declaration order
+    IntEnum    -> as int
+
+The leading field count lets a decoder accept messages from an older
+encoder (missing trailing fields fall back to dataclass defaults), which is
+the append-only evolution rule the thrift ids gave the reference.
+"""
+
+import dataclasses
+import functools
+import typing
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_varint(out: bytearray, n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def read_varint(buf, off: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[off]
+        off += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, off
+        shift += 7
+
+
+class CodecError(Exception):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def _fields_of(cls):
+    hints = typing.get_type_hints(cls)
+    return [(f.name, hints[f.name], f) for f in dataclasses.fields(cls)]
+
+
+def _encode_value(out: bytearray, t, v) -> None:
+    origin = typing.get_origin(t)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if v is None:
+            out.append(0)
+        else:
+            out.append(1)
+            _encode_value(out, args[0], v)
+    elif origin in (list, typing.List):
+        (item_t,) = typing.get_args(t)
+        write_varint(out, len(v))
+        for item in v:
+            _encode_value(out, item_t, item)
+    elif t is bytes:
+        write_varint(out, len(v))
+        out.extend(v)
+    elif t is str:
+        raw = v.encode("utf-8")
+        write_varint(out, len(raw))
+        out.extend(raw)
+    elif t is bool:
+        out.append(1 if v else 0)
+    elif t is int or (isinstance(t, type) and issubclass(t, int)):
+        write_varint(out, _zigzag(int(v)))
+    elif dataclasses.is_dataclass(t):
+        _encode_struct(out, t, v)
+    else:
+        raise CodecError(f"unsupported type {t!r}")
+
+
+def _decode_value(buf, off: int, t):
+    origin = typing.get_origin(t)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        flag = buf[off]
+        off += 1
+        if not flag:
+            return None, off
+        return _decode_value(buf, off, args[0])
+    if origin in (list, typing.List):
+        (item_t,) = typing.get_args(t)
+        n, off = read_varint(buf, off)
+        out = []
+        for _ in range(n):
+            item, off = _decode_value(buf, off, item_t)
+            out.append(item)
+        return out, off
+    if t is bytes:
+        n, off = read_varint(buf, off)
+        return bytes(buf[off : off + n]), off + n
+    if t is str:
+        n, off = read_varint(buf, off)
+        return bytes(buf[off : off + n]).decode("utf-8"), off + n
+    if t is bool:
+        return bool(buf[off]), off + 1
+    if t is int or (isinstance(t, type) and issubclass(t, int)):
+        n, off = read_varint(buf, off)
+        v = _unzigzag(n)
+        return (t(v) if t is not int else v), off
+    if dataclasses.is_dataclass(t):
+        return _decode_struct(buf, off, t)
+    raise CodecError(f"unsupported type {t!r}")
+
+
+def _encode_struct(out: bytearray, cls, obj) -> None:
+    fields = _fields_of(cls)
+    write_varint(out, len(fields))
+    for name, t, _ in fields:
+        _encode_value(out, t, getattr(obj, name))
+
+
+def _decode_struct(buf, off: int, cls):
+    n, off = read_varint(buf, off)
+    fields = _fields_of(cls)
+    if n > len(fields):
+        raise CodecError(
+            f"{cls.__name__}: encoder sent {n} fields, decoder knows {len(fields)}")
+    kwargs = {}
+    for i in range(n):
+        name, t, _ = fields[i]
+        kwargs[name], off = _decode_value(buf, off, t)
+    obj = cls(**kwargs)
+    return obj, off
+
+
+def encode(obj) -> bytes:
+    """Serialize a rpc.messages dataclass instance."""
+    out = bytearray()
+    _encode_struct(out, type(obj), obj)
+    return bytes(out)
+
+
+def decode(cls, data) -> object:
+    """Deserialize `data` into an instance of dataclass `cls`."""
+    obj, off = _decode_struct(data, 0, cls)
+    if off != len(data):
+        raise CodecError(f"{cls.__name__}: {len(data) - off} trailing bytes")
+    return obj

@@ -58,6 +58,14 @@ class MutateOperation(enum.IntEnum):  # rrdb.thrift:61-65
 
 
 @dataclass
+class KeyRequest:
+    """Single-key request body (the reference passes a raw blob for
+    get/remove/ttl; sortkey_count passes the hash_key blob)."""
+
+    key: bytes = b""
+
+
+@dataclass
 class UpdateRequest:  # update_request
     key: bytes
     value: bytes

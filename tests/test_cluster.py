@@ -1,0 +1,171 @@
+"""Cluster onebox: meta server + 3 replica nodes + meta-resolved client.
+
+The VERDICT-r1 item 8 'Done' criterion: table DDL, beacon FD, and a client
+that survives a replica-node kill with automatic re-route — all over real
+sockets in one process (the reference's onebox, run.sh:480).
+"""
+
+import time
+
+import pytest
+
+from pegasus_tpu.client import MetaResolver, PegasusClient
+from pegasus_tpu.engine import EngineOptions
+from pegasus_tpu.meta import MetaServer
+from pegasus_tpu.meta import messages as mm
+from pegasus_tpu.meta.meta_server import (RPC_CM_CREATE_APP, RPC_CM_LIST_NODES,
+                                          RPC_CM_SET_APP_ENVS)
+from pegasus_tpu.replication.replica_stub import ReplicaStub
+from pegasus_tpu.rpc import codec
+from pegasus_tpu.rpc.transport import RpcServer
+
+
+class Cluster:
+    def __init__(self, root, n_nodes=3, fd_grace=60.0):
+        self.meta = MetaServer(str(root / "meta" / "state.json"),
+                               fd_grace_seconds=fd_grace)
+        self.meta_rpc = RpcServer().start()
+        for code, fn in self.meta.rpc_handlers().items():
+            self.meta_rpc.register(code, fn)
+        self.meta_addr = f"{self.meta_rpc.address[0]}:{self.meta_rpc.address[1]}"
+        self.nodes = {}
+        for i in range(n_nodes):
+            stub = ReplicaStub(str(root / f"node{i}"), [self.meta_addr],
+                               options_factory=lambda: EngineOptions(backend="cpu"))
+            stub.start(beacon_interval=0.2)
+            self.nodes[stub.address] = stub
+
+    def ddl(self, code, req, resp_cls):
+        from pegasus_tpu.rpc.transport import RpcConnection
+
+        host, _, port = self.meta_addr.rpartition(":")
+        conn = RpcConnection((host, int(port)))
+        try:
+            _, body = conn.call(code, codec.encode(req), timeout=10.0)
+            return codec.decode(resp_cls, body)
+        finally:
+            conn.close()
+
+    def kill_node(self, addr):
+        stub = self.nodes.pop(addr)
+        stub.stop()
+        self.meta.mark_node_dead(addr)
+
+    def stop(self):
+        for s in self.nodes.values():
+            s.stop()
+        self.meta_rpc.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def make_client(cluster, app="t1", partitions=4):
+    r = cluster.ddl(RPC_CM_CREATE_APP,
+                    mm.CreateAppRequest(app_name=app, partition_count=partitions,
+                                        replica_count=3),
+                    mm.CreateAppResponse)
+    assert r.error == 0 and r.app_id >= 1
+    resolver = MetaResolver([cluster.meta_addr], app)
+    return PegasusClient(resolver)
+
+
+def test_create_app_and_data_ops(cluster):
+    c = make_client(cluster)
+    for i in range(32):
+        c.set(b"hk%d" % i, b"sk", b"val%d" % i)
+    for i in range(32):
+        assert c.get(b"hk%d" % i, b"sk") == b"val%d" % i
+    assert c.sortkey_count(b"hk3") == 1
+    c.close()
+
+
+def test_writes_replicate_across_nodes(cluster):
+    c = make_client(cluster, app="t2")
+    for i in range(16):
+        c.set(b"k%d" % i, b"s", b"v%d" % i)
+    # every partition has 3 members with matching prepared decrees
+    resolver = c.resolver
+    cfg = cluster.meta._parts[resolver.app_id]
+    for pc in cfg:
+        assert pc.primary and len(pc.secondaries) == 2
+    c.close()
+
+
+def test_client_survives_primary_node_kill(cluster):
+    c = make_client(cluster, app="t3", partitions=4)
+    for i in range(48):
+        c.set(b"fk%d" % i, b"s", b"v%d" % i)
+    # kill a node that is primary for at least one partition
+    victim = cluster.meta._parts[c.resolver.app_id][0].primary
+    cluster.kill_node(victim)
+    # client re-resolves on routing failure and keeps working
+    for i in range(48):
+        assert c.get(b"fk%d" % i, b"s") == b"v%d" % i, f"lost fk{i}"
+    for i in range(48, 64):
+        c.set(b"fk%d" % i, b"s", b"v%d" % i)
+        assert c.get(b"fk%d" % i, b"s") == b"v%d" % i
+    # failed partitions were reconfigured with a promoted primary
+    for pc in cluster.meta._parts[c.resolver.app_id]:
+        assert pc.primary != victim
+        assert victim not in pc.secondaries
+    c.close()
+
+
+def test_dead_node_replicas_rebuilt_on_survivor(cluster):
+    c = make_client(cluster, app="t4", partitions=2)
+    for i in range(20):
+        c.set(b"rk%d" % i, b"s", b"v%d" % i)
+    victim = cluster.meta._parts[c.resolver.app_id][0].primary
+    cluster.kill_node(victim)
+    # with 3 nodes and one dead, reconfiguration keeps 2 members (no spare
+    # node); data still fully available
+    for pc in cluster.meta._parts[c.resolver.app_id]:
+        members = [pc.primary] + pc.secondaries
+        assert victim not in members and len(members) >= 2
+    for i in range(20):
+        assert c.get(b"rk%d" % i, b"s") == b"v%d" % i
+    c.close()
+
+
+def test_app_envs_propagate_to_replicas(cluster):
+    c = make_client(cluster, app="t5", partitions=2)
+    r = cluster.ddl(RPC_CM_SET_APP_ENVS,
+                    mm.SetAppEnvsRequest(app_name="t5",
+                                         envs_json='{"default_ttl": "120"}'),
+                    mm.SetAppEnvsResponse)
+    assert r.error == 0
+    # every live replica of t5 picked the env up
+    found = 0
+    for stub in cluster.nodes.values():
+        for (aid, pidx), rep in stub._replicas.items():
+            if aid == c.resolver.app_id:
+                assert rep.server.app_envs.get("default_ttl") == "120"
+                found += 1
+    assert found >= 2
+    c.close()
+
+
+def test_list_nodes_fd_view(cluster):
+    time.sleep(0.3)
+    r = cluster.ddl(RPC_CM_LIST_NODES, mm.ListNodesRequest(), mm.ListNodesResponse)
+    assert len(r.nodes) == 3
+    assert all(n.alive for n in r.nodes)
+
+
+def test_meta_state_survives_restart(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        cl = make_client(c, app="t6", partitions=2)
+        cl.set(b"h", b"s", b"v")
+        cl.close()
+        state_path = c.meta.state_path
+        m2 = MetaServer(state_path)
+        assert "t6" in m2._apps
+        assert len(m2._parts[m2._apps["t6"].app_id]) == 2
+    finally:
+        c.stop()

@@ -169,25 +169,58 @@ def test_prefix_collision_suffix_ranks():
     assert out.n == 6
 
 
-@pytest.mark.parametrize("n,ncols", [(1024, 1), (1024, 3), (4096, 11)])
-def test_bitonic_sort_matches_lexsort(n, ncols):
+@pytest.mark.parametrize("n,ncols", [(64, 1), (1024, 3), (4096, 9)])
+def test_sort_network_matches_lexsort(n, ncols):
+    import jax
     import jax.numpy as jnp
 
-    from pegasus_tpu.ops.bitonic import bitonic_sort
+    from pegasus_tpu.ops.device_sort import sort_network
 
     rng = np.random.default_rng(n + ncols)
     # small value range to force cross-column ties
     cols = [rng.integers(0, 7, size=n, dtype=np.uint32) for _ in range(ncols)]
-    got_cols, got_perm = bitonic_sort([jnp.asarray(c) for c in cols],
-                                      jnp.arange(n, dtype=jnp.int32))
+    out = jax.jit(lambda c: sort_network(c, nk=ncols))(
+        [jnp.asarray(c) for c in cols] + [jnp.arange(n, dtype=jnp.int32)]
+    )
     want = np.lexsort(tuple(reversed(cols)))
-    for c, g in zip(cols, got_cols):
+    for c, g in zip(cols, out[:ncols]):
         np.testing.assert_array_equal(np.asarray(g), c[want])
     # permutation is a valid reordering producing the sorted columns
-    perm = np.asarray(got_perm)
+    perm = np.asarray(out[-1])
     assert sorted(perm) == list(range(n))
-    for c, g in zip(cols, got_cols):
+    for c, g in zip(cols, out[:ncols]):
         np.testing.assert_array_equal(c[perm], np.asarray(g))
+
+
+@pytest.mark.parametrize("la,lb", [(100, 100), (1, 37), (500, 12), (1024, 1024)])
+def test_merge_two_sorted_runs(la, lb):
+    import jax
+    import jax.numpy as jnp
+
+    from pegasus_tpu.ops.device_sort import merge_two_sorted
+
+    rng = np.random.default_rng(la * 1000 + lb)
+    ncols = 3
+
+    def mk(n):
+        prim = np.sort(rng.integers(0, 50, size=n, dtype=np.uint32))
+        rest = [rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+                for _ in range(ncols - 1)]
+        # make rows unique & sorted via lexsort on all cols
+        order = np.lexsort(tuple(reversed([prim] + rest)))
+        return [c[order] for c in [prim] + rest]
+
+    A, B = mk(la), mk(lb)
+    pad_fill = tuple([np.uint32(0xFFFFFFFF)] * ncols + [np.int32(-1)])
+    a_ops = [jnp.asarray(c) for c in A] + [jnp.arange(la, dtype=jnp.int32)]
+    b_ops = [jnp.asarray(c) for c in B] + [jnp.arange(la, la + lb, dtype=jnp.int32)]
+    out = jax.jit(lambda a, b: merge_two_sorted(a, b, ncols, pad_fill))(a_ops, b_ops)
+    merged = [np.asarray(c)[: la + lb] for c in out]
+    want_cols = [np.concatenate([a, b]) for a, b in zip(A, B)]
+    want = np.lexsort(tuple(reversed(want_cols)))
+    for wc, g in zip(want_cols, merged[:ncols]):
+        np.testing.assert_array_equal(g, wc[want])
+    assert sorted(np.asarray(merged[-1])) == list(range(la + lb))
 
 
 def test_pack_prefix_bigendian_order():
@@ -198,3 +231,17 @@ def test_pack_prefix_bigendian_order():
     # key bytes \x00\x02ab -> 0x000261 62
     assert p[0, 0] == 0x00026162
     assert p[0, 1] == 0  # zero padding
+
+
+def test_wide_merge_over_255_runs_chunks_correctly():
+    """Run priority travels in 8 bits; >255 runs pre-combine (newest-first)
+    without filtering so the final semantics are unchanged."""
+    runs = []
+    for i in range(300):
+        runs.append(make_block([(b"shared", b"", b"run%d" % i, 0, False),
+                                (b"only%d" % i, b"", b"v", 0, False)]))
+    res = compact_blocks(runs, CompactOptions(backend="cpu", now=1))
+    assert res.block.n == 301
+    by_key = {res.block.key(i): res.block.value(i) for i in range(res.block.n)}
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    assert SCHEMAS[2].extract_user_data(by_key[generate_key(b"shared", b"")]) == b"run0"

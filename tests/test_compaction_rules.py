@@ -1,0 +1,187 @@
+"""User-specified compaction rule tests, mirroring
+src/server/test compaction_filter_rule / compaction_operation tests and the
+rule matrix of compaction_filter_rule.h:47-151 — on both backends with
+identical bytes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base import consts
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.base.value_schema import SCHEMAS
+from pegasus_tpu.engine import EngineOptions
+from pegasus_tpu.engine.compaction_rules import (apply_operations,
+                                                parse_user_specified_compaction)
+from pegasus_tpu.engine.server_impl import PegasusServer
+from pegasus_tpu.ops import CompactOptions, compact_blocks
+from tests.test_compact_ops import make_block
+
+
+def spec(*ops):
+    return json.dumps({"ops": list(ops)})
+
+
+def op(type_, params=None, rules=()):
+    return {"type": type_, "params": json.dumps(params or {}),
+            "rules": [{"type": t, "params": json.dumps(p)} for t, p in rules]}
+
+
+def test_parse_skips_invalid_entries():
+    assert parse_user_specified_compaction("not json") == []
+    assert parse_user_specified_compaction(spec(
+        op("COT_DELETE", rules=[]))) == []          # op without rules dropped
+    ops = parse_user_specified_compaction(spec(
+        op("COT_DELETE", rules=[("FRT_BOGUS", {})]),
+        op("COT_DELETE",
+           rules=[("FRT_HASHKEY_PATTERN",
+                   {"pattern": "x", "match_type": "SMT_MATCH_PREFIX"})])))
+    assert len(ops) == 1
+
+
+@pytest.mark.parametrize("match_type,pattern,hk,expect", [
+    ("SMT_MATCH_PREFIX", "user", b"user123", True),
+    ("SMT_MATCH_PREFIX", "user", b"xuser", False),
+    ("SMT_MATCH_POSTFIX", "123", b"user123", True),
+    ("SMT_MATCH_POSTFIX", "123", b"123x", False),
+    ("SMT_MATCH_ANYWHERE", "er1", b"user123", True),
+    ("SMT_MATCH_ANYWHERE", "zzz", b"user123", False),
+])
+def test_hashkey_pattern_matrix(match_type, pattern, hk, expect):
+    blk = make_block([(hk, b"s", b"v", 0, False)])
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE",
+        rules=[("FRT_HASHKEY_PATTERN",
+                {"pattern": pattern, "match_type": match_type})])))
+    drop, _ = apply_operations(blk, ops, now=100)
+    assert bool(drop[0]) is expect
+
+
+def test_sortkey_pattern_rule():
+    blk = make_block([(b"h", b"abc_keep", b"v", 0, False),
+                      (b"h", b"drop_abc", b"v", 0, False)])
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE",
+        rules=[("FRT_SORTKEY_PATTERN",
+                {"pattern": "drop", "match_type": "SMT_MATCH_PREFIX"})])))
+    drop, _ = apply_operations(blk, ops, now=100)
+    assert list(drop) == [False, True]
+
+
+def test_ttl_range_rule_matrix():
+    now = 1000
+    blk = make_block([
+        (b"h", b"nottl", b"v", 0, False),
+        (b"h", b"in", b"v", now + 50, False),     # remaining ttl 50
+        (b"h", b"out", b"v", now + 500, False),   # remaining ttl 500
+    ])
+    # 0/0 matches records with NO ttl (reference :80-83)
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE", rules=[("FRT_TTL_RANGE", {"start_ttl": 0, "stop_ttl": 0})])))
+    drop, _ = apply_operations(blk, ops, now=now)
+    assert list(drop) == [True, False, False]
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE", rules=[("FRT_TTL_RANGE", {"start_ttl": 10, "stop_ttl": 100})])))
+    drop, _ = apply_operations(blk, ops, now=now)
+    assert list(drop) == [False, True, False]
+
+
+def test_all_rules_must_match():
+    blk = make_block([(b"user1", b"tmp_x", b"v", 0, False),
+                      (b"user1", b"keep", b"v", 0, False),
+                      (b"other", b"tmp_y", b"v", 0, False)])
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE",
+        rules=[("FRT_HASHKEY_PATTERN",
+                {"pattern": "user", "match_type": "SMT_MATCH_PREFIX"}),
+               ("FRT_SORTKEY_PATTERN",
+                {"pattern": "tmp_", "match_type": "SMT_MATCH_PREFIX"})])))
+    drop, _ = apply_operations(blk, ops, now=100)
+    assert list(drop) == [True, False, False]
+
+
+def test_update_ttl_from_now_and_current_and_timestamp():
+    from pegasus_tpu.base.utils import epoch_begin
+
+    now = 1000
+    blk = make_block([(b"h", b"a", b"v", 0, False),
+                      (b"h", b"b", b"v", now + 100, False)])
+    rules = [("FRT_HASHKEY_PATTERN",
+              {"pattern": "h", "match_type": "SMT_MATCH_PREFIX"})]
+    # FROM_NOW: everyone matched gets now+value
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_UPDATE_TTL", {"type": "UTOT_FROM_NOW", "value": 77}, rules)))
+    b2 = make_block([(b"h", b"a", b"v", 0, False),
+                     (b"h", b"b", b"v", now + 100, False)])
+    _, changed = apply_operations(b2, ops, now=now)
+    assert changed and list(b2.expire_ts) == [now + 77, now + 77]
+    # value bytes rewritten too (v2 header at offset 1)
+    assert SCHEMAS[2].extract_expire_ts(b2.value(0)) == now + 77
+    # FROM_CURRENT: only records WITH a ttl move
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_UPDATE_TTL", {"type": "UTOT_FROM_CURRENT", "value": 5}, rules)))
+    b3 = make_block([(b"h", b"a", b"v", 0, False),
+                     (b"h", b"b", b"v", now + 100, False)])
+    apply_operations(b3, ops, now=now)
+    assert list(b3.expire_ts) == [0, now + 105]
+    # TIMESTAMP: absolute unix ts converted to the 2016 epoch
+    unix_ts = epoch_begin + 5000
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_UPDATE_TTL", {"type": "UTOT_TIMESTAMP", "value": unix_ts}, rules)))
+    b4 = make_block([(b"h", b"a", b"v", 0, False)])
+    apply_operations(b4, ops, now=now)
+    assert list(b4.expire_ts) == [5000]
+
+
+def test_first_matching_op_wins():
+    blk = make_block([(b"h", b"s", b"v", 0, False)])
+    rules = [("FRT_HASHKEY_PATTERN",
+              {"pattern": "h", "match_type": "SMT_MATCH_PREFIX"})]
+    ops = parse_user_specified_compaction(spec(
+        op("COT_UPDATE_TTL", {"type": "UTOT_FROM_NOW", "value": 9}, rules),
+        op("COT_DELETE", rules=rules)))
+    drop, changed = apply_operations(blk, ops, now=100)
+    assert not drop[0] and changed          # first op handled it
+    assert blk.expire_ts[0] == 109
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_rules_in_compaction_both_backends_identical(backend):
+    rng = np.random.default_rng(3)
+    recs = []
+    for i in range(120):
+        hk = (b"tmp_%d" if i % 3 == 0 else b"keep_%d") % i
+        recs.append((hk, b"s%d" % i, b"v%d" % i, 0, False))
+    runs = [make_block(recs[:60]), make_block(recs[60:])]
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_DELETE",
+        rules=[("FRT_HASHKEY_PATTERN",
+                {"pattern": "tmp_", "match_type": "SMT_MATCH_PREFIX"})])))
+    res = compact_blocks(runs, CompactOptions(
+        backend=backend, now=100, user_ops=tuple(ops)))
+    keys = [res.block.key(i) for i in range(res.block.n)]
+    assert all(b"tmp_" not in k for k in keys)
+    assert res.block.n == sum(1 for r in recs if r[0].startswith(b"keep_"))
+    if backend == "tpu":
+        cpu = compact_blocks(runs, CompactOptions(
+            backend="cpu", now=100, user_ops=tuple(ops)))
+        np.testing.assert_array_equal(cpu.block.key_arena, res.block.key_arena)
+        np.testing.assert_array_equal(cpu.block.val_arena, res.block.val_arena)
+
+
+def test_engine_env_wiring(tmp_path):
+    srv = PegasusServer(str(tmp_path / "db"), options=EngineOptions(backend="cpu"))
+    srv.update_app_envs({consts.USER_SPECIFIED_COMPACTION: spec(op(
+        "COT_DELETE",
+        rules=[("FRT_SORTKEY_PATTERN",
+                {"pattern": "junk", "match_type": "SMT_MATCH_PREFIX"})]))})
+    for i in range(10):
+        srv.engine.put(generate_key(b"h", b"junk%d" % i),
+                       SCHEMAS[2].generate_value(0, 0, b"x"))
+        srv.engine.put(generate_key(b"h", b"good%d" % i),
+                       SCHEMAS[2].generate_value(0, 0, b"x"))
+    srv.engine.manual_compact(now=100)
+    assert srv.engine.stats()["total_sst_records"] == 10
+    srv.close()

@@ -1,0 +1,212 @@
+"""Onebox RPC test: multi-partition table through real sockets.
+
+The VERDICT-r1 'minimum viable server' milestone: every data op driven
+through the codec + TCP transport + replica serverlet + client, partitions
+spread over two server processes' worth of RpcServers in one process
+(the reference's onebox pattern, run.sh:480).
+"""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.client import PegasusClient, PegasusError, StaticResolver
+from pegasus_tpu.engine import EngineOptions
+from pegasus_tpu.engine.replica_service import ReplicaService
+from pegasus_tpu.engine.server_impl import PegasusServer
+from pegasus_tpu.rpc import codec
+from pegasus_tpu.rpc import messages as msg
+from pegasus_tpu.rpc.messages import CasCheckType, Status
+from pegasus_tpu.rpc.transport import RpcServer
+
+N_PARTITIONS = 4
+APP_ID = 7
+
+
+@pytest.fixture(scope="module")
+def onebox(tmp_path_factory):
+    """Two RpcServers ("nodes"), 4 partitions split across them."""
+    root = tmp_path_factory.mktemp("onebox")
+    servers, services = [], []
+    addr_by_pidx = {}
+    for node in range(2):
+        svc = ReplicaService()
+        rpc = RpcServer().start()
+        for pidx in range(N_PARTITIONS):
+            if pidx % 2 == node:
+                ps = PegasusServer(str(root / f"p{pidx}"), app_id=APP_ID,
+                                   pidx=pidx, options=EngineOptions(backend="cpu"),
+                                   server=f"node{node}")
+                svc.add_replica(ps, N_PARTITIONS)
+                addr_by_pidx[pidx] = rpc.address
+        rpc.register_serverlet(svc)
+        servers.append(rpc)
+        services.append(svc)
+    resolver = StaticResolver(APP_ID, [addr_by_pidx[p] for p in range(N_PARTITIONS)])
+    client = PegasusClient(resolver)
+    yield client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def test_set_get_del_exist_ttl(onebox):
+    c = onebox
+    c.set(b"user1", b"k1", b"v1")
+    c.set(b"user2", b"k1", b"v2", ttl_seconds=1000)
+    assert c.get(b"user1", b"k1") == b"v1"
+    assert c.get(b"user2", b"k1") == b"v2"
+    assert c.get(b"user1", b"missing") is None
+    assert c.exist(b"user1", b"k1")
+    assert not c.exist(b"nope", b"k1")
+    assert c.ttl(b"user1", b"k1") == -1
+    ttl = c.ttl(b"user2", b"k1")
+    assert 990 < ttl <= 1000
+    assert c.ttl(b"gone", b"x") is None
+    c.delete(b"user1", b"k1")
+    assert c.get(b"user1", b"k1") is None
+
+
+def test_routing_covers_all_partitions(onebox):
+    """Write enough hash keys that every partition serves some of them."""
+    from pegasus_tpu.base import key_schema
+
+    seen = set()
+    for i in range(64):
+        hk = b"route%d" % i
+        onebox.set(hk, b"s", b"v%d" % i)
+        key = key_schema.generate_key(hk, b"s")
+        seen.add(key_schema.key_hash(key) % N_PARTITIONS)
+    assert seen == set(range(N_PARTITIONS))
+    for i in range(64):
+        assert onebox.get(b"route%d" % i, b"s") == b"v%d" % i
+
+
+def test_multi_ops(onebox):
+    c = onebox
+    c.multi_set(b"mh", {b"a": b"1", b"b": b"2", b"c": b"3"})
+    complete, kvs = c.multi_get(b"mh")
+    assert complete and kvs == {b"a": b"1", b"b": b"2", b"c": b"3"}
+    _, kvs = c.multi_get(b"mh", sort_keys=[b"a", b"c", b"zz"])
+    assert kvs == {b"a": b"1", b"c": b"3"}
+    assert c.sortkey_count(b"mh") == 3
+    assert c.multi_del(b"mh", [b"a", b"b"]) == 2
+    _, kvs = c.multi_get(b"mh")
+    assert kvs == {b"c": b"3"}
+
+
+def test_multi_get_reverse_window(onebox):
+    c = onebox
+    c.multi_set(b"rev", {b"k%02d" % i: b"v%02d" % i for i in range(10)})
+    complete, kvs = c.multi_get(b"rev", max_kv_count=3, reverse=True)
+    # reverse keeps the LAST 3 of the ascending range
+    assert not complete
+    assert set(kvs) == {b"k07", b"k08", b"k09"}
+
+
+def test_incr(onebox):
+    c = onebox
+    assert c.incr(b"cnt", b"x", 5) == 5
+    assert c.incr(b"cnt", b"x", -2) == 3
+    assert c.get(b"cnt", b"x") == b"3"
+    # non-numeric value -> INVALID_ARGUMENT surfaced as PegasusError
+    c.set(b"cnt", b"bad", b"notanumber")
+    with pytest.raises(PegasusError) as ei:
+        c.incr(b"cnt", b"bad", 1)
+    assert ei.value.status == Status.INVALID_ARGUMENT
+
+
+def test_check_and_set(onebox):
+    c = onebox
+    r = c.check_and_set(b"cas", b"ck", CasCheckType.VALUE_NOT_EXIST, b"",
+                        b"ck", b"first")
+    assert r.error == Status.OK
+    r = c.check_and_set(b"cas", b"ck", CasCheckType.VALUE_NOT_EXIST, b"",
+                        b"ck", b"second")
+    assert r.error == Status.TRY_AGAIN  # check failed
+    assert c.get(b"cas", b"ck") == b"first"
+    r = c.check_and_set(b"cas", b"ck", CasCheckType.VALUE_BYTES_EQUAL, b"first",
+                        b"other", b"written", return_check_value=True)
+    assert r.error == Status.OK
+    assert r.check_value_returned and r.check_value == b"first"
+    assert c.get(b"cas", b"other") == b"written"
+
+
+def test_check_and_mutate(onebox):
+    c = onebox
+    c.set(b"cam", b"guard", b"go")
+    r = c.check_and_mutate(b"cam", b"guard", CasCheckType.VALUE_BYTES_EQUAL,
+                           b"go", [("set", b"m1", b"v1", 0), ("del", b"guard")])
+    assert r.error == Status.OK
+    assert c.get(b"cam", b"m1") == b"v1"
+    assert c.get(b"cam", b"guard") is None
+
+
+def test_scanner_full_and_hash(onebox):
+    c = onebox
+    rows = {b"s%02d" % i: b"val%d" % i for i in range(25)}
+    c.multi_set(b"scanhk", rows)
+    got = {sk: v for hk, sk, v in c.get_scanner(b"scanhk", batch_size=7)}
+    assert got == rows
+    # full-table scan across all partitions finds every row written above
+    total = {}
+    for sc in c.get_unordered_scanners():
+        for hk, sk, v in sc:
+            total.setdefault(hk, {})[sk] = v
+    assert total[b"scanhk"] == rows
+    assert b"cas" in total
+
+
+def test_scan_session_keeps_one_context_id(onebox):
+    """VERDICT r1 weak #7: one context id per scan session."""
+    c = onebox
+    c.multi_set(b"ctxhk", {b"s%02d" % i: b"v" for i in range(30)})
+    from pegasus_tpu.base import key_schema
+    from pegasus_tpu.engine import replica_service as codes
+
+    start = key_schema.generate_key(b"ctxhk", b"")
+    stop = key_schema.generate_next_bytes(b"ctxhk")
+    pidx, h = c._route(start)
+    req = msg.GetScannerRequest(start_key=start, stop_key=stop, batch_size=5,
+                                validate_partition_hash=False)
+    r1 = c._call(codes.RPC_GET_SCANNER, pidx, h, req, msg.ScanResponse)
+    assert r1.error == Status.OK and len(r1.kvs) == 5
+    cid = r1.context_id
+    assert cid >= 0
+    r2 = c._call(codes.RPC_SCAN, pidx, h, msg.ScanRequest(cid), msg.ScanResponse)
+    assert r2.error == Status.OK
+    assert r2.context_id == cid  # same session id across batches
+    c._call(codes.RPC_CLEAR_SCANNER, pidx, h, msg.ScanRequest(cid), None)
+    r3 = c._call(codes.RPC_SCAN, pidx, h, msg.ScanRequest(cid), msg.ScanResponse)
+    assert r3.error == Status.NOT_FOUND
+
+
+def test_wrong_partition_rejected(onebox):
+    """Partition-hash sanity check (pegasus_server_write.cpp)."""
+    from pegasus_tpu.base import key_schema
+    from pegasus_tpu.engine import replica_service as codes
+
+    c = onebox
+    key = key_schema.generate_key(b"misroute", b"s")
+    h = key_schema.key_hash(key)
+    wrong = (h % N_PARTITIONS + 1) % N_PARTITIONS
+    with pytest.raises(PegasusError):
+        c._call(codes.RPC_GET, wrong, h, msg.KeyRequest(key), msg.ReadResponse)
+
+
+def test_codec_roundtrip_all_messages():
+    rng = np.random.default_rng(0)
+    samples = [
+        msg.UpdateRequest(b"k", b"v", 77),
+        msg.MultiGetRequest(b"h", [b"a", b"b"], 10, 20, True, b"s", b"t",
+                            False, True, msg.FilterType.MATCH_PREFIX, b"p", True),
+        msg.MultiGetResponse(0, [msg.KeyValue(b"k", b"v", 5),
+                                 msg.KeyValue(b"x", b"", None)], 1, 2, "srv"),
+        msg.CheckAndMutateRequest(b"h", b"cs", 3, b"op",
+                                  [msg.Mutate(1, b"sk", b"v", 9)], True),
+        msg.ScanResponse(0, [], -1, 3, 1, "s"),
+        msg.IncrRequest(b"k", -(1 << 40), -1),
+    ]
+    for obj in samples:
+        enc = codec.encode(obj)
+        dec = codec.decode(type(obj), enc)
+        assert dec == obj, obj

@@ -1,0 +1,320 @@
+"""Server-layer unit tests: write service matrices + read handler semantics.
+
+The fake-replica pattern (SURVEY.md §4.1): PegasusServer runs in-process
+over a temp-dir engine, mutations fabricated as committed batches. Ports the
+reference coverage of src/test/function_test/test_basic.cpp (CAS matrices),
+pegasus_write_service_impl.h:179-258 (incr), :570-663 (cas check types).
+"""
+
+import pytest
+
+from pegasus_tpu.base import consts, key_schema
+from pegasus_tpu.base.value_schema import SCHEMAS
+from pegasus_tpu.engine import EngineOptions
+from pegasus_tpu.engine.server_impl import (PegasusServer, RPC_CHECK_AND_MUTATE,
+                                            RPC_CHECK_AND_SET, RPC_INCR,
+                                            RPC_MULTI_PUT, RPC_MULTI_REMOVE,
+                                            RPC_PUT, RPC_REMOVE)
+from pegasus_tpu.rpc import messages as msg
+from pegasus_tpu.rpc.messages import CasCheckType, FilterType, Status
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = PegasusServer(str(tmp_path / "db"), app_id=1, pidx=0,
+                      options=EngineOptions(backend="cpu"))
+    yield s
+    s.close()
+
+
+def write(srv, code, req, now=None):
+    d = srv.engine.last_committed_decree() + 1
+    return srv.on_batched_write_requests(d, 1000, [(code, req)], now=now)[0]
+
+
+def put(srv, hk, sk, value, expire=0):
+    key = key_schema.generate_key(hk, sk)
+    return write(srv, RPC_PUT, msg.UpdateRequest(key, value, expire))
+
+
+def get(srv, hk, sk, now=None):
+    r = srv.on_get(key_schema.generate_key(hk, sk), now=now)
+    return None if r.error == Status.NOT_FOUND else r.value
+
+
+# ------------------------------------------------------------------ batching
+
+def test_batched_puts_and_removes_one_decree(srv):
+    d = srv.engine.last_committed_decree() + 1
+    reqs = [
+        (RPC_PUT, msg.UpdateRequest(key_schema.generate_key(b"h", b"a"), b"1", 0)),
+        (RPC_PUT, msg.UpdateRequest(key_schema.generate_key(b"h", b"b"), b"2", 0)),
+        (RPC_REMOVE, msg.KeyRequest(key_schema.generate_key(b"h", b"a"))),
+    ]
+    resps = srv.on_batched_write_requests(d, 1000, reqs)
+    assert len(resps) == 3 and all(r.error == Status.OK for r in resps)
+    assert srv.engine.last_committed_decree() == d
+    assert get(srv, b"h", b"a") is None
+    assert get(srv, b"h", b"b") == b"2"
+
+
+def test_empty_batch_advances_decree(srv):
+    d = srv.engine.last_committed_decree() + 1
+    assert srv.on_batched_write_requests(d, 0, []) == []
+    assert srv.engine.last_committed_decree() == d
+
+
+# -------------------------------------------------------------------- incr
+
+def test_incr_semantics(srv):
+    key = key_schema.generate_key(b"i", b"k")
+
+    def incr(by, expire=0):
+        return write(srv, RPC_INCR, msg.IncrRequest(key, by, expire))
+
+    r = incr(10)
+    assert (r.error, r.new_value) == (Status.OK, 10)
+    r = incr(-4)
+    assert r.new_value == 6
+    # incr by 0 reads without writing
+    r = incr(0)
+    assert (r.error, r.new_value) == (Status.OK, 6)
+    # non-numeric existing value
+    put(srv, b"i", b"bad", b"xyz")
+    r = write(srv, RPC_INCR, msg.IncrRequest(key_schema.generate_key(b"i", b"bad"), 1))
+    assert r.error == Status.INVALID_ARGUMENT
+    # overflow detection (reference :137-143)
+    put(srv, b"i", b"max", str(2**63 - 1).encode())
+    r = write(srv, RPC_INCR,
+              msg.IncrRequest(key_schema.generate_key(b"i", b"max"), 1))
+    assert r.error == Status.INVALID_ARGUMENT
+    assert get(srv, b"i", b"max") == str(2**63 - 1).encode()
+
+
+def test_incr_ttl_interaction(srv):
+    key = key_schema.generate_key(b"i", b"ttl")
+    now = 1000
+    # create with ttl via expire>0
+    r = write(srv, RPC_INCR, msg.IncrRequest(key, 1, now + 50), now=now)
+    assert r.error == Status.OK
+    assert srv.on_ttl(key, now=now).ttl_seconds == 50
+    # expire=0 keeps existing ttl
+    write(srv, RPC_INCR, msg.IncrRequest(key, 1, 0), now=now)
+    assert srv.on_ttl(key, now=now).ttl_seconds == 50
+    # expire<0 clears ttl
+    write(srv, RPC_INCR, msg.IncrRequest(key, 1, -1), now=now)
+    assert srv.on_ttl(key, now=now).ttl_seconds == -1
+
+
+# ------------------------------------------------------------- CAS matrix
+
+CAS_CASES = [
+    # (check_type, existing value or None, operand, expect_pass)
+    (CasCheckType.NO_CHECK, None, b"", True),
+    (CasCheckType.VALUE_NOT_EXIST, None, b"", True),
+    (CasCheckType.VALUE_NOT_EXIST, b"v", b"", False),
+    (CasCheckType.VALUE_NOT_EXIST_OR_EMPTY, b"", b"", True),
+    (CasCheckType.VALUE_NOT_EXIST_OR_EMPTY, b"v", b"", False),
+    (CasCheckType.VALUE_EXIST, None, b"", False),
+    (CasCheckType.VALUE_EXIST, b"", b"", True),
+    (CasCheckType.VALUE_NOT_EMPTY, b"", b"", False),
+    (CasCheckType.VALUE_NOT_EMPTY, b"v", b"", True),
+    (CasCheckType.VALUE_MATCH_ANYWHERE, b"hello", b"ell", True),
+    (CasCheckType.VALUE_MATCH_ANYWHERE, b"hello", b"xyz", False),
+    (CasCheckType.VALUE_MATCH_PREFIX, b"hello", b"he", True),
+    (CasCheckType.VALUE_MATCH_PREFIX, b"hello", b"lo", False),
+    (CasCheckType.VALUE_MATCH_POSTFIX, b"hello", b"lo", True),
+    (CasCheckType.VALUE_MATCH_POSTFIX, b"hello", b"he", False),
+    (CasCheckType.VALUE_BYTES_LESS, b"abc", b"abd", True),
+    (CasCheckType.VALUE_BYTES_LESS, b"abc", b"abc", False),
+    (CasCheckType.VALUE_BYTES_LESS_OR_EQUAL, b"abc", b"abc", True),
+    (CasCheckType.VALUE_BYTES_EQUAL, b"abc", b"abc", True),
+    (CasCheckType.VALUE_BYTES_EQUAL, b"abc", b"abd", False),
+    (CasCheckType.VALUE_BYTES_GREATER_OR_EQUAL, b"abd", b"abc", True),
+    (CasCheckType.VALUE_BYTES_GREATER, b"abd", b"abc", True),
+    (CasCheckType.VALUE_BYTES_GREATER, b"abc", b"abc", False),
+    (CasCheckType.VALUE_INT_LESS, b"5", b"10", True),
+    (CasCheckType.VALUE_INT_LESS, b"10", b"5", False),
+    (CasCheckType.VALUE_INT_LESS_OR_EQUAL, b"10", b"10", True),
+    (CasCheckType.VALUE_INT_EQUAL, b"-3", b"-3", True),
+    (CasCheckType.VALUE_INT_GREATER_OR_EQUAL, b"10", b"10", True),
+    (CasCheckType.VALUE_INT_GREATER, b"11", b"10", True),
+    (CasCheckType.VALUE_INT_GREATER, b"10", b"10", False),
+]
+
+
+@pytest.mark.parametrize("ct,existing,operand,expect", CAS_CASES)
+def test_check_and_set_matrix(srv, ct, existing, operand, expect):
+    hk = b"cas%d" % int(ct)
+    if existing is not None:
+        put(srv, hk, b"ck", existing)
+    r = write(srv, RPC_CHECK_AND_SET, msg.CheckAndSetRequest(
+        hash_key=hk, check_sort_key=b"ck", check_type=ct,
+        check_operand=operand, set_diff_sort_key=True, set_sort_key=b"out",
+        set_value=b"WROTE"))
+    if expect:
+        assert r.error == Status.OK
+        assert get(srv, hk, b"out") == b"WROTE"
+    else:
+        assert r.error == Status.TRY_AGAIN
+        assert get(srv, hk, b"out") is None
+
+
+def test_check_and_set_int_invalid_argument(srv):
+    put(srv, b"casx", b"ck", b"notint")
+    r = write(srv, RPC_CHECK_AND_SET, msg.CheckAndSetRequest(
+        hash_key=b"casx", check_sort_key=b"ck",
+        check_type=CasCheckType.VALUE_INT_EQUAL, check_operand=b"5",
+        set_diff_sort_key=True, set_sort_key=b"out", set_value=b"x"))
+    assert r.error == Status.INVALID_ARGUMENT
+
+
+def test_check_and_set_same_sortkey_reads_old_value(srv):
+    put(srv, b"cassame", b"k", b"old")
+    r = write(srv, RPC_CHECK_AND_SET, msg.CheckAndSetRequest(
+        hash_key=b"cassame", check_sort_key=b"k",
+        check_type=CasCheckType.VALUE_BYTES_EQUAL, check_operand=b"old",
+        set_diff_sort_key=False, set_sort_key=b"k", set_value=b"new",
+        return_check_value=True))
+    assert r.error == Status.OK
+    assert r.check_value == b"old"
+    assert get(srv, b"cassame", b"k") == b"new"
+
+
+def test_check_and_mutate_multi_ops(srv):
+    put(srv, b"cam", b"g", b"42")
+    r = write(srv, RPC_CHECK_AND_MUTATE, msg.CheckAndMutateRequest(
+        hash_key=b"cam", check_sort_key=b"g",
+        check_type=CasCheckType.VALUE_INT_GREATER_OR_EQUAL, check_operand=b"40",
+        mutate_list=[msg.Mutate(msg.MutateOperation.PUT, b"a", b"1", 0),
+                     msg.Mutate(msg.MutateOperation.PUT, b"b", b"2", 0),
+                     msg.Mutate(msg.MutateOperation.DELETE, b"g")]))
+    assert r.error == Status.OK
+    assert get(srv, b"cam", b"a") == b"1"
+    assert get(srv, b"cam", b"b") == b"2"
+    assert get(srv, b"cam", b"g") is None
+
+
+def test_check_and_mutate_failed_check_mutates_nothing(srv):
+    put(srv, b"cam2", b"g", b"1")
+    r = write(srv, RPC_CHECK_AND_MUTATE, msg.CheckAndMutateRequest(
+        hash_key=b"cam2", check_sort_key=b"g",
+        check_type=CasCheckType.VALUE_INT_GREATER, check_operand=b"5",
+        mutate_list=[msg.Mutate(msg.MutateOperation.PUT, b"a", b"1", 0)]))
+    assert r.error == Status.TRY_AGAIN
+    assert get(srv, b"cam2", b"a") is None
+
+
+def test_check_and_mutate_empty_mutations_invalid(srv):
+    r = write(srv, RPC_CHECK_AND_MUTATE, msg.CheckAndMutateRequest(
+        hash_key=b"cam3", check_sort_key=b"g", check_type=CasCheckType.NO_CHECK,
+        check_operand=b"", mutate_list=[]))
+    assert r.error == Status.INVALID_ARGUMENT
+
+
+# ---------------------------------------------------------------- multi_get
+
+def fill_range(srv, hk, n=10):
+    for i in range(n):
+        put(srv, hk, b"s%02d" % i, b"v%02d" % i)
+
+
+def test_multi_get_range_inclusivity(srv):
+    fill_range(srv, b"mg")
+    req = msg.MultiGetRequest(b"mg", start_sortkey=b"s02", stop_sortkey=b"s05",
+                              start_inclusive=True, stop_inclusive=True)
+    r = srv.on_multi_get(req)
+    assert [kv.key for kv in r.kvs] == [b"s02", b"s03", b"s04", b"s05"]
+    req = msg.MultiGetRequest(b"mg", start_sortkey=b"s02", stop_sortkey=b"s05",
+                              start_inclusive=False, stop_inclusive=False)
+    r = srv.on_multi_get(req)
+    assert [kv.key for kv in r.kvs] == [b"s03", b"s04"]
+
+
+def test_multi_get_sortkey_filter(srv):
+    put(srv, b"mgf", b"aa1", b"x")
+    put(srv, b"mgf", b"ab2", b"y")
+    put(srv, b"mgf", b"bb3", b"z")
+    req = msg.MultiGetRequest(b"mgf",
+                              sort_key_filter_type=FilterType.MATCH_PREFIX,
+                              sort_key_filter_pattern=b"a")
+    r = srv.on_multi_get(req)
+    assert {kv.key for kv in r.kvs} == {b"aa1", b"ab2"}
+    req = msg.MultiGetRequest(b"mgf",
+                              sort_key_filter_type=FilterType.MATCH_POSTFIX,
+                              sort_key_filter_pattern=b"3")
+    r = srv.on_multi_get(req)
+    assert {kv.key for kv in r.kvs} == {b"bb3"}
+
+
+def test_multi_get_forward_limit_keeps_first(srv):
+    fill_range(srv, b"mgl")
+    r = srv.on_multi_get(msg.MultiGetRequest(b"mgl", max_kv_count=4))
+    assert r.error == Status.INCOMPLETE
+    assert [kv.key for kv in r.kvs] == [b"s00", b"s01", b"s02", b"s03"]
+
+
+def test_multi_get_reverse_limit_keeps_last_descending(srv):
+    fill_range(srv, b"mgr")
+    r = srv.on_multi_get(msg.MultiGetRequest(b"mgr", max_kv_count=4, reverse=True))
+    assert r.error == Status.INCOMPLETE
+    assert [kv.key for kv in r.kvs] == [b"s09", b"s08", b"s07", b"s06"]
+    # complete reverse returns everything, descending
+    r = srv.on_multi_get(msg.MultiGetRequest(b"mgr", reverse=True))
+    assert r.error == Status.OK
+    assert [kv.key for kv in r.kvs] == [b"s%02d" % i for i in range(9, -1, -1)]
+
+
+def test_multi_get_reverse_with_limiter_returns_tail(srv):
+    """code-review r2: the limiter budget must be spent from the range's
+    END for reverse reads (the reference iterates Prev() from the stop)."""
+    fill_range(srv, b"mgt", 50)
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "10"})
+    r = srv.on_multi_get(msg.MultiGetRequest(b"mgt", max_kv_count=5, reverse=True))
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "1000"})
+    assert r.error == Status.INCOMPLETE
+    # the LAST sort keys, descending — not the head of the range
+    assert [kv.key for kv in r.kvs] == [b"s49", b"s48", b"s47", b"s46", b"s45"]
+
+
+def test_engine_reverse_scan_matches_forward(srv):
+    fill_range(srv, b"revscan", 12)
+    srv.engine.flush()
+    fwd = [k for k, _, _ in srv.engine.scan(b"", None, now=1)]
+    rev = [k for k, _, _ in srv.engine.scan(b"", None, now=1, reverse=True)]
+    assert rev == list(reversed(fwd)) and len(fwd) >= 12
+
+
+def test_multi_get_no_value(srv):
+    fill_range(srv, b"mgnv", 3)
+    r = srv.on_multi_get(msg.MultiGetRequest(b"mgnv", no_value=True))
+    assert all(kv.value == b"" for kv in r.kvs) and len(r.kvs) == 3
+
+
+def test_range_read_limiter_caps_iteration(srv):
+    fill_range(srv, b"lim", 50)
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "10"})
+    r = srv.on_multi_get(msg.MultiGetRequest(b"lim"))
+    assert r.error == Status.INCOMPLETE
+    assert len(r.kvs) < 50
+    c = srv.on_sortkey_count(b"lim")
+    assert c.error == Status.INCOMPLETE
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "1000"})
+
+
+def test_get_scanner_hashkey_prefix_narrowing(srv):
+    put(srv, b"pfx_a", b"s", b"1")
+    put(srv, b"pfx_b", b"s", b"2")
+    put(srv, b"other", b"s", b"3")
+    req = msg.GetScannerRequest(hash_key_filter_type=FilterType.MATCH_PREFIX,
+                                hash_key_filter_pattern=b"pfx_",
+                                validate_partition_hash=False)
+    r = srv.on_get_scanner(req)
+    keys = {key_schema.restore_key(kv.key)[0] for kv in r.kvs}
+    assert keys == {b"pfx_a", b"pfx_b"}
+
+
+def test_ttl_expired_read_returns_not_found(srv):
+    put(srv, b"exp", b"s", b"v", expire=100)
+    assert get(srv, b"exp", b"s", now=99) == b"v"
+    assert get(srv, b"exp", b"s", now=101) is None
