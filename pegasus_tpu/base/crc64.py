@@ -47,10 +47,20 @@ def crc64_batch(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray) -> 
     """Hash many byte strings packed in one uint8 arena.
 
     arena: uint8[total]; offsets/lengths: int64[n]. Returns uint64[n].
-    Vectorized across records byte-position-at-a-time: iteration count is
-    max(lengths), each step processes every record still live. Hash keys are
-    short (tens of bytes), so this beats a per-record Python loop by ~100x.
+    Uses the native slice-by-8 kernel (pegasus_tpu.native) when the
+    toolchain is available, else the vectorized numpy path below.
     """
+    from .. import native
+
+    if native.available():
+        return native.crc64_batch(arena, offsets, lengths)
+    return crc64_batch_numpy(arena, offsets, lengths)
+
+
+def crc64_batch_numpy(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Numpy fallback: vectorized across records byte-position-at-a-time;
+    iteration count is max(lengths), each step processes every record still
+    live. Hash keys are short, so this beats a Python loop by ~100x."""
     n = len(offsets)
     crc = np.full(n, _MASK, dtype=np.uint64)
     if n == 0:

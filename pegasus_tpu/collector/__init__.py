@@ -1,0 +1,6 @@
+from .available_detector import AvailableDetector
+from .info_collector import InfoCollector, hotspot_partitions
+from .reporter import CounterReporter, falcon_payload, prometheus_text
+
+__all__ = ["AvailableDetector", "InfoCollector", "hotspot_partitions",
+           "CounterReporter", "falcon_payload", "prometheus_text"]

@@ -1,0 +1,123 @@
+"""Info collector: cluster-wide stat scraping + hotspot analysis.
+
+The standalone "collector" service app (SURVEY.md §2.2 'Info collector
+app'; reference src/server/info_collector.{h,cpp} +
+hotspot_partition_calculator.h:37-70): on a timer it lists apps from meta,
+scrapes every replica node's perf counters via the `perf-counters` remote
+command, aggregates per-app row stats, republishes them as
+`collector.app.<name>.*` counters, and runs the sigma-based hotspot
+analysis over per-partition QPS — partitions more than 3 standard
+deviations above the mean are flagged (and can be fed to detect_hotkey).
+"""
+
+import json
+import threading
+
+from ..meta import messages as mm
+from ..meta.meta_server import RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG
+from ..rpc import codec
+from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime.perf_counters import counters
+from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
+
+
+class InfoCollector:
+    def __init__(self, meta_addrs, interval_seconds: float = 10.0):
+        self.meta_addrs = list(meta_addrs)
+        self.interval = interval_seconds
+        self.pool = ConnectionPool()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self.hotspots = {}   # app_name -> [pidx...] flagged last round
+        self.app_stats = {}  # app_name -> aggregated dict
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.pool.close()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.collect_once()
+            except (RpcError, OSError):
+                continue
+
+    # ------------------------------------------------------------- scrape
+
+    def _call(self, addr: str, code: str, req):
+        host, _, port = addr.rpartition(":")
+        conn = self.pool.get((host, int(port)))
+        _, body = conn.call(code, codec.encode(req), timeout=5.0)
+        return body
+
+    def _meta_call(self, code, req, resp_cls):
+        last = None
+        for m in self.meta_addrs:
+            try:
+                return codec.decode(resp_cls, self._call(m, code, req))
+            except (RpcError, OSError) as e:
+                last = e
+        raise last
+
+    def scrape_node(self, addr: str, prefix: str = "") -> dict:
+        req = RemoteCommandRequest("perf-counters-by-prefix", [prefix])
+        body = self._call(addr, "RPC_CLI_CLI_CALL", req)
+        out = codec.decode(RemoteCommandResponse, body)
+        return json.loads(out.output)
+
+    def collect_once(self) -> dict:
+        apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
+                               mm.ListAppsResponse).apps
+        summary = {}
+        for app in apps:
+            cfg = self._meta_call(RPC_CM_QUERY_CONFIG,
+                                  mm.QueryConfigRequest(app.app_name),
+                                  mm.QueryConfigResponse)
+            per_partition_qps = {}
+            agg = {"get_qps": 0.0, "put_qps": 0.0, "multi_get_qps": 0.0,
+                   "scan_qps": 0.0, "recent_read_cu": 0.0,
+                   "recent_write_cu": 0.0}
+            nodes = {pc.primary for pc in cfg.partitions if pc.primary}
+            for node in nodes:
+                try:
+                    snap = self.scrape_node(node, prefix=f"app.{app.app_id}.")
+                except (RpcError, OSError, ValueError):
+                    continue
+                for name, v in snap.items():
+                    # app.<id>.<pidx>.<counter>
+                    parts = name.split(".")
+                    if len(parts) < 4:
+                        continue
+                    pidx, cname = int(parts[2]), ".".join(parts[3:])
+                    if cname in agg:
+                        agg[cname] += v
+                    if cname in ("get_qps", "put_qps", "multi_get_qps"):
+                        per_partition_qps[pidx] = per_partition_qps.get(pidx, 0.0) + v
+            for cname, v in agg.items():
+                counters.number(f"collector.app.{app.app_name}.{cname}").set(v)
+            self.hotspots[app.app_name] = hotspot_partitions(per_partition_qps)
+            summary[app.app_name] = agg
+        self.app_stats = summary
+        return summary
+
+
+def hotspot_partitions(per_partition_qps: dict, sigmas: float = 3.0) -> list:
+    """Sigma analysis of per-partition load (reference
+    hotspot_partition_calculator::stat_histories_analyse). Each candidate is
+    tested against mean + sigmas*stddev of the OTHER partitions so a single
+    extreme outlier cannot inflate the threshold that hides it."""
+    if len(per_partition_qps) < 3:
+        return []
+    out = []
+    for p, v in per_partition_qps.items():
+        rest = [x for q, x in per_partition_qps.items() if q != p]
+        mean = sum(rest) / len(rest)
+        var = sum((x - mean) ** 2 for x in rest) / len(rest)
+        stddev = var ** 0.5
+        if v > mean + sigmas * stddev and v > mean:
+            out.append(p)
+    return sorted(out)

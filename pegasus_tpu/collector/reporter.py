@@ -1,0 +1,76 @@
+"""Counter reporter: Prometheus text exposition of the perf-counter registry.
+
+Mirror of src/reporter/pegasus_counter_reporter.{h,cpp}: the reference
+pushes counters to Falcon (HTTP JSON) or exposes/pushes Prometheus; here a
+lightweight HTTP exposer serves `/metrics` in Prometheus text format and
+`/counters` as JSON from the process-wide registry, plus a push helper
+producing the Falcon-style JSON payload for an external pusher.
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..runtime.perf_counters import counters
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_text(snapshot: dict = None) -> str:
+    snap = counters.snapshot() if snapshot is None else snapshot
+    lines = []
+    for name, value in sorted(snap.items()):
+        metric = _NAME_RE.sub("_", name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def falcon_payload(endpoint: str, snapshot: dict = None) -> str:
+    """Falcon push body (list of metric dicts), reference
+    pegasus_counter_reporter.cpp falcon_gauge JSON shape."""
+    snap = counters.snapshot() if snapshot is None else snapshot
+    out = [{"endpoint": endpoint, "metric": name, "value": float(v),
+            "step": 60, "counterType": "GAUGE", "tags": ""}
+           for name, v in sorted(snap.items())]
+    return json.dumps(out)
+
+
+class CounterReporter:
+    """HTTP exposer on (host, port); port 0 picks an ephemeral port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/metrics"):
+                    body = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/counters"):
+                    body = json.dumps(counters.snapshot(), indent=1).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

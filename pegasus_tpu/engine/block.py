@@ -38,7 +38,12 @@ def _as_arena(chunks) -> tuple:
 
 def _gather_arena(arena, offsets, lengths, idx):
     """Vectorized gather of variable-length slices: new compact arena for idx."""
+    from .. import native
+
     n = len(lengths)
+    if n and len(idx) and native.available():
+        out, new_off = native.gather_arena(arena, offsets, lengths, idx)
+        return out, new_off, lengths[idx]
     if n and len(idx):
         # uniform-length fast path (common: fixed-size records): 2D reshape
         # gather is a straight memcpy per row instead of repeat/cumsum work

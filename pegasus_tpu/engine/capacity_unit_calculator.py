@@ -1,0 +1,34 @@
+"""Capacity-unit accounting: size-normalized read/write units per op.
+
+Mirror of src/server/capacity_unit_calculator.{h,cpp}: every data op adds
+ceil(bytes / {read,write}_cu_size) units to the replica's CU counters (the
+billing/throttling surface), and feeds the hotkey collectors with the
+op's hash_key so detection sees real traffic.
+"""
+
+from ..runtime.perf_counters import counters
+
+
+class CapacityUnitCalculator:
+    def __init__(self, app_id: int, pidx: int, read_cu_size: int = 4096,
+                 write_cu_size: int = 4096, read_hotkey=None, write_hotkey=None):
+        self.read_cu_size = read_cu_size
+        self.write_cu_size = write_cu_size
+        pfx = f"app.{app_id}.{pidx}."
+        self._read_cu = counters.rate(pfx + "recent_read_cu")
+        self._write_cu = counters.rate(pfx + "recent_write_cu")
+        self.read_hotkey = read_hotkey
+        self.write_hotkey = write_hotkey
+
+    def _units(self, nbytes: int, unit: int) -> int:
+        return max(1, -(-max(nbytes, 1) // unit))
+
+    def add_read(self, hash_key: bytes, nbytes: int) -> None:
+        self._read_cu.add(self._units(nbytes, self.read_cu_size))
+        if self.read_hotkey is not None:
+            self.read_hotkey.capture(hash_key)
+
+    def add_write(self, hash_key: bytes, nbytes: int) -> None:
+        self._write_cu.add(self._units(nbytes, self.write_cu_size))
+        if self.write_hotkey is not None:
+            self.write_hotkey.capture(hash_key)

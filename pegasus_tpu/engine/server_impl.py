@@ -61,6 +61,15 @@ class PegasusServer:
         from .manual_compact_service import ManualCompactService
 
         self.manual_compact_service = ManualCompactService(self)
+        from .capacity_unit_calculator import CapacityUnitCalculator
+        from .hotkey_collector import HotkeyCollector
+
+        self.read_hotkey = HotkeyCollector("read")
+        self.write_hotkey = HotkeyCollector("write")
+        self.cu_calculator = CapacityUnitCalculator(
+            app_id, pidx, read_hotkey=self.read_hotkey,
+            write_hotkey=self.write_hotkey)
+        self.write_service.cu_calculator = self.cu_calculator
         if app_envs:
             self.update_app_envs(app_envs)
 
@@ -199,6 +208,11 @@ class PegasusServer:
             resp.error = Status.NOT_FOUND
         else:
             resp.value = self._schema.extract_user_data(raw)
+        try:
+            hk, _ = key_schema.restore_key(key)
+        except ValueError:
+            hk = key  # malformed client key: still account, never raise
+        self.cu_calculator.add_read(hk, len(key) + len(resp.value))
         counters.rate(self._pfx + "get_qps").increment()
         counters.percentile(self._pfx + "get_latency_us").set(
             int((time.perf_counter() - t0) * 1e6))
@@ -214,11 +228,14 @@ class PegasusServer:
                                     server=self.server)
         counters.rate(self._pfx + "multi_get_qps").increment()
         if req.sort_keys:
+            size = 0
             for sk in req.sort_keys:
                 raw = self.engine.get(key_schema.generate_key(req.hash_key, sk), now=now)
                 if raw is not None:
                     data = b"" if req.no_value else self._schema.extract_user_data(raw)
                     resp.kvs.append(msg.KeyValue(sk, data))
+                    size += len(sk) + len(data)
+            self.cu_calculator.add_read(req.hash_key, size)
             return resp
 
         start = key_schema.generate_key(req.hash_key, req.start_sortkey)
@@ -267,6 +284,7 @@ class PegasusServer:
                 out.pop()
                 complete = False
                 break
+        self.cu_calculator.add_read(req.hash_key, size)
         resp.kvs = out
         resp.error = Status.OK if complete else Status.INCOMPLETE
         return resp
@@ -287,6 +305,7 @@ class PegasusServer:
                 break
             count += 1
         resp.count = count
+        self.cu_calculator.add_read(hash_key, count)
         counters.rate(self._pfx + "scan_qps").increment()
         return resp
 
@@ -387,6 +406,21 @@ class PegasusServer:
                 ctx = ScanContext(iterator, req)
             resp.context_id = self._contexts.put(ctx)
         return resp
+
+    # -------------------------------------------------------------- hotkeys
+
+    def on_detect_hotkey(self, kind: str, action: str) -> str:
+        """detect_hotkey RPC (reference pegasus_server_impl.cpp:2976)."""
+        if kind not in ("read", "write"):
+            return f"ERROR: bad hotkey type {kind!r} (read|write)"
+        if action not in ("start", "stop", "query"):
+            return f"ERROR: bad action {action!r} (start|stop|query)"
+        collector = self.read_hotkey if kind == "read" else self.write_hotkey
+        if action == "start":
+            return collector.start()
+        if action == "stop":
+            return collector.stop()
+        return collector.query()
 
     # ------------------------------------------------------------ lifecycle
 

@@ -44,6 +44,13 @@ class WriteService:
         self.cluster_id = cluster_id
         self._schema = SCHEMAS[engine.data_version()]
         self._batch = None
+        self.cu_calculator = None  # set by PegasusServer
+
+    def _add_write_cu(self, key_or_hash: bytes, nbytes: int, is_key=True):
+        if self.cu_calculator is None:
+            return
+        hk = key_schema.restore_key(key_or_hash)[0] if is_key else key_or_hash
+        self.cu_calculator.add_write(hk, nbytes)
 
     # ----------------------------------------------------------- helpers
 
@@ -82,11 +89,13 @@ class WriteService:
         resp = self._fill(msg.UpdateResponse(), decree)
         value = self._encode(req.value, req.expire_ts_seconds, timestamp_us)
         self.engine.write(WriteBatch().put(req.key, value, req.expire_ts_seconds), decree)
+        self._add_write_cu(req.key, len(req.key) + len(req.value))
         return resp
 
     def remove(self, decree: int, key: bytes):
         resp = self._fill(msg.UpdateResponse(), decree)
         self.engine.write(WriteBatch().delete(key), decree)
+        self._add_write_cu(key, len(key))
         return resp
 
     def multi_put(self, decree: int, req: msg.MultiPutRequest, timestamp_us: int = 0):
@@ -96,11 +105,14 @@ class WriteService:
             self.empty_put(decree)
             return resp
         batch = WriteBatch()
+        total = 0
         for kv in req.kvs:
             key = key_schema.generate_key(req.hash_key, kv.key)
             value = self._encode(kv.value, req.expire_ts_seconds, timestamp_us)
             batch.put(key, value, req.expire_ts_seconds)
+            total += len(key) + len(kv.value)
         self.engine.write(batch, decree)
+        self._add_write_cu(req.hash_key, total, is_key=False)
         return resp
 
     def multi_remove(self, decree: int, req: msg.MultiRemoveRequest):
@@ -110,9 +122,12 @@ class WriteService:
             self.empty_put(decree)
             return resp
         batch = WriteBatch()
+        total = 0
         for sk in req.sort_keys:
             batch.delete(key_schema.generate_key(req.hash_key, sk))
+            total += len(req.hash_key) + len(sk)
         self.engine.write(batch, decree)
+        self._add_write_cu(req.hash_key, total, is_key=False)
         resp.count = len(req.sort_keys)
         return resp
 
@@ -149,6 +164,7 @@ class WriteService:
                 new_expire = req.expire_ts_seconds
         value = self._encode(str(new_value).encode(), new_expire)
         self.engine.write(WriteBatch().put(req.key, value, new_expire), decree)
+        self._add_write_cu(req.key, len(req.key) + len(value))
         resp.new_value = new_value
         return resp
 
@@ -183,6 +199,7 @@ class WriteService:
         self.engine.write(
             WriteBatch().put(set_key, value, req.set_expire_ts_seconds), decree
         )
+        self._add_write_cu(req.hash_key, len(set_key) + len(value), is_key=False)
         return resp
 
     def check_and_mutate(self, decree: int, req: msg.CheckAndMutateRequest, now: int = None):
@@ -215,14 +232,18 @@ class WriteService:
             self.empty_put(decree)
             return resp
         batch = WriteBatch()
+        total = 0
         for m in req.mutate_list:
             key = key_schema.generate_key(req.hash_key, m.sort_key)
             if m.operation == MutateOperation.PUT:
                 value = self._encode(m.value, m.set_expire_ts_seconds)
                 batch.put(key, value, m.set_expire_ts_seconds)
+                total += len(key) + len(value)
             else:
                 batch.delete(key)
+                total += len(key)
         self.engine.write(batch, decree)
+        self._add_write_cu(req.hash_key, total, is_key=False)
         return resp
 
     # ------------------------------------------------- batched put/remove

@@ -1,0 +1,125 @@
+"""ctypes bindings for the native host-ops library (hostops.cpp).
+
+Compiles the shared library on first use with the in-image g++ (no pip, no
+pybind11 — plain `extern "C"` + ctypes, the SURVEY §2 requirement that
+runtime hot paths be native like the reference's C++). Every binding has a
+numpy fallback; `available()` reports whether the native path is active.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hostops.cpp")
+_SO = os.path.join(_DIR, "libhostops.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C")
+        lib.crc64_batch.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u64p]
+        lib.gather_arena.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64,
+                                     u8p, i64p]
+        lib.pack_prefixes.argtypes = [u8p, i64p, i32p, ctypes.c_int64,
+                                      ctypes.c_int32, u32p]
+        lib.merge_counts.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                                     ctypes.c_int64, ctypes.c_int32, i64p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc64_batch(arena, offsets, lengths):
+    """uint64[n] crc64 of each slice; native slice-by-8 when available."""
+    lib = _load()
+    n = len(offsets)
+    if lib is None or n == 0:
+        from ..base.crc64 import crc64_batch_numpy
+
+        return crc64_batch_numpy(arena, offsets, lengths)
+    out = np.empty(n, np.uint64)
+    lib.crc64_batch(np.ascontiguousarray(arena, np.uint8),
+                    np.ascontiguousarray(offsets, np.int64),
+                    np.ascontiguousarray(lengths, np.int64), n, out)
+    return out
+
+
+def gather_arena(arena, off, len32, idx):
+    """-> (out_arena, out_off) compacted selection; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    idx = np.ascontiguousarray(idx, np.int64)
+    len32 = np.ascontiguousarray(len32, np.int32)
+    total = int(len32[idx].astype(np.int64).sum())
+    out = np.empty(total, np.uint8)
+    out_off = np.empty(len(idx), np.int64)
+    lib.gather_arena(np.ascontiguousarray(arena, np.uint8),
+                     np.ascontiguousarray(off, np.int64),
+                     len32, idx, len(idx), out, out_off)
+    return out, out_off
+
+
+def pack_prefixes(arena, off, len32, w):
+    """-> uint32[n, w] big-endian packed prefixes; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(off)
+    out = np.empty((w, n), np.uint32)
+    lib.pack_prefixes(np.ascontiguousarray(arena, np.uint8),
+                      np.ascontiguousarray(off, np.int64),
+                      np.ascontiguousarray(len32, np.int32), n, w,
+                      out.reshape(-1))
+    return out.T
+
+
+def merge_counts(a_sbytes, b_sbytes, side: str):
+    """Counts of b-items < (side='left') / <= (side='right') each a-item.
+    Both inputs ascending fixed-width byte arrays; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a_sbytes)
+    b = np.ascontiguousarray(b_sbytes)
+    out = np.empty(len(a), np.int64)
+    lib.merge_counts(a.view(np.uint8).reshape(-1), len(a),
+                     b.view(np.uint8).reshape(-1), len(b),
+                     a.dtype.itemsize, 1 if side == "right" else 0, out)
+    return out

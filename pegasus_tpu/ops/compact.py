@@ -167,6 +167,9 @@ class CpuBackend:
             total = sum(packed.lens)
             merged_sb = np.empty(total, dtype=packed.sbytes[0].dtype)
             merged_gidx = np.empty(total, dtype=np.int32)
+            from .. import native
+
+            use_native = native.available()
             for i in range(K):
                 r = np.arange(packed.lens[i], dtype=np.int64)
                 for j in range(K):
@@ -174,8 +177,13 @@ class CpuBackend:
                         continue
                     # equal keys order newest-run (lowest index) first
                     side = "right" if j < i else "left"
-                    r += np.searchsorted(packed.sbytes[j], packed.sbytes[i],
-                                         side=side)
+                    if use_native:
+                        # galloping two-pointer pass over both sorted runs
+                        r += native.merge_counts(packed.sbytes[i],
+                                                 packed.sbytes[j], side)
+                    else:
+                        r += np.searchsorted(packed.sbytes[j], packed.sbytes[i],
+                                             side=side)
                 merged_sb[r] = packed.sbytes[i]
                 merged_gidx[r] = packed.gidx[i]
         same = np.zeros(len(merged_sb), dtype=bool)

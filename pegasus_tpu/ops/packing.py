@@ -46,10 +46,14 @@ def pack_sbytes(prefix_cols, klen, rank=None):
 
 def pack_key_prefixes(key_arena, key_off, key_len, width_u32: int = DEFAULT_PREFIX_U32):
     """-> uint32[n, width_u32], big-endian packed, zero-padded."""
+    from .. import native
+
     n = len(key_off)
     w_bytes = width_u32 * 4
     if n == 0:
         return np.zeros((0, width_u32), np.uint32)
+    if native.available():
+        return native.pack_prefixes(key_arena, key_off, key_len, width_u32)
     pos = np.arange(w_bytes, dtype=np.int64)
     idx = key_off[:, None] + pos[None, :]
     valid = pos[None, :] < key_len[:, None]

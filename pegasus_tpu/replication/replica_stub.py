@@ -26,6 +26,7 @@ from .replica import GroupView, PRIMARY, PrepareRejected, Replica, ReplicaError
 
 RPC_PREPARE = "RPC_PREPARE"
 RPC_LEARN = "RPC_LEARN"
+RPC_REMOTE_COMMAND = "RPC_CLI_CLI_CALL"
 
 
 class _RemotePeer:
@@ -86,6 +87,15 @@ class ReplicaStub:
         self.rpc.register(RPC_REPLICA_STATE, self._on_replica_state)
         self.rpc.register(RPC_PREPARE, self._on_prepare)
         self.rpc.register(RPC_LEARN, self._on_learn)
+        from ..runtime.remote_command import RemoteCommandService
+
+        self.commands = RemoteCommandService()
+        self.commands.register_defaults(node_kind="replica",
+                                        describe=self._describe)
+        self.commands.register("manual-compact", self._cmd_manual_compact)
+        self.commands.register("query-compact-state", self._cmd_compact_state)
+        self.commands.register("detect_hotkey", self._cmd_detect_hotkey)
+        self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
         self._stop = threading.Event()
@@ -209,6 +219,54 @@ class ReplicaStub:
             files=[mm.FileBlob(n, d) for n, d in state["files"]],
             tail=[codec.encode(m) for m in state["tail"]],
             last_committed=state["last_committed"], ballot=state["ballot"]))
+
+    # ------------------------------------------------------ remote commands
+
+    def _describe(self) -> dict:
+        with self._lock:
+            return {
+                "address": self.address,
+                "replicas": {
+                    f"{a}.{p}": {
+                        "status": r.status, "ballot": r.ballot,
+                        "last_committed": r.last_committed,
+                        "last_prepared": r.last_prepared,
+                        "last_durable": r.server.engine.last_durable_decree(),
+                    }
+                    for (a, p), r in self._replicas.items()
+                },
+            }
+
+    def _cmd_manual_compact(self, args: list) -> str:
+        """manual-compact [app_id.pidx] — run a full compaction now."""
+        done = []
+        with self._lock:
+            targets = list(self._replicas.items())
+        for (a, p), rep in targets:
+            if args and f"{a}.{p}" not in args:
+                continue
+            rep.server.manual_compact()
+            done.append(f"{a}.{p}")
+        return "compacted: " + ", ".join(done) if done else "no matching replica"
+
+    def _cmd_compact_state(self, args: list) -> str:
+        with self._lock:
+            targets = list(self._replicas.items())
+        return "\n".join(
+            f"{a}.{p}: {rep.server.manual_compact_service.query_compact_state()}"
+            for (a, p), rep in targets)
+
+    def _cmd_detect_hotkey(self, args: list) -> str:
+        """detect_hotkey <app_id.pidx> <read|write> <start|stop|query>."""
+        if len(args) < 3:
+            return "usage: detect_hotkey <app_id.pidx> <read|write> <start|stop|query>"
+        gpid, kind, action = args[0], args[1], args[2]
+        a, _, p = gpid.partition(".")
+        with self._lock:
+            rep = self._replicas.get((int(a), int(p)))
+        if rep is None:
+            return f"no replica {gpid}"
+        return rep.server.on_detect_hotkey(kind, action)
 
     # ------------------------------------------------------------ write path
 

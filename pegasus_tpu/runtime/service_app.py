@@ -1,0 +1,174 @@
+"""Service-app container: ini-driven process bootstrap (the dsn_run role).
+
+Mirror of the rDSN app container Pegasus boots through
+(src/server/main.cpp:94-111 `dsn_run`; pegasus_service_app.h:31-102;
+config.ini [apps.meta]/[apps.replica]/[apps.collector]): a config file
+declares which apps run in this process and on which ports; `run()`
+instantiates each registered factory and starts it. One process can host
+meta, replica, collector, or any mix — the onebox pattern.
+
+Config shape (ini):
+
+    [apps.meta]
+    type = meta
+    run = true
+    port = 34601
+
+    [apps.replica]
+    type = replica
+    run = true
+    port = 34801
+    data_dir = /tmp/pegasus/replica
+
+    [pegasus.server]
+    meta_servers = 127.0.0.1:34601
+"""
+
+import os
+import threading
+
+from .config import Config
+
+_FACTORIES = {}
+
+
+def register_app_factory(type_name: str, factory) -> None:
+    """factory(name, config, section) -> app object with start()/stop()."""
+    _FACTORIES[type_name] = factory
+
+
+class ServiceAppContainer:
+    def __init__(self, config: Config):
+        self.config = config
+        self.apps = {}
+
+    def start(self, only: list = None) -> dict:
+        for section in self.config.sections():
+            if not section.startswith("apps."):
+                continue
+            name = section[len("apps."):]
+            if only and name not in only:
+                continue
+            if not self.config.get_bool(section, "run", True):
+                continue
+            type_name = self.config.get_string(section, "type", name)
+            factory = _FACTORIES.get(type_name)
+            if factory is None:
+                raise ValueError(f"no app factory registered for {type_name!r}")
+            app = factory(name, self.config, section)
+            app.start()
+            self.apps[name] = app
+        return self.apps
+
+    def stop(self) -> None:
+        for app in reversed(list(self.apps.values())):
+            app.stop()
+        self.apps.clear()
+
+    def wait_forever(self) -> None:
+        threading.Event().wait()
+
+
+# ---------------------------------------------------------- built-in apps
+
+
+class MetaApp:
+    def __init__(self, name, config: Config, section: str):
+        from ..meta.meta_server import MetaServer
+        from ..rpc.transport import RpcServer
+
+        state_dir = config.get_string(section, "state_dir",
+                                      os.path.join("pegasus-data", "meta"))
+        self.meta = MetaServer(
+            os.path.join(state_dir, "state.json"),
+            fd_grace_seconds=config.get_float("failure_detector",
+                                              "grace_seconds", 22.0))
+        self.rpc = RpcServer(config.get_string(section, "host", "127.0.0.1"),
+                             config.get_int(section, "port", 34601))
+        for code, fn in self.meta.rpc_handlers().items():
+            self.rpc.register(code, fn)
+        self._fd_timer = None
+        self._fd_interval = config.get_float("failure_detector",
+                                             "check_interval_seconds", 5.0)
+
+    @property
+    def address(self):
+        return f"{self.rpc.address[0]}:{self.rpc.address[1]}"
+
+    def start(self):
+        self.rpc.start()
+        self._schedule_fd()
+        return self
+
+    def _schedule_fd(self):
+        def tick():
+            self.meta.check_leases()
+            self._fd_timer = threading.Timer(self._fd_interval, tick)
+            self._fd_timer.daemon = True
+            self._fd_timer.start()
+
+        self._fd_timer = threading.Timer(self._fd_interval, tick)
+        self._fd_timer.daemon = True
+        self._fd_timer.start()
+
+    def stop(self):
+        if self._fd_timer:
+            self._fd_timer.cancel()
+        self.rpc.stop()
+
+
+class ReplicaApp:
+    def __init__(self, name, config: Config, section: str):
+        from ..engine import EngineOptions
+        from ..replication.replica_stub import ReplicaStub
+
+        metas = config.get_list("pegasus.server", "meta_servers",
+                                ["127.0.0.1:34601"])
+        backend = config.get_string("pegasus.server", "compaction_backend", "cpu")
+        data_dir = config.get_string(section, "data_dir",
+                                     os.path.join("pegasus-data", name))
+
+        def options_factory():
+            return EngineOptions(backend=backend)
+
+        self.stub = ReplicaStub(
+            data_dir, list(metas),
+            host=config.get_string(section, "host", "127.0.0.1"),
+            port=config.get_int(section, "port", 0),
+            options_factory=options_factory)
+        self._beacon = config.get_float("failure_detector",
+                                        "beacon_interval_seconds", 1.0)
+
+    @property
+    def address(self):
+        return self.stub.address
+
+    def start(self):
+        self.stub.start(self._beacon)
+        return self
+
+    def stop(self):
+        self.stub.stop()
+
+
+class CollectorApp:
+    def __init__(self, name, config: Config, section: str):
+        from ..collector.info_collector import InfoCollector
+
+        metas = config.get_list("pegasus.server", "meta_servers",
+                                ["127.0.0.1:34601"])
+        self.collector = InfoCollector(
+            list(metas),
+            interval_seconds=config.get_float(section, "interval_seconds", 10.0))
+
+    def start(self):
+        self.collector.start()
+        return self
+
+    def stop(self):
+        self.collector.stop()
+
+
+register_app_factory("meta", MetaApp)
+register_app_factory("replica", ReplicaApp)
+register_app_factory("collector", CollectorApp)

@@ -1,0 +1,2 @@
+"""Process entry: `python -m pegasus_tpu.server --config cfg.ini [--app ...]`
+boots the ini-declared service apps (the dsn_run/main.cpp role)."""

@@ -1,0 +1,25 @@
+import argparse
+
+from ..runtime.config import Config
+from ..runtime.service_app import ServiceAppContainer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pegasus-server")
+    ap.add_argument("--config", required=True, help="ini config path")
+    ap.add_argument("--app", default="", help="comma-separated app names "
+                    "(default: every [apps.*] with run=true)")
+    ns = ap.parse_args(argv)
+    container = ServiceAppContainer(Config(ns.config))
+    only = [a for a in ns.app.split(",") if a] or None
+    apps = container.start(only)
+    for name, app in apps.items():
+        addr = getattr(app, "address", "")
+        print(f"[pegasus-tpu] app {name} started {addr}", flush=True)
+    try:
+        container.wait_forever()
+    except KeyboardInterrupt:
+        container.stop()
+
+
+main()

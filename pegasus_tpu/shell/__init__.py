@@ -1,0 +1,3 @@
+from .main import Shell, main
+
+__all__ = ["Shell", "main"]

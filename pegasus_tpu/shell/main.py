@@ -1,0 +1,385 @@
+"""pegasus shell: admin + data CLI over the meta server and replica nodes.
+
+The src/shell surface (command table src/shell/main.cpp:42-..., impls
+src/shell/commands/*.cpp) rebuilt over this stack's client/meta RPCs. Runs
+as a REPL (`python -m pegasus_tpu.shell --meta host:port`) or one-shot
+(`... --meta host:port -- app ls`). Commands cover cluster info, table
+DDL, node view, data ops (set/get/del/multi_*/ttl/incr/scan/count_data/
+copy_data), app-envs (incl. the manual-compact and usage-scenario control
+surface), remote commands, and perf-counter scraping.
+"""
+
+import argparse
+import json
+import shlex
+import sys
+import time
+
+from ..base.utils import c_escape_string
+from ..client import MetaResolver, PegasusClient, PegasusError
+from ..meta import messages as mm
+from ..meta.meta_server import (RPC_CM_CREATE_APP, RPC_CM_DROP_APP,
+                                RPC_CM_LIST_APPS, RPC_CM_LIST_NODES,
+                                RPC_CM_QUERY_CONFIG, RPC_CM_SET_APP_ENVS)
+from ..rpc import codec
+from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
+
+
+class Shell:
+    def __init__(self, meta_addrs, out=sys.stdout):
+        self.meta_addrs = list(meta_addrs)
+        self.pool = ConnectionPool()
+        self.out = out
+        self.current_app = None
+        self._clients = {}
+        self.commands = {
+            "help": (self.cmd_help, "list commands"),
+            "cluster_info": (self.cmd_cluster_info, "meta + node summary"),
+            "ls": (self.cmd_ls, "list tables"),
+            "app": (self.cmd_app, "app <name> — show partition table"),
+            "create": (self.cmd_create, "create <name> [-p N] [-r N]"),
+            "drop": (self.cmd_drop, "drop <name>"),
+            "use": (self.cmd_use, "use <name> — select table for data ops"),
+            "nodes": (self.cmd_nodes, "list replica nodes"),
+            "set": (self.cmd_set, "set <hk> <sk> <value> [ttl]"),
+            "get": (self.cmd_get, "get <hk> <sk>"),
+            "del": (self.cmd_del, "del <hk> <sk>"),
+            "exist": (self.cmd_exist, "exist <hk> <sk>"),
+            "ttl": (self.cmd_ttl, "ttl <hk> <sk>"),
+            "incr": (self.cmd_incr, "incr <hk> <sk> [by]"),
+            "multi_set": (self.cmd_multi_set, "multi_set <hk> <sk> <v> [<sk> <v>...]"),
+            "multi_get": (self.cmd_multi_get, "multi_get <hk> [sk...]"),
+            "multi_del": (self.cmd_multi_del, "multi_del <hk> <sk> [sk...]"),
+            "sortkey_count": (self.cmd_sortkey_count, "sortkey_count <hk>"),
+            "hash_scan": (self.cmd_hash_scan, "hash_scan <hk> [start] [stop]"),
+            "full_scan": (self.cmd_full_scan, "full_scan [max_rows]"),
+            "count_data": (self.cmd_count_data, "count rows in current table"),
+            "copy_data": (self.cmd_copy_data, "copy_data <dest_table>"),
+            "get_app_envs": (self.cmd_get_app_envs, "show current table envs"),
+            "set_app_envs": (self.cmd_set_app_envs, "set_app_envs <k> <v> [...]"),
+            "del_app_envs": (self.cmd_del_app_envs, "del_app_envs <k> [...]"),
+            "manual_compact": (self.cmd_manual_compact,
+                               "trigger once manual compaction via app envs"),
+            "query_compact_state": (self.cmd_query_compact,
+                                    "query manual compact state on nodes"),
+            "remote_command": (self.cmd_remote_command,
+                               "remote_command <node|all> <cmd> [args...]"),
+            "server_info": (self.cmd_server_info, "server-info on every node"),
+            "server_stat": (self.cmd_server_stat, "server-stat on every node"),
+            "perf_counters": (self.cmd_perf_counters,
+                              "perf_counters <node> [prefix]"),
+            "detect_hotkey": (self.cmd_detect_hotkey,
+                              "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
+            "exit": (None, "quit"),
+            "quit": (None, "quit"),
+        }
+
+    # ----------------------------------------------------------- plumbing
+
+    def _meta_call(self, code, req, resp_cls):
+        last = None
+        for m in self.meta_addrs:
+            host, _, port = m.rpartition(":")
+            try:
+                conn = self.pool.get((host, int(port)))
+                _, body = conn.call(code, codec.encode(req), timeout=10.0)
+                return codec.decode(resp_cls, body)
+            except (RpcError, OSError) as e:
+                last = e
+        raise RpcError(7, f"no meta reachable: {last}")
+
+    def _node_command(self, node, command, args):
+        host, _, port = node.rpartition(":")
+        conn = self.pool.get((host, int(port)))
+        _, body = conn.call("RPC_CLI_CLI_CALL",
+                            codec.encode(RemoteCommandRequest(command, args)),
+                            timeout=10.0)
+        return codec.decode(RemoteCommandResponse, body).output
+
+    def _client(self, app=None) -> PegasusClient:
+        app = app or self.current_app
+        if app is None:
+            raise PegasusError(4, "no table selected (use <name>)")
+        if app not in self._clients:
+            self._clients[app] = PegasusClient(
+                MetaResolver(self.meta_addrs, app, self.pool))
+        return self._clients[app]
+
+    def _nodes(self):
+        r = self._meta_call(RPC_CM_LIST_NODES, mm.ListNodesRequest(),
+                            mm.ListNodesResponse)
+        return r.nodes
+
+    def p(self, *args):
+        print(*args, file=self.out)
+
+    # ----------------------------------------------------------- commands
+
+    def cmd_help(self, args):
+        for name, (_, doc) in sorted(self.commands.items()):
+            self.p(f"  {name:<22} {doc}")
+
+    def cmd_cluster_info(self, args):
+        apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
+                               mm.ListAppsResponse).apps
+        nodes = self._nodes()
+        self.p(f"meta_servers       : {','.join(self.meta_addrs)}")
+        self.p(f"app_count          : {len(apps)}")
+        self.p(f"node_count         : {len(nodes)} "
+               f"({sum(1 for n in nodes if n.alive)} alive)")
+
+    def cmd_ls(self, args):
+        apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
+                               mm.ListAppsResponse).apps
+        self.p(f"{'app_id':>6}  {'status':<14} {'app_name':<24} "
+               f"{'pcount':>6} {'rcount':>6}")
+        for a in sorted(apps, key=lambda x: x.app_id):
+            self.p(f"{a.app_id:>6}  {a.status:<14} {a.app_name:<24} "
+                   f"{a.partition_count:>6} {a.replica_count:>6}")
+
+    def cmd_app(self, args):
+        name = args[0] if args else self.current_app
+        cfg = self._meta_call(RPC_CM_QUERY_CONFIG, mm.QueryConfigRequest(name),
+                              mm.QueryConfigResponse)
+        if cfg.error:
+            self.p(f"ERROR: {cfg.error_text}")
+            return
+        self.p(f"app {cfg.app.app_name} id={cfg.app.app_id} "
+               f"partitions={cfg.app.partition_count}")
+        self.p(f"{'pidx':>4} {'ballot':>6}  {'primary':<22} secondaries")
+        for pc in cfg.partitions:
+            self.p(f"{pc.pidx:>4} {pc.ballot:>6}  {pc.primary:<22} "
+                   f"{','.join(pc.secondaries)}")
+
+    def cmd_create(self, args):
+        ap = argparse.ArgumentParser(prog="create")
+        ap.add_argument("name")
+        ap.add_argument("-p", "--partition_count", type=int, default=8)
+        ap.add_argument("-r", "--replica_count", type=int, default=3)
+        ns = ap.parse_args(args)
+        r = self._meta_call(RPC_CM_CREATE_APP,
+                            mm.CreateAppRequest(ns.name, ns.partition_count,
+                                                ns.replica_count),
+                            mm.CreateAppResponse)
+        self.p(f"ERROR: {r.error_text}" if r.error
+               else f"create app {ns.name} succeed, id={r.app_id}")
+
+    def cmd_drop(self, args):
+        r = self._meta_call(RPC_CM_DROP_APP, mm.DropAppRequest(args[0]),
+                            mm.DropAppResponse)
+        self._clients.pop(args[0], None)
+        self.p(f"ERROR: {r.error_text}" if r.error else f"drop app {args[0]} succeed")
+
+    def cmd_use(self, args):
+        self.current_app = args[0]
+        self.p(f"OK, table: {args[0]}")
+
+    def cmd_nodes(self, args):
+        self.p(f"{'address':<22} {'status':<8} {'replica_count':>13}")
+        for n in self._nodes():
+            self.p(f"{n.address:<22} {'ALIVE' if n.alive else 'UNALIVE':<8} "
+                   f"{n.replica_count:>13}")
+
+    # data ops ------------------------------------------------------------
+
+    def cmd_set(self, args):
+        ttl = int(args[3]) if len(args) > 3 else 0
+        self._client().set(args[0].encode(), args[1].encode(),
+                           args[2].encode(), ttl_seconds=ttl)
+        self.p("OK")
+
+    def cmd_get(self, args):
+        v = self._client().get(args[0].encode(), args[1].encode())
+        self.p("not found" if v is None else f'"{c_escape_string(v)}"')
+
+    def cmd_del(self, args):
+        self._client().delete(args[0].encode(), args[1].encode())
+        self.p("OK")
+
+    def cmd_exist(self, args):
+        self.p(str(self._client().exist(args[0].encode(), args[1].encode())).lower())
+
+    def cmd_ttl(self, args):
+        t = self._client().ttl(args[0].encode(), args[1].encode())
+        self.p("not found" if t is None
+               else ("no ttl" if t < 0 else f"{t} seconds"))
+
+    def cmd_incr(self, args):
+        by = int(args[2]) if len(args) > 2 else 1
+        self.p(str(self._client().incr(args[0].encode(), args[1].encode(), by)))
+
+    def cmd_multi_set(self, args):
+        hk, rest = args[0].encode(), args[1:]
+        kvs = {rest[i].encode(): rest[i + 1].encode()
+               for i in range(0, len(rest) - 1, 2)}
+        self._client().multi_set(hk, kvs)
+        self.p(f"OK, {len(kvs)} kvs")
+
+    def cmd_multi_get(self, args):
+        hk = args[0].encode()
+        sks = [a.encode() for a in args[1:]] or None
+        complete, kvs = self._client().multi_get(hk, sort_keys=sks)
+        for sk in sorted(kvs):
+            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(kvs[sk])}"')
+        self.p(f"{len(kvs)} rows{'' if complete else ' (incomplete)'}")
+
+    def cmd_multi_del(self, args):
+        n = self._client().multi_del(args[0].encode(),
+                                     [a.encode() for a in args[1:]])
+        self.p(f"OK, {n} deleted")
+
+    def cmd_sortkey_count(self, args):
+        self.p(str(self._client().sortkey_count(args[0].encode())))
+
+    def cmd_hash_scan(self, args):
+        hk = args[0].encode()
+        start = args[1].encode() if len(args) > 1 else b""
+        stop = args[2].encode() if len(args) > 2 else b""
+        n = 0
+        for _, sk, v in self._client().get_scanner(hk, start, stop):
+            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(v)}"')
+            n += 1
+        self.p(f"{n} rows")
+
+    def cmd_full_scan(self, args):
+        limit = int(args[0]) if args else 1 << 30
+        n = 0
+        for sc in self._client().get_unordered_scanners():
+            for hk, sk, v in sc:
+                self.p(f'"{c_escape_string(hk)}" : "{c_escape_string(sk)}" => '
+                       f'"{c_escape_string(v)}"')
+                n += 1
+                if n >= limit:
+                    self.p(f"{n} rows (limited)")
+                    return
+        self.p(f"{n} rows")
+
+    def cmd_count_data(self, args):
+        n = 0
+        for sc in self._client().get_unordered_scanners():
+            for _ in sc:
+                n += 1
+        self.p(f"{n} rows")
+
+    def cmd_copy_data(self, args):
+        dest = self._client(args[0])
+        n = 0
+        for sc in self._client().get_unordered_scanners():
+            for hk, sk, v in sc:
+                dest.set(hk, sk, v)
+                n += 1
+        self.p(f"copied {n} rows to {args[0]}")
+
+    # env / admin ---------------------------------------------------------
+
+    def _set_envs(self, envs: dict):
+        r = self._meta_call(RPC_CM_SET_APP_ENVS,
+                            mm.SetAppEnvsRequest(self.current_app,
+                                                 json.dumps(envs)),
+                            mm.SetAppEnvsResponse)
+        if r.error:
+            self.p(f"ERROR: {r.error_text}")
+        return r.error == 0
+
+    def cmd_get_app_envs(self, args):
+        cfg = self._meta_call(RPC_CM_QUERY_CONFIG,
+                              mm.QueryConfigRequest(self.current_app),
+                              mm.QueryConfigResponse)
+        self.p(json.dumps(json.loads(cfg.app.envs_json), indent=1))
+
+    def cmd_set_app_envs(self, args):
+        envs = {args[i]: args[i + 1] for i in range(0, len(args) - 1, 2)}
+        if self._set_envs(envs):
+            self.p(f"set {len(envs)} envs OK")
+
+    def cmd_del_app_envs(self, args):
+        # empty value removes at the replica layer; meta keeps the tombstone
+        if self._set_envs({k: "" for k in args}):
+            self.p("OK")
+
+    def cmd_manual_compact(self, args):
+        if self._set_envs({"manual_compact.once.trigger_time":
+                           str(int(time.time()))}):
+            self.p("manual compact triggered")
+
+    def cmd_query_compact(self, args):
+        for n in self._nodes():
+            if n.alive:
+                self.p(f"[{n.address}]")
+                self.p(self._node_command(n.address, "query-compact-state", []))
+
+    def cmd_remote_command(self, args):
+        target, cmd, rest = args[0], args[1], args[2:]
+        nodes = ([n.address for n in self._nodes() if n.alive]
+                 if target == "all" else [target])
+        for node in nodes:
+            self.p(f"[{node}]")
+            self.p(self._node_command(node, cmd, rest))
+
+    def cmd_server_info(self, args):
+        self.cmd_remote_command(["all", "server-info"])
+
+    def cmd_server_stat(self, args):
+        self.cmd_remote_command(["all", "server-stat"])
+
+    def cmd_perf_counters(self, args):
+        node = args[0]
+        cmd = "perf-counters-by-prefix" if len(args) > 1 else "perf-counters"
+        self.p(self._node_command(node, cmd, args[1:]))
+
+    def cmd_detect_hotkey(self, args):
+        node, rest = args[0], args[1:]
+        self.p(self._node_command(node, "detect_hotkey", rest))
+
+    # ---------------------------------------------------------------- run
+
+    def run_line(self, line: str) -> bool:
+        """-> False when the shell should exit."""
+        parts = shlex.split(line)
+        if not parts:
+            return True
+        name, args = parts[0], parts[1:]
+        if name in ("exit", "quit"):
+            return False
+        ent = self.commands.get(name)
+        if ent is None:
+            self.p(f"unknown command {name!r} (try help)")
+            return True
+        try:
+            ent[0](args)
+        except (PegasusError, RpcError) as e:
+            self.p(f"ERROR: {e}")
+        except (IndexError, ValueError):
+            self.p(f"usage: {ent[1]}")
+        return True
+
+    def repl(self):
+        self.p("pegasus-tpu shell; 'help' for commands")
+        while True:
+            try:
+                prompt = f"{self.current_app or ''}> "
+                line = input(prompt)
+            except EOFError:
+                break
+            if not self.run_line(line):
+                break
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pegasus-shell")
+    ap.add_argument("--meta", default="127.0.0.1:34601",
+                    help="comma-separated meta server list")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="one-shot command (flags after the command name "
+                         "pass through, e.g. create t -p 8)")
+    ns = ap.parse_args(argv)
+    sh = Shell(ns.meta.split(","))
+    if ns.command:
+        sh.run_line(shlex.join(ns.command))
+    else:
+        sh.repl()
+
+
+if __name__ == "__main__":
+    main()

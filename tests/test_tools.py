@@ -1,0 +1,253 @@
+"""Ecosystem-layer tests: service-app container, shell, collector,
+reporter, hotkey detection — driven against a real in-process onebox."""
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pegasus_tpu.collector import (AvailableDetector, CounterReporter,
+                                   InfoCollector, hotspot_partitions,
+                                   prometheus_text)
+from pegasus_tpu.engine.hotkey_collector import (COARSE, FINE, FINISHED,
+                                                 HotkeyCollector, STOPPED)
+from pegasus_tpu.runtime.config import Config
+from pegasus_tpu.runtime.service_app import ServiceAppContainer
+from pegasus_tpu.shell.main import Shell
+
+ONEBOX_INI = """
+[apps.meta]
+type = meta
+run = true
+port = 0
+state_dir = %{root}/meta
+
+[apps.replica1]
+type = replica
+run = true
+port = 0
+data_dir = %{root}/replica1
+
+[apps.replica2]
+type = replica
+run = true
+port = 0
+data_dir = %{root}/replica2
+
+[apps.replica3]
+type = replica
+run = true
+port = 0
+data_dir = %{root}/replica3
+
+[pegasus.server]
+meta_servers = %{meta}
+
+[failure_detector]
+beacon_interval_seconds = 0.2
+grace_seconds = 60
+check_interval_seconds = 3600
+"""
+
+
+@pytest.fixture(scope="module")
+def onebox(tmp_path_factory):
+    root = tmp_path_factory.mktemp("toolbox")
+    cfg_meta = Config(text=ONEBOX_INI, variables={"root": str(root), "meta": "x"})
+    container = ServiceAppContainer(cfg_meta)
+    container.start(only=["meta"])
+    meta_addr = container.apps["meta"].address
+    cfg_rest = Config(text=ONEBOX_INI,
+                      variables={"root": str(root), "meta": meta_addr})
+    container2 = ServiceAppContainer(cfg_rest)
+    container2.start(only=["replica1", "replica2", "replica3"])
+    time.sleep(0.3)  # beacons land
+    yield meta_addr
+    container2.stop()
+    container.stop()
+
+
+@pytest.fixture
+def shell(onebox):
+    out = io.StringIO()
+    sh = Shell([onebox], out=out)
+    return sh, out
+
+
+def text(out):
+    return out.getvalue()
+
+
+def test_shell_ddl_and_data_ops(shell):
+    sh, out = shell
+    sh.run_line("create shelltest -p 4 -r 3")
+    assert "succeed" in text(out)
+    sh.run_line("use shelltest")
+    sh.run_line("ls")
+    assert "shelltest" in text(out)
+    sh.run_line("app shelltest")
+    assert "pidx" in text(out)
+    sh.run_line('set user1 sk1 "hello world"')
+    sh.run_line("get user1 sk1")
+    assert "hello world" in text(out)
+    sh.run_line("exist user1 sk1")
+    sh.run_line("ttl user1 sk1")
+    assert "no ttl" in text(out)
+    sh.run_line("incr user1 counter 5")
+    sh.run_line("multi_set mh a 1 b 2 c 3")
+    sh.run_line("multi_get mh")
+    assert '"a" : "1"' in text(out)
+    sh.run_line("sortkey_count mh")
+    sh.run_line("hash_scan mh")
+    sh.run_line("multi_del mh a b")
+    sh.run_line("del user1 sk1")
+    sh.run_line("get user1 sk1")
+    assert "not found" in text(out)
+
+
+def test_shell_cluster_admin(shell):
+    sh, out = shell
+    sh.run_line("cluster_info")
+    assert "node_count" in text(out)
+    sh.run_line("nodes")
+    assert "ALIVE" in text(out)
+    sh.run_line("server_info")
+    assert "pegasus-tpu" in text(out)
+    sh.run_line("server_stat")
+
+
+def test_shell_full_scan_and_copy(shell):
+    sh, out = shell
+    sh.run_line("create copysrc -p 2")
+    sh.run_line("create copydst -p 2")
+    sh.run_line("use copysrc")
+    for i in range(6):
+        sh.run_line(f"set h{i} s v{i}")
+    sh.run_line("count_data")
+    assert "6 rows" in text(out)
+    sh.run_line("copy_data copydst")
+    assert "copied 6 rows" in text(out)
+    sh.run_line("use copydst")
+    sh.run_line("get h3 s")
+    assert "v3" in text(out)
+    sh.run_line("full_scan")
+
+
+def test_shell_envs_and_manual_compact(shell):
+    sh, out = shell
+    sh.run_line("create envtest -p 2")
+    sh.run_line("use envtest")
+    sh.run_line("set k s v")
+    sh.run_line("set_app_envs rocksdb.usage_scenario prefer_write")
+    assert "set 1 envs OK" in text(out)
+    sh.run_line("get_app_envs")
+    assert "prefer_write" in text(out)
+    sh.run_line("manual_compact")
+    assert "triggered" in text(out)
+    sh.run_line("query_compact_state")
+    assert "idle" in text(out) or "running" in text(out)
+
+
+def test_shell_remote_and_counters(shell, onebox):
+    sh, out = shell
+    sh.run_line("create cnttest -p 2")
+    sh.run_line("use cnttest")
+    sh.run_line("set hot s v")
+    nodes = [n.address for n in sh._nodes() if n.alive]
+    sh.run_line(f"perf_counters {nodes[0]} app.")
+    sh.run_line("remote_command all describe")
+    assert "replicas" in text(out)
+
+
+def test_hotkey_state_machine():
+    hc = HotkeyCollector("read", coarse_threshold=50, fine_threshold=30)
+    assert hc.state == STOPPED
+    hc.start()
+    assert hc.state == COARSE
+    # one dominant key among background noise
+    for i in range(200):
+        hc.capture(b"HOT" if i % 2 == 0 else b"bg%d" % i)
+    assert hc.state == FINISHED
+    assert hc.result == b"HOT"
+    assert b"HOT" in hc.query().encode()
+    hc.stop()
+    assert hc.state == STOPPED
+
+
+def test_hotkey_uniform_load_finds_nothing():
+    hc = HotkeyCollector("write", coarse_threshold=50)
+    hc.start()
+    for i in range(300):
+        hc.capture(b"k%d" % i)
+    assert hc.state in (COARSE, FINE)  # never FINISHED on uniform load
+
+
+def test_detect_hotkey_via_shell(shell):
+    sh, out = shell
+    sh.run_line("create hottest -p 1 -r 3")
+    sh.run_line("use hottest")
+    cfg = sh._meta_call.__self__  # noqa: simple access below instead
+    # find the node serving partition 0
+    import pegasus_tpu.meta.messages as mm
+    from pegasus_tpu.meta.meta_server import RPC_CM_QUERY_CONFIG
+
+    qc = sh._meta_call(RPC_CM_QUERY_CONFIG, mm.QueryConfigRequest("hottest"),
+                       mm.QueryConfigResponse)
+    node = qc.partitions[0].primary
+    app_id = qc.app.app_id
+    sh.run_line(f"detect_hotkey {node} {app_id}.0 read start")
+    assert "started" in text(out)
+    for i in range(300):
+        sh.run_line("get hotkey1 s" if i % 2 == 0 else f"get cold{i} s")
+    sh.run_line(f"detect_hotkey {node} {app_id}.0 read query")
+    assert "hotkey1" in text(out)
+
+
+def test_hotspot_partition_analysis():
+    qps = {i: 10.0 for i in range(8)}
+    assert hotspot_partitions(qps) == []
+    qps[3] = 500.0
+    assert hotspot_partitions(qps) == [3]
+
+
+def test_counter_reporter_prometheus(onebox):
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    counters.number("reporter.test_metric").set(42)
+    rep = CounterReporter().start()
+    try:
+        host, port = rep.address
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        assert "reporter_test_metric 42.0" in body
+        cjson = urllib.request.urlopen(
+            f"http://{host}:{port}/counters", timeout=5).read().decode()
+        assert json.loads(cjson)["reporter.test_metric"] == 42
+    finally:
+        rep.stop()
+
+
+def test_info_collector_aggregates(onebox, shell):
+    sh, out = shell
+    sh.run_line("create colltest -p 2")
+    sh.run_line("use colltest")
+    for i in range(10):
+        sh.run_line(f"set ck{i} s v")
+        sh.run_line(f"get ck{i} s")
+    coll = InfoCollector([onebox], interval_seconds=3600)
+    summary = coll.collect_once()
+    assert "colltest" in summary
+    assert summary["colltest"]["get_qps"] >= 0
+    coll.stop()
+
+
+def test_available_detector_probe(onebox, shell):
+    sh, _ = shell
+    sh.run_line("create test -p 2")  # the canary's default table
+    det = AvailableDetector([onebox], interval_seconds=3600)
+    assert det.probe_once() is True
+    rep = det.report()
+    assert rep["minute"] == 1.0
+    det.stop()
