@@ -48,6 +48,10 @@ ITERATION_THRESHOLD_TIME_MS = "replica.rocksdb_iteration_threshold_time_ms"
 SPLIT_VALIDATE_PARTITION_HASH = "replica.split.validate_partition_hash"
 USER_SPECIFIED_COMPACTION = "user_specified_compaction"
 
+# partition-split ownership mask, spread post-split so compaction GCs keys
+# the partition no longer owns (reference set_partition_version)
+REPLICA_PARTITION_VERSION = "replica.partition_version"
+
 # range-read limiter thresholds (src/server/range_read_limiter.h flags)
 ROCKSDB_ITERATION_THRESHOLD_COUNT = "replica.rocksdb_max_iteration_count"
 ROCKSDB_ITERATION_THRESHOLD_SIZE = "replica.rocksdb_max_iteration_size"

@@ -44,6 +44,9 @@ class StaticResolver:
     def partition_count(self) -> int:
         return len(self._addresses)
 
+    def refresh(self) -> None:
+        pass  # static map: nothing to re-query
+
     def resolve(self, pidx: int, refresh: bool = False):
         return self._addresses[pidx]
 
@@ -67,14 +70,24 @@ class PegasusClient:
     def _call(self, code: str, pidx: int, phash: int, req_obj, resp_cls):
         body = codec.encode(req_obj)
         last = None
-        for attempt in range(2):
-            addr = self.resolver.resolve(pidx, refresh=attempt > 0)
+        for attempt in range(3):
+            if attempt > 0:
+                self.resolver.refresh()
+                if phash:
+                    # reconfiguration may have CHANGED the partition count
+                    # (split): recompute the route, not just the address
+                    pidx = phash % self.resolver.partition_count
+            addr = self.resolver.resolve(pidx)
             try:
                 conn = self.pool.get(addr)
                 _, rbody = conn.call(code, body, app_id=self.resolver.app_id,
                                      partition_index=pidx, partition_hash=phash,
                                      timeout=self.timeout)
                 return codec.decode(resp_cls, rbody) if resp_cls else None
+            except OSError as e:  # dead node: connect refused/reset
+                last = e
+                self.pool.invalidate(addr)
+                continue
             except RpcError as e:
                 last = e
                 if e.err in (ERR_NETWORK_FAILURE, ERR_TIMEOUT,

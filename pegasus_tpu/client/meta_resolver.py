@@ -34,6 +34,9 @@ class MetaResolver:
         with self._lock:
             return self._app.partition_count
 
+    def refresh(self) -> None:
+        self._refresh()
+
     def resolve(self, pidx: int, refresh: bool = False):
         if refresh:
             self._refresh()

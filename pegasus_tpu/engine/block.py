@@ -37,13 +37,13 @@ def _as_arena(chunks) -> tuple:
 
 
 def _gather_arena(arena, offsets, lengths, idx):
-    """Vectorized gather of variable-length slices: new compact arena for idx."""
-    from .. import native
+    """Vectorized gather of variable-length slices: new compact arena for idx.
 
+    Uniform-length records take numpy's 2D fancy-index (measured faster
+    than a per-record memcpy loop on this host); variable-length gathers
+    use the native kernel, falling back to the repeat/cumsum construction.
+    """
     n = len(lengths)
-    if n and len(idx) and native.available():
-        out, new_off = native.gather_arena(arena, offsets, lengths, idx)
-        return out, new_off, lengths[idx]
     if n and len(idx):
         # uniform-length fast path (common: fixed-size records): 2D reshape
         # gather is a straight memcpy per row instead of repeat/cumsum work
@@ -54,6 +54,11 @@ def _gather_arena(arena, offsets, lengths, idx):
             out = arena.reshape(n, l0)[idx].reshape(-1)
             new_off = np.arange(len(idx), dtype=np.int64) * l0
             return out, new_off, np.full(len(idx), l0, np.int32)
+        from .. import native
+
+        if native.available():
+            out, new_off = native.gather_arena(arena, offsets, lengths, idx)
+            return out, new_off, lengths[idx]
     sel_off = offsets[idx]
     sel_len = lengths[idx].astype(np.int64)
     total = int(sel_len.sum())

@@ -1,0 +1,134 @@
+"""Bulk load: external-file ingestion through the engine's device sort.
+
+Mirror of the reference bulk-load framework's storage side (SURVEY.md §2.4
+'Bulk load framework'; engine ingestion pegasus_write_service_impl.h:484 +
+rocksdb_wrapper.cpp:185 IngestExternalFile): a provider directory holds
+per-partition ingest sets; each replica ingests its partition's files.
+
+TPU-first twist: the reference requires pre-sorted SSTs from an offline
+Spark job; here ingest sets may be UNSORTED record files — the external
+sort runs as the same device kernel as flush (ops.sort_block), making
+bulk load the second big batched-kernel consumer (SURVEY §7 M6).
+
+Ingest file format: either a native SST (engine/sstable.py, ingested
+as-is after a sortedness check) or a "raw set" file:
+
+    magic "PGRAW1\n" then framed records
+    [u16 hk_len][hash_key][u32 sk_len][sort_key][u32 v_len][value][u32 ttl]
+
+Provider layout (the bulk_load_provider_root):
+    <root>/<app_name>/<partition_count>/<pidx>/*.sst|*.raw
+    <root>/<app_name>/bulk_load_metadata (json: file list + sizes)
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..base.key_schema import generate_key
+from .block import KVBlock
+from .sstable import MAGIC as SST_MAGIC, SSTable
+
+RAW_MAGIC = b"PGRAW1\n"
+
+
+def write_raw_set(path: str, records) -> int:
+    """records: iterable of (hash_key, sort_key, value, ttl_seconds_abs).
+    Returns record count. The offline-producer helper (the Spark job role)."""
+    n = 0
+    with open(path, "wb") as f:
+        f.write(RAW_MAGIC)
+        for hk, sk, value, ttl in records:
+            f.write(struct.pack("<H", len(hk)))
+            f.write(hk)
+            f.write(struct.pack("<I", len(sk)))
+            f.write(sk)
+            f.write(struct.pack("<I", len(value)))
+            f.write(value)
+            f.write(struct.pack("<I", ttl))
+            n += 1
+    return n
+
+
+def read_raw_set(path: str):
+    """-> yields (hash_key, sort_key, value, expire_ts)."""
+    with open(path, "rb") as f:
+        if f.read(len(RAW_MAGIC)) != RAW_MAGIC:
+            raise ValueError(f"{path}: bad raw-set magic")
+        data = f.read()
+    off = 0
+    while off < len(data):
+        (hl,) = struct.unpack_from("<H", data, off)
+        off += 2
+        hk = bytes(data[off:off + hl]); off += hl
+        (sl,) = struct.unpack_from("<I", data, off); off += 4
+        sk = bytes(data[off:off + sl]); off += sl
+        (vl,) = struct.unpack_from("<I", data, off); off += 4
+        v = bytes(data[off:off + vl]); off += vl
+        (ttl,) = struct.unpack_from("<I", data, off); off += 4
+        yield hk, sk, v, ttl
+
+
+def load_ingest_file(path: str, schema) -> KVBlock:
+    """One ingest file -> a KVBlock (values encoded with the table schema)."""
+    with open(path, "rb") as f:
+        magic = f.read(len(SST_MAGIC))
+    if magic == SST_MAGIC:
+        return SSTable(path).block()
+    rows = []
+    for hk, sk, v, ttl in read_raw_set(path):
+        rows.append((generate_key(hk, sk), schema.generate_value(ttl, 0, v),
+                     ttl, False))
+    return KVBlock.from_records(rows)
+
+
+def metadata_path(provider_root: str, app_name: str) -> str:
+    return os.path.join(provider_root, app_name, "bulk_load_metadata")
+
+
+def write_metadata(provider_root: str, app_name: str, partition_count: int) -> dict:
+    """Scan the provider tree and write the metadata file the meta server
+    validates before starting a load (reference bulk_load_metadata)."""
+    app_root = os.path.join(provider_root, app_name, str(partition_count))
+    meta = {"app_name": app_name, "partition_count": partition_count,
+            "partitions": {}}
+    for pidx in range(partition_count):
+        pdir = os.path.join(app_root, str(pidx))
+        files = []
+        if os.path.isdir(pdir):
+            for name in sorted(os.listdir(pdir)):
+                p = os.path.join(pdir, name)
+                files.append({"name": name, "size": os.path.getsize(p)})
+        meta["partitions"][str(pidx)] = files
+    with open(metadata_path(provider_root, app_name), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+def ingest_partition(engine, provider_root: str, app_name: str,
+                     partition_count: int, pidx: int, schema,
+                     verify_hash: bool = True) -> dict:
+    """Replica-side ingestion (the ingestion_files write): load every file
+    of this partition's ingest set, device-sort, drop rows that don't hash
+    here, and install as L0 runs. Returns stats."""
+    from ..ops.compact import CompactOptions, compact_blocks
+
+    pdir = os.path.join(provider_root, app_name, str(partition_count), str(pidx))
+    if not os.path.isdir(pdir):
+        return {"files": 0, "records": 0}
+    blocks = []
+    for name in sorted(os.listdir(pdir)):
+        blocks.append(load_ingest_file(os.path.join(pdir, name), schema))
+    if not blocks:
+        return {"files": 0, "records": 0}
+    opts = CompactOptions(
+        backend=engine.opts.backend, prefix_u32=engine.opts.prefix_u32,
+        filter=verify_hash,
+        pidx=pidx, partition_mask=(partition_count - 1) if verify_hash else 0,
+        bottommost=False, runs_sorted=False, now=0,
+    )
+    merged = compact_blocks(blocks, opts).block
+    engine.install_ingested_block(merged)
+    return {"files": len(blocks), "records": int(merged.n)}

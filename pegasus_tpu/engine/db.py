@@ -483,6 +483,23 @@ class LsmEngine:
             self._write_manifest_locked()
         return stats
 
+    def install_ingested_block(self, block: KVBlock) -> None:
+        """Bulk-load install: a sorted, deduped block becomes a fresh L0 run
+        (the IngestExternalFile seam, reference rocksdb_wrapper.cpp:185).
+        Like RocksDB's default IngestExternalFile, the ingested data gets
+        the NEWEST position (a fresh sequence number): it shadows any
+        existing version of the same keys, at every level."""
+        self.flush()  # RocksDB ingest flushes first so the fresh seqno wins
+        with self._lock:
+            path = os.path.join(self.path, self._alloc_file_locked())
+        write_sst(path, block, {"level": 0, "ingested": True,
+                                "last_flushed_decree": self._durable_decree})
+        with self._lock:
+            self._l0.insert(0, SSTable(path))
+            self._write_manifest_locked()
+        if len(self._l0) >= self.opts.l0_compaction_trigger:
+            self.compact()
+
     # ------------------------------------------------------------- checkpoint
 
     def checkpoint(self, dest_dir: str) -> int:

@@ -40,6 +40,9 @@ WRITE_CODES = {
     server_impl.RPC_CHECK_AND_SET: (msg.CheckAndSetRequest, msg.CheckAndSetResponse),
     server_impl.RPC_CHECK_AND_MUTATE: (msg.CheckAndMutateRequest,
                                        msg.CheckAndMutateResponse),
+    server_impl.RPC_DUPLICATE: (msg.DuplicateRequest, msg.DuplicateResponse),
+    server_impl.RPC_BULK_LOAD_INGEST: (msg.BulkLoadIngestRequest,
+                                       msg.BulkLoadIngestResponse),
 }
 
 

@@ -23,17 +23,11 @@ from .range_read_limiter import RangeReadLimiter
 from .scan_context import ScanContext, ScanContextCache
 from .write_service import WriteService
 
-# write op codes (task-code names follow src/include/rrdb/rrdb.code.definition.h)
-RPC_PUT = "RPC_RRDB_RRDB_PUT"
-RPC_MULTI_PUT = "RPC_RRDB_RRDB_MULTI_PUT"
-RPC_REMOVE = "RPC_RRDB_RRDB_REMOVE"
-RPC_MULTI_REMOVE = "RPC_RRDB_RRDB_MULTI_REMOVE"
-RPC_INCR = "RPC_RRDB_RRDB_INCR"
-RPC_CHECK_AND_SET = "RPC_RRDB_RRDB_CHECK_AND_SET"
-RPC_CHECK_AND_MUTATE = "RPC_RRDB_RRDB_CHECK_AND_MUTATE"
-RPC_DUPLICATE = "RPC_RRDB_RRDB_DUPLICATE"
-
-BATCHABLE = {RPC_PUT, RPC_REMOVE}
+# write op codes live in rpc.task_codes; re-exported for existing callers
+from ..rpc.task_codes import (BATCHABLE, RPC_BULK_LOAD_INGEST,  # noqa: F401
+                              RPC_CHECK_AND_MUTATE, RPC_CHECK_AND_SET,
+                              RPC_DUPLICATE, RPC_INCR, RPC_MULTI_PUT,
+                              RPC_MULTI_REMOVE, RPC_PUT, RPC_REMOVE)
 
 
 class PegasusServer:
@@ -90,6 +84,11 @@ class PegasusServer:
 
             self.engine.opts.user_ops = tuple(parse_user_specified_compaction(
                 envs[consts.USER_SPECIFIED_COMPACTION]))
+        pv = envs.get(consts.REPLICA_PARTITION_VERSION)
+        if pv is not None:
+            # post-split ownership mask: compaction drops keys whose hash no
+            # longer routes here (reference set_partition_version)
+            self.engine.opts.partition_mask = max(0, int(pv))
         scenario = envs.get(consts.ENV_USAGE_SCENARIO_KEY)
         if scenario:
             self.set_usage_scenario(scenario)
@@ -193,6 +192,12 @@ class PegasusServer:
         if code == RPC_CHECK_AND_MUTATE:
             counters.rate(self._pfx + "check_and_mutate_qps").increment()
             return ws.check_and_mutate(decree, req, now=now)
+        if code == RPC_DUPLICATE:
+            counters.rate(self._pfx + "duplicate_qps").increment()
+            return ws.duplicate(decree, req, now=now)
+        if code == RPC_BULK_LOAD_INGEST:
+            counters.rate(self._pfx + "bulk_load_qps").increment()
+            return ws.ingestion_files(decree, req)
         raise ValueError(f"unknown write code {code}")
 
     # ------------------------------------------------------------- read path

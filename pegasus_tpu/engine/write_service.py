@@ -12,9 +12,20 @@ rejected mutations (empty_put), preserving the last_flushed_decree invariant.
 from ..base import key_schema
 from ..base.utils import epoch_now
 from ..base.value_schema import SCHEMAS, check_if_ts_expired, generate_timetag
-from ..rpc import messages as msg
+from ..rpc import messages as msg, task_codes
 from ..rpc.messages import CasCheckType, MutateOperation, Status
 from .db import LsmEngine, WriteBatch
+
+# inner request type per duplicable task code (duplicate_request.raw_message)
+_DUP_INNER = {
+    task_codes.RPC_PUT: msg.UpdateRequest,
+    task_codes.RPC_REMOVE: msg.KeyRequest,
+    task_codes.RPC_MULTI_PUT: msg.MultiPutRequest,
+    task_codes.RPC_MULTI_REMOVE: msg.MultiRemoveRequest,
+    task_codes.RPC_INCR: msg.IncrRequest,
+    task_codes.RPC_CHECK_AND_SET: msg.CheckAndSetRequest,
+    task_codes.RPC_CHECK_AND_MUTATE: msg.CheckAndMutateRequest,
+}
 
 
 def buf2int64(data: bytes):
@@ -245,6 +256,90 @@ class WriteService:
         self.engine.write(batch, decree)
         self._add_write_cu(req.hash_key, total, is_key=False)
         return resp
+
+    def ingestion_files(self, decree: int, req: msg.BulkLoadIngestRequest):
+        """Replicated bulk-load ingestion (the ingestion_files write,
+        reference pegasus_write_service_impl.h:484): every replica of the
+        partition applies this at the same decree, reading the SHARED
+        provider set — so bulk-loaded data has a decree and survives
+        failover like any other committed write."""
+        from .bulk_load import ingest_partition
+
+        resp = self._fill(msg.BulkLoadIngestResponse(), decree)
+        try:
+            stats = ingest_partition(self.engine, req.provider_root,
+                                     req.app_name, req.partition_count,
+                                     self.pidx, self._schema)
+            resp.ingested_records = stats["records"]
+        except (OSError, ValueError) as e:
+            resp.error = Status.IO_ERROR
+            print(f"[bulk_load] ingest failed: {e!r}")
+        self.empty_put(decree)  # the decree itself still advances
+        return resp
+
+    def duplicate(self, decree: int, req: msg.DuplicateRequest, now: int = None):
+        """Apply a mutation shipped from another cluster (the remote side of
+        pegasus_mutation_duplicator). verify_timetag resolves write-write
+        conflicts last-writer-wins with cluster-id tiebreak (value schema v1
+        timetag, reference pegasus_write_service::duplicate +
+        rocksdb_wrapper's verify_timetag get)."""
+        from ..rpc import codec, task_codes
+
+        resp = self._fill(msg.DuplicateResponse(), decree)
+        inner_cls = _DUP_INNER.get(req.task_code)
+        if inner_cls is None:
+            resp.error = Status.INVALID_ARGUMENT
+            resp.error_hint = f"non-duplicable task code {req.task_code}"
+            self.empty_put(decree)
+            return resp
+        inner = codec.decode(inner_cls, req.raw_message)
+        if req.verify_timetag and self._schema.VERSION >= 1 \
+                and hasattr(inner, "key"):
+            incoming = generate_timetag(req.timestamp, req.cluster_id,
+                                        req.task_code == task_codes.RPC_REMOVE)
+            raw = self.engine.get(inner.key, now=epoch_now() if now is None else now)
+            if raw is not None and self._schema.extract_timetag(raw) > incoming:
+                # local version is newer: drop the stale duplicate
+                self.empty_put(decree)
+                resp.error_hint = "ignored stale duplicate"
+                return resp
+        # apply with the ORIGIN timestamp so timetags carry provenance
+        if req.task_code == task_codes.RPC_PUT:
+            value = self._encode_with_origin(inner.value, inner.expire_ts_seconds,
+                                             req.timestamp, req.cluster_id, False)
+            self.engine.write(WriteBatch().put(inner.key, value,
+                                               inner.expire_ts_seconds), decree)
+        elif req.task_code == task_codes.RPC_REMOVE:
+            self.engine.write(WriteBatch().delete(inner.key), decree)
+        elif req.task_code == task_codes.RPC_MULTI_PUT:
+            batch = WriteBatch()
+            for kv in inner.kvs:
+                key = key_schema.generate_key(inner.hash_key, kv.key)
+                value = self._encode_with_origin(kv.value, inner.expire_ts_seconds,
+                                                 req.timestamp, req.cluster_id,
+                                                 False)
+                batch.put(key, value, inner.expire_ts_seconds)
+            self.engine.write(batch, decree)
+        elif req.task_code == task_codes.RPC_MULTI_REMOVE:
+            batch = WriteBatch()
+            for sk in inner.sort_keys:
+                batch.delete(key_schema.generate_key(inner.hash_key, sk))
+            self.engine.write(batch, decree)
+        else:
+            # read-modify-write codes re-run locally (incr/CAS duplicate as
+            # their effect is deterministic given the shipped arguments)
+            handler = {task_codes.RPC_INCR: self.incr,
+                       task_codes.RPC_CHECK_AND_SET: self.check_and_set,
+                       task_codes.RPC_CHECK_AND_MUTATE: self.check_and_mutate}
+            handler[req.task_code](decree, inner, now=now)
+        return resp
+
+    def _encode_with_origin(self, user_data, expire_ts, timestamp_us,
+                            cluster_id, deleted) -> bytes:
+        timetag = 0
+        if self._schema.VERSION >= 1:
+            timetag = generate_timetag(timestamp_us, cluster_id, deleted)
+        return self._schema.generate_value(expire_ts, timetag, user_data)
 
     # ------------------------------------------------- batched put/remove
 

@@ -1,0 +1,84 @@
+"""Spatial cell scheme: Morton (Z-order) curve over quantized lat/lng.
+
+The reference indexes with s2geometry cell ids (src/geo/lib/geo_client.h:
+hash_key = level-`min_level` S2 cell, sort_key = deeper cell path). That
+library isn't available here, so this build uses an equivalent scheme of
+its own: interleave the bits of quantized latitude/longitude into a Morton
+code; a "cell at level L" is the top 2*L bits. Morton cells share S2's key
+property for this workload — nearby points share prefixes — so the same
+dual-table layout and covering-scan search work unchanged.
+
+Level semantics: level L splits the world into 2^L x 2^L cells; cell edge
+is ~(180/2^L) degrees of latitude (~20000km/2^L at the equator).
+"""
+
+import math
+
+EARTH_RADIUS_M = 6371000.9
+_BITS = 30  # quantization bits per axis
+
+
+def _quantize(v: float, lo: float, hi: float) -> int:
+    x = (v - lo) / (hi - lo)
+    return min((1 << _BITS) - 1, max(0, int(x * (1 << _BITS))))
+
+
+def _spread(v: int) -> int:
+    """Insert a zero bit between every bit of v (30 -> 60 bits)."""
+    out = 0
+    for i in range(_BITS):
+        out |= ((v >> i) & 1) << (2 * i)
+    return out
+
+
+def morton(lat: float, lng: float) -> int:
+    """60-bit interleaved cell code, lat bits even, lng bits odd."""
+    return _spread(_quantize(lat, -90.0, 90.0)) | (
+        _spread(_quantize(lng, -180.0, 180.0)) << 1)
+
+
+def cell_id(lat: float, lng: float, level: int) -> int:
+    """Top 2*level bits of the Morton code: the level-L cell."""
+    return morton(lat, lng) >> (2 * (_BITS - level))
+
+
+def cell_token(cid: int, level: int) -> bytes:
+    """Fixed-width printable token for use as a hash_key."""
+    width = -(-2 * level // 4)  # hex digits
+    return b"%0*x" % (width, cid)
+
+
+def haversine_m(lat1, lng1, lat2, lng2) -> float:
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = p2 - p1
+    dl = math.radians(lng2 - lng1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * EARTH_RADIUS_M * math.asin(min(1.0, math.sqrt(a)))
+
+
+MAX_COVERING_CELLS = 4096
+
+
+def covering_cells(lat: float, lng: float, radius_m: float, level: int) -> list:
+    """Level-L cells covering the search circle's bounding box (the
+    gen_search_cap covering role). The sample grid step matches the cell
+    edge so no covering cell between samples is skipped, whatever the
+    radius/level ratio; the cell count is capped (huge radii should use a
+    coarser level, like S2 covering limits). Returns sorted unique ids."""
+    dlat = math.degrees(radius_m / EARTH_RADIUS_M)
+    coslat = max(0.01, math.cos(math.radians(lat)))
+    dlng = math.degrees(radius_m / (EARTH_RADIUS_M * coslat))
+    cell_h = 180.0 / (1 << level)   # cell edge in latitude degrees
+    cell_w = 360.0 / (1 << level)
+    steps_lat = min(255, int(2 * dlat / cell_h) + 2)
+    steps_lng = min(255, int(2 * dlng / cell_w) + 2)
+    cells = set()
+    for i in range(steps_lat + 1):
+        for j in range(steps_lng + 1):
+            la = min(90.0, max(-90.0, lat - dlat + 2 * dlat * i / steps_lat))
+            ln = lng - dlng + 2 * dlng * j / steps_lng
+            ln = (ln + 180.0) % 360.0 - 180.0
+            cells.add(cell_id(la, ln, level))
+            if len(cells) >= MAX_COVERING_CELLS:
+                return sorted(cells)
+    return sorted(cells)

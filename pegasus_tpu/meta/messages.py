@@ -121,6 +121,59 @@ class ListNodesResponse:
     nodes: List[NodeInfo] = field(default_factory=list)
 
 
+@dataclass
+class SplitAppRequest:
+    app_name: str = ""
+
+
+@dataclass
+class SplitAppResponse:
+    error: int = 0
+    error_text: str = ""
+    new_partition_count: int = 0
+
+
+@dataclass
+class BackupAppRequest:
+    app_name: str = ""
+    backup_root: str = ""             # block-service path (local FS provider)
+
+
+@dataclass
+class BackupAppResponse:
+    error: int = 0
+    error_text: str = ""
+    backup_id: int = 0
+
+
+@dataclass
+class RestoreAppRequest:
+    backup_root: str = ""
+    backup_id: int = 0
+    old_app_name: str = ""
+    new_app_name: str = ""
+
+
+@dataclass
+class RestoreAppResponse:
+    error: int = 0
+    error_text: str = ""
+    app_id: int = 0
+
+
+@dataclass
+class StartBulkLoadRequest:
+    app_name: str = ""
+    provider_root: str = ""
+
+
+@dataclass
+class StartBulkLoadResponse:
+    error: int = 0
+    error_text: str = ""
+    ingested_records: int = 0
+
+
 # --- meta -> replica node commands ---
 
 @dataclass
@@ -133,6 +186,9 @@ class OpenReplicaRequest:
     secondaries: List[str] = field(default_factory=list)
     learn_from: str = ""              # non-empty: seed from this node first
     envs_json: str = "{}"
+    partition_count: int = 0          # for partition-hash routing checks
+    learn_pidx: int = -1              # learn from a DIFFERENT pidx (split)
+    restore_dir: str = ""             # seed a fresh engine from this dir
 
 
 @dataclass

@@ -28,12 +28,18 @@ RPC_CM_LIST_APPS = "RPC_CM_LIST_APPS"
 RPC_CM_QUERY_CONFIG = "RPC_CM_QUERY_PARTITION_CONFIG_BY_INDEX"
 RPC_CM_SET_APP_ENVS = "RPC_CM_UPDATE_APP_ENV"
 RPC_CM_LIST_NODES = "RPC_CM_LIST_NODES"
+RPC_CM_SPLIT_APP = "RPC_CM_START_PARTITION_SPLIT"
+RPC_CM_BACKUP_APP = "RPC_CM_START_BACKUP_APP"
+RPC_CM_RESTORE_APP = "RPC_CM_START_RESTORE"
+RPC_CM_START_BULK_LOAD = "RPC_CM_START_BULK_LOAD"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
 # meta -> replica node
 RPC_OPEN_REPLICA = "RPC_CONFIG_PROPOSAL_OPEN_REPLICA"
 RPC_CLOSE_REPLICA = "RPC_CONFIG_PROPOSAL_CLOSE_REPLICA"
 RPC_REPLICA_STATE = "RPC_QUERY_REPLICA_STATE"
+RPC_COLD_BACKUP = "RPC_COLD_BACKUP"
+RPC_BULK_LOAD = "RPC_BULK_LOAD"
 
 
 class MetaServer:
@@ -60,6 +66,10 @@ class MetaServer:
             RPC_CM_QUERY_CONFIG: self._on_query_config,
             RPC_CM_SET_APP_ENVS: self._on_set_app_envs,
             RPC_CM_LIST_NODES: self._on_list_nodes,
+            RPC_CM_SPLIT_APP: self._on_split_app,
+            RPC_CM_BACKUP_APP: self._on_backup_app,
+            RPC_CM_RESTORE_APP: self._on_restore_app,
+            RPC_CM_START_BULK_LOAD: self._on_start_bulk_load,
             RPC_FD_BEACON: self._on_beacon,
         }
 
@@ -75,14 +85,20 @@ class MetaServer:
             if not alive:
                 return codec.encode(mm.CreateAppResponse(
                     error=1, error_text="no alive replica nodes"))
+            # partition counts are powers of two: split doubles them and the
+            # ownership filter is a bit mask (hash & (count-1) == pidx), so
+            # mask and modulo must agree (reference requires the same)
+            pcount = 1
+            while pcount < max(1, req.partition_count):
+                pcount <<= 1
             app = mm.AppInfo(app_name=req.app_name, app_id=self._next_app_id,
-                             partition_count=req.partition_count,
+                             partition_count=pcount,
                              replica_count=min(req.replica_count, len(alive)),
                              envs_json=req.envs_json)
             self._next_app_id += 1
             self._apps[req.app_name] = app
             parts = []
-            for pidx in range(req.partition_count):
+            for pidx in range(pcount):
                 members = self._pick_nodes_locked(app.replica_count, pidx)
                 pc = mm.PartitionConfig(pidx=pidx, ballot=1,
                                         primary=members[0],
@@ -147,6 +163,180 @@ class MetaServer:
                     secondaries=pc.secondaries, envs_json=app.envs_json),
                     ignore_errors=True)
         return codec.encode(mm.SetAppEnvsResponse())
+
+    # ------------------------------------------------------ split/backup/load
+
+    def _on_split_app(self, header, body) -> bytes:
+        """Online partition split: double the partition count (SURVEY §2.4
+        'Partition split'; reference meta split + engine-side stale-key GC).
+        Child partition pidx+n is seeded from parent pidx via the learn
+        path on the same member set; every replica then gets
+        partition_version = 2n-1 so compaction GCs keys it no longer owns
+        (key_ttl_compaction_filter.h:107 analogue)."""
+        req = codec.decode(mm.SplitAppRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.SplitAppResponse(error=1,
+                                                        error_text="no such app"))
+            n = app.partition_count
+            parts = self._parts[app.app_id]
+            children = []
+            for pidx in range(n, 2 * n):
+                parent = parts[pidx - n]
+                pc = mm.PartitionConfig(pidx=pidx, ballot=1,
+                                        primary=parent.primary,
+                                        secondaries=list(parent.secondaries))
+                parts.append(pc)
+                children.append((parent, pc))
+            app.partition_count = 2 * n
+            envs = json.loads(app.envs_json)
+            envs["replica.partition_version"] = str(2 * n - 1)
+            app.envs_json = json.dumps(envs)
+            self._persist_locked()
+        for parent, pc in children:
+            # seed child from the parent's primary (full-copy learn); then
+            # the view installs with the child's own pidx
+            req_open = mm.OpenReplicaRequest(
+                app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+                ballot=pc.ballot, primary=pc.primary,
+                secondaries=pc.secondaries, envs_json=app.envs_json,
+                partition_count=2 * n, learn_from=parent.primary,
+                learn_pidx=parent.pidx)
+            for node in [pc.primary] + pc.secondaries:
+                self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
+                                   ignore_errors=True)
+        # re-push parents so they learn the new partition_version env
+        with self._lock:
+            parents = list(self._parts[app.app_id][:n])
+        for pc in parents:
+            self._install_partition(app, pc)
+        return codec.encode(mm.SplitAppResponse(new_partition_count=2 * n))
+
+    def _on_backup_app(self, header, body) -> bytes:
+        """Cold backup: every partition primary checkpoints into the backup
+        root (block-service local-FS provider), then backup metadata lands
+        beside them (reference cold backup to block service, SURVEY §2.4)."""
+        req = codec.decode(mm.BackupAppRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.BackupAppResponse(error=1,
+                                                         error_text="no such app"))
+            parts = list(self._parts[app.app_id])
+        backup_id = int(time.time() * 1000)
+        base = os.path.join(req.backup_root, str(backup_id), req.app_name)
+        for pc in parts:
+            dest = os.path.join(base, str(pc.pidx))
+            out = self._send_to_node(pc.primary, RPC_COLD_BACKUP,
+                                     mm.OpenReplicaRequest(
+                                         app_id=app.app_id, pidx=pc.pidx,
+                                         restore_dir=dest),
+                                     ignore_errors=True)
+            if out is None:
+                return codec.encode(mm.BackupAppResponse(
+                    error=1, error_text=f"partition {pc.pidx} backup failed"))
+        with open(os.path.join(base, "backup_metadata"), "w") as f:
+            json.dump({"app_name": app.app_name, "app_id": app.app_id,
+                       "partition_count": app.partition_count,
+                       "backup_id": backup_id, "envs_json": app.envs_json}, f)
+        return codec.encode(mm.BackupAppResponse(backup_id=backup_id))
+
+    def _on_restore_app(self, header, body) -> bytes:
+        """Restore a backup into a NEW table: create the app with the
+        backed-up partition count, each replica seeding its engine from the
+        backup dir at open (reference restore envs ROCKSDB_ENV_RESTORE_*,
+        pegasus_server_impl.cpp:1339-1393)."""
+        req = codec.decode(mm.RestoreAppRequest, body)
+        meta_file = os.path.join(req.backup_root, str(req.backup_id),
+                                 req.old_app_name, "backup_metadata")
+        try:
+            with open(meta_file) as f:
+                bmeta = json.load(f)
+        except OSError:
+            return codec.encode(mm.RestoreAppResponse(
+                error=1, error_text=f"no backup metadata at {meta_file}"))
+        with self._lock:
+            if req.new_app_name in self._apps:
+                return codec.encode(mm.RestoreAppResponse(
+                    error=1, error_text="app exists"))
+            alive = self._alive_nodes_locked()
+            if not alive:
+                return codec.encode(mm.RestoreAppResponse(
+                    error=1, error_text="no alive nodes"))
+            app = mm.AppInfo(app_name=req.new_app_name,
+                             app_id=self._next_app_id,
+                             partition_count=bmeta["partition_count"],
+                             replica_count=min(3, len(alive)),
+                             envs_json=bmeta.get("envs_json", "{}"))
+            self._next_app_id += 1
+            self._apps[req.new_app_name] = app
+            parts = []
+            for pidx in range(app.partition_count):
+                members = self._pick_nodes_locked(app.replica_count, pidx)
+                parts.append(mm.PartitionConfig(pidx=pidx, ballot=1,
+                                                primary=members[0],
+                                                secondaries=members[1:]))
+            self._parts[app.app_id] = parts
+            self._persist_locked()
+        for pc in parts:
+            src = os.path.join(req.backup_root, str(req.backup_id),
+                               req.old_app_name, str(pc.pidx))
+            req_open = mm.OpenReplicaRequest(
+                app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+                ballot=pc.ballot, primary=pc.primary,
+                secondaries=pc.secondaries, envs_json=app.envs_json,
+                partition_count=app.partition_count, restore_dir=src)
+            for node in [pc.primary] + pc.secondaries:
+                self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
+                                   ignore_errors=True)
+        return codec.encode(mm.RestoreAppResponse(app_id=app.app_id))
+
+    def _on_start_bulk_load(self, header, body) -> bytes:
+        """Meta-driven bulk load: validate provider metadata, then each
+        partition primary ingests its set (reference bulk-load DDL,
+        SURVEY §2.4 'Bulk load framework')."""
+        from ..engine import bulk_load as bl
+
+        req = codec.decode(mm.StartBulkLoadRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.StartBulkLoadResponse(
+                    error=1, error_text="no such app"))
+            parts = list(self._parts[app.app_id])
+        try:
+            with open(bl.metadata_path(req.provider_root, req.app_name)) as f:
+                bmeta = json.load(f)
+        except OSError:
+            return codec.encode(mm.StartBulkLoadResponse(
+                error=1, error_text="no bulk_load_metadata"))
+        if bmeta["partition_count"] != app.partition_count:
+            return codec.encode(mm.StartBulkLoadResponse(
+                error=1, error_text="partition count mismatch"))
+        from ..rpc import messages as rpc_msg
+        from ..rpc.task_codes import RPC_BULK_LOAD_INGEST
+
+        total = 0
+        for pc in parts:
+            ingest = rpc_msg.BulkLoadIngestRequest(
+                provider_root=req.provider_root, app_name=req.app_name,
+                partition_count=app.partition_count)
+            # route through the primary's WRITE path: the ingestion command
+            # replicates via PacificA so every replica loads the set at the
+            # same decree (survives failover)
+            out = self._send_to_node(pc.primary, RPC_BULK_LOAD_INGEST, ingest,
+                                     app_id=app.app_id, pidx=pc.pidx,
+                                     ignore_errors=True)
+            if out is None:
+                return codec.encode(mm.StartBulkLoadResponse(
+                    error=1, error_text=f"partition {pc.pidx} ingest failed"))
+            resp = codec.decode(rpc_msg.BulkLoadIngestResponse, out)
+            if resp.error:
+                return codec.encode(mm.StartBulkLoadResponse(
+                    error=1, error_text=f"partition {pc.pidx} ingest error"))
+            total += resp.ingested_records
+        return codec.encode(mm.StartBulkLoadResponse(ingested_records=total))
 
     def _on_list_nodes(self, header, body) -> bytes:
         with self._lock:
@@ -241,7 +431,7 @@ class MetaServer:
         req = mm.OpenReplicaRequest(
             app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
             ballot=pc.ballot, primary=pc.primary, secondaries=pc.secondaries,
-            envs_json=app.envs_json)
+            envs_json=app.envs_json, partition_count=app.partition_count)
         for node in [pc.primary] + pc.secondaries:
             if node:
                 self._send_to_node(node, RPC_OPEN_REPLICA, req,
@@ -251,7 +441,8 @@ class MetaServer:
                 app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
                 ballot=pc.ballot, primary=pc.primary,
                 secondaries=pc.secondaries + [node],
-                learn_from=pc.primary, envs_json=app.envs_json)
+                learn_from=pc.primary, envs_json=app.envs_json,
+                partition_count=app.partition_count)
             self._send_to_node(node, RPC_OPEN_REPLICA, lreq, ignore_errors=True)
 
     # ------------------------------------------------------------- helpers
@@ -264,11 +455,13 @@ class MetaServer:
         except (RpcError, OSError):
             return None
 
-    def _send_to_node(self, node: str, code: str, req, ignore_errors=False):
+    def _send_to_node(self, node: str, code: str, req, ignore_errors=False,
+                      app_id: int = 0, pidx: int = 0):
         host, _, port = node.rpartition(":")
         try:
             conn = self.pool.get((host, int(port)))
-            _, body = conn.call(code, codec.encode(req), timeout=10.0)
+            _, body = conn.call(code, codec.encode(req), timeout=60.0,
+                                app_id=app_id, partition_index=pidx)
             return body
         except (RpcError, OSError):
             if ignore_errors:

@@ -1,0 +1,3 @@
+from .proxy import RedisProxy
+
+__all__ = ["RedisProxy"]

@@ -1,0 +1,136 @@
+"""Cross-cluster duplication: ship committed mutations to a remote cluster.
+
+Mirror of pegasus_mutation_duplicator + the rDSN duplication framework
+(SURVEY.md §2.4 'Duplication framework'; reference
+src/server/pegasus_mutation_duplicator.{h,cpp}): a hook on the replica's
+commit path enqueues every mutation; a shipper thread replays them to the
+remote cluster as RPC_RRDB_RRDB_DUPLICATE writes carrying the origin
+timestamp + cluster id. The remote applies them through its own PacificA
+(so duplicates are themselves replicated), with last-writer-wins conflict
+resolution via the value-schema timetag (verify_timetag). Shipping is
+in-order overall, which subsumes the reference's per-hash FIFO guarantee.
+"""
+
+import threading
+
+from ..base import key_schema
+from ..engine.replica_service import WRITE_CODES
+from ..engine.server_impl import RPC_DUPLICATE
+from ..rpc import codec
+from ..rpc import messages as msg
+from ..rpc.transport import ConnectionPool, RpcError
+from .mutation_log import LogMutation
+
+
+class MutationDuplicator:
+    """Attach with `replica.commit_hooks.append(dup.on_commit)`."""
+
+    def __init__(self, remote_resolver, cluster_id: int = 1,
+                 fail_mode: str = "slow"):
+        """remote_resolver: client resolver for the remote table;
+        fail_mode: 'slow' blocks/retries (default), 'skip' drops on error
+        (reference dup fail-mode knob)."""
+        self.resolver = remote_resolver
+        self.cluster_id = cluster_id
+        self.fail_mode = fail_mode
+        self.pool = ConnectionPool()
+        self._queue = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self.shipped = 0
+        self.skipped = 0
+        self.last_shipped_decree = 0
+        self._thread = threading.Thread(target=self._ship_loop, daemon=True)
+        self._thread.start()
+
+    # ----------------------------------------------------------------- hook
+
+    def on_commit(self, m: LogMutation) -> None:
+        with self._cv:
+            self._queue.append(m)
+            self._cv.notify()
+
+    # ----------------------------------------------------------------- ship
+
+    def _ship_loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(0.2)
+                if self._stop and not self._queue:
+                    return
+                m = self._queue.pop(0)
+            self._ship_one(m)
+
+    def _ship_one(self, m: LogMutation) -> None:
+        import time
+
+        for code, body in zip(m.codes, m.bodies):
+            if code == RPC_DUPLICATE:
+                continue  # never re-duplicate a duplicate (loop guard)
+            req = msg.DuplicateRequest(
+                timestamp=m.timestamp_us, task_code=code, raw_message=body,
+                cluster_id=self.cluster_id, verify_timetag=True)
+            key = _routing_key(code, body)
+            attempts = 0
+            while not self._stop:
+                try:
+                    self._send(req, key, refresh=attempts > 0)
+                    self.shipped += 1
+                    break
+                except (RpcError, OSError):
+                    attempts += 1
+                    if self.fail_mode == "skip":
+                        self.skipped += 1
+                        break
+                    # fail_mode='slow': keep the backlog, retry with backoff
+                    # (the reference's dup_fail_mode=slow holds the pipeline)
+                    time.sleep(min(2.0, 0.05 * attempts))
+        self.last_shipped_decree = max(self.last_shipped_decree, m.decree)
+
+    def _send(self, req: msg.DuplicateRequest, key: bytes,
+              refresh: bool = False) -> None:
+        if refresh:
+            self.resolver.refresh()
+        h = key_schema.key_hash(key)
+        pidx = h % self.resolver.partition_count
+        addr = self.resolver.resolve(pidx)
+        try:
+            conn = self.pool.get(addr)
+            conn.call(RPC_DUPLICATE, codec.encode(req),
+                      app_id=self.resolver.app_id, partition_index=pidx,
+                      partition_hash=h, timeout=10.0)
+        except (RpcError, OSError):
+            self.pool.invalidate(addr)
+            raise
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait until the backlog drains (tests / graceful shutdown)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._cv:
+                if not self._queue:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+        self.pool.close()
+
+
+def _routing_key(code: str, body: bytes) -> bytes:
+    """The hash-carrying key of a mutation (get_hash_from_request role,
+    reference pegasus_mutation_duplicator.cpp)."""
+    req_cls, _ = WRITE_CODES[code]
+    req = codec.decode(req_cls, body)
+    if hasattr(req, "key"):
+        return req.key
+    if hasattr(req, "hash_key"):
+        return key_schema.generate_key(req.hash_key, b"")
+    raise ValueError(f"cannot route duplicate of {code}")

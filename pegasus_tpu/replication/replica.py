@@ -81,6 +81,7 @@ class Replica:
                                     pidx=pidx, options=options, server=name)
         self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
         self._uncommitted = {}   # decree -> LogMutation (prepared, not applied)
+        self.commit_hooks = []   # fn(LogMutation) after commit (duplication)
         self.last_committed = self.server.engine.last_committed_decree()
         self.last_prepared = self.last_committed
         self._recover_from_log()
@@ -199,6 +200,8 @@ class Replica:
                 d, m.timestamp_us, reqs, now=now)
             last_resp = resps[0] if resps else None
             self.last_committed = d
+            for hook in self.commit_hooks:
+                hook(m)
         return last_resp
 
     # --------------------------------------------------------------- learner

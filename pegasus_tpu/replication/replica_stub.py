@@ -85,6 +85,10 @@ class ReplicaStub:
         self.rpc.register(RPC_OPEN_REPLICA, self._on_open_replica)
         self.rpc.register(RPC_CLOSE_REPLICA, self._on_close_replica)
         self.rpc.register(RPC_REPLICA_STATE, self._on_replica_state)
+        from ..meta.meta_server import RPC_BULK_LOAD, RPC_COLD_BACKUP
+
+        self.rpc.register(RPC_COLD_BACKUP, self._on_cold_backup)
+        self.rpc.register(RPC_BULK_LOAD, self._on_bulk_load)
         self.rpc.register(RPC_PREPARE, self._on_prepare)
         self.rpc.register(RPC_LEARN, self._on_learn)
         from ..runtime.remote_command import RemoteCommandService
@@ -139,17 +143,29 @@ class ReplicaStub:
             rep = self._replicas.get(key)
             if rep is None:
                 path = os.path.join(self.root, f"{req.app_id}.{req.pidx}")
+                if req.restore_dir and not os.path.exists(
+                        os.path.join(path, "data", "MANIFEST")):
+                    self._seed_from_restore(path, req.restore_dir)
                 rep = Replica(f"{self.address}", path, req.app_id, req.pidx,
                               self.options_factory(),
                               peers=self._peer_factory(req.app_id, req.pidx))
                 self._replicas[key] = rep
-                self._service.add_replica(rep.server, self._partition_count(req))
-        if req.learn_from and req.learn_from != self.address:
-            peer = _RemotePeer(self, req.learn_from, req.app_id, req.pidx)
-            rep.learn_from(peer)
-            with self._lock:
-                self._service.remove_replica(req.app_id, req.pidx)
-                self._service.add_replica(rep.server, self._partition_count(req))
+                self._service.add_replica(rep.server, req.partition_count)
+        learn_self = (req.learn_from == self.address
+                      and (req.learn_pidx < 0 or req.learn_pidx == req.pidx))
+        if req.learn_from and not learn_self:
+            learn_pidx = req.learn_pidx if req.learn_pidx >= 0 else req.pidx
+            if req.learn_from == self.address:
+                with self._lock:
+                    src = self._replicas.get((req.app_id, learn_pidx))
+                peer = src  # in-process parent (split on the same node)
+            else:
+                peer = _RemotePeer(self, req.learn_from, req.app_id, learn_pidx)
+            if peer is not None:
+                rep.learn_from(peer)
+                with self._lock:
+                    self._service.remove_replica(req.app_id, req.pidx)
+                    self._service.add_replica(rep.server, req.partition_count)
         rep.assume_view(GroupView(req.ballot, req.primary, req.secondaries))
         envs = json.loads(req.envs_json or "{}")
         if envs:
@@ -158,11 +174,18 @@ class ReplicaStub:
             last_committed=rep.last_committed, last_prepared=rep.last_prepared))
 
     @staticmethod
-    def _partition_count(req: mm.OpenReplicaRequest) -> int:
-        # partition count isn't in the open request; the hash check happens
-        # on the client-facing path where the resolver supplies pidx. Use a
-        # safe upper bound by disabling the modulo check (0 -> skip).
-        return 0
+    def _seed_from_restore(replica_path: str, restore_dir: str) -> None:
+        """Pre-open restore: copy backup checkpoint files into the data dir
+        (reference restore-rename at open, pegasus_server_impl.cpp:1339)."""
+        import shutil
+
+        data = os.path.join(replica_path, "data")
+        os.makedirs(data, exist_ok=True)
+        if os.path.isdir(restore_dir):
+            for name in os.listdir(restore_dir):
+                src = os.path.join(restore_dir, name)
+                if os.path.isfile(src):
+                    shutil.copy2(src, os.path.join(data, name))
 
     def _on_close_replica(self, header, body) -> bytes:
         req = codec.decode(mm.CloseReplicaRequest, body)
@@ -219,6 +242,30 @@ class ReplicaStub:
             files=[mm.FileBlob(n, d) for n, d in state["files"]],
             tail=[codec.encode(m) for m in state["tail"]],
             last_committed=state["last_committed"], ballot=state["ballot"]))
+
+    def _on_cold_backup(self, header, body) -> bytes:
+        """Checkpoint this partition into the backup destination dir."""
+        req = codec.decode(mm.OpenReplicaRequest, body)
+        with self._lock:
+            rep = self._replicas.get((req.app_id, req.pidx))
+        if rep is None:
+            raise RpcError(ERR_OBJECT_NOT_FOUND, "replica not served here")
+        decree = rep.server.engine.checkpoint(req.restore_dir)
+        return codec.encode(mm.OpenReplicaResponse(last_committed=decree))
+
+    def _on_bulk_load(self, header, body) -> bytes:
+        """Ingest this partition's bulk-load set from the provider root."""
+        from ..engine import bulk_load as bl
+
+        req = codec.decode(mm.OpenReplicaRequest, body)
+        with self._lock:
+            rep = self._replicas.get((req.app_id, req.pidx))
+        if rep is None:
+            raise RpcError(ERR_OBJECT_NOT_FOUND, "replica not served here")
+        stats = bl.ingest_partition(
+            rep.server.engine, req.restore_dir, req.app_name,
+            req.partition_count, req.pidx, rep.server._schema)
+        return int(stats["records"]).to_bytes(8, "little")
 
     # ------------------------------------------------------ remote commands
 

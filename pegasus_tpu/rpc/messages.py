@@ -268,6 +268,27 @@ class ScanResponse:  # scan_response
 
 
 @dataclass
+class BulkLoadIngestRequest:
+    """Replicated ingestion command (the ingestion_request role): every
+    replica of the partition reads the shared provider set and ingests it
+    at the same decree, so bulk-loaded data survives failover."""
+
+    provider_root: str = ""
+    app_name: str = ""
+    partition_count: int = 0
+
+
+@dataclass
+class BulkLoadIngestResponse:
+    error: int = 0
+    ingested_records: int = 0
+    app_id: int = 0
+    partition_index: int = 0
+    decree: int = 0
+    server: str = ""
+
+
+@dataclass
 class DuplicateRequest:  # duplicate_request
     timestamp: int = 0
     task_code: str = ""

@@ -1,0 +1,158 @@
+"""Geo client + redis proxy tests (reference: src/geo tests, redis_proxy_ut)."""
+
+import socket
+
+import pytest
+
+from pegasus_tpu.client import MetaResolver, PegasusClient
+from pegasus_tpu.geo import GeoClient, LatlngCodec, cells
+from pegasus_tpu.redis_proxy import RedisProxy
+from tests.test_satellites import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniCluster(tmp_path_factory.mktemp("georedis"), n_nodes=3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def geo(cluster):
+    common = cluster.create("geo_data", partitions=2)
+    index = cluster.create("geo_index", partitions=2)
+    g = GeoClient(common, index, min_level=12)
+    yield g
+    common.close()
+    index.close()
+
+
+def val(lat, lng, name=b"x"):
+    # '|'-separated value; codec defaults: lng at 4, lat at 5
+    return b"|".join([name, b"", b"", b"", repr(lng).encode(), repr(lat).encode()])
+
+
+def test_latlng_codec_roundtrip():
+    c = LatlngCodec()
+    v = c.encode(b"a|b", 31.23, 121.47)
+    assert c.decode(v) == (31.23, 121.47)
+    assert c.decode(b"no fields") is None
+    assert c.decode(val(99.0, 0.0)) is None  # out of range
+
+
+def test_morton_cells_share_prefixes():
+    # nearby points share their level-12 cell far more often than distant ones
+    a = cells.cell_id(31.2304, 121.4737, 12)
+    b = cells.cell_id(31.2305, 121.4738, 12)
+    c = cells.cell_id(-33.8688, 151.2093, 12)
+    assert a == b != c
+    assert cells.haversine_m(31.2304, 121.4737, 31.2305, 121.4738) < 20
+
+
+def test_geo_set_get_search(geo):
+    # a cluster of points in Shanghai + one far away
+    pts = {
+        b"p1": (31.2304, 121.4737),
+        b"p2": (31.2310, 121.4745),
+        b"p3": (31.2400, 121.4900),
+        b"far": (39.9042, 116.4074),  # Beijing
+    }
+    for name, (lat, lng) in pts.items():
+        geo.set(b"city", name, val(lat, lng, name))
+    assert geo.get(b"city", b"p1") == val(*pts[b"p1"], name=b"p1")
+    hits = geo.search_radial(31.2304, 121.4737, 2500)
+    names = [sk for _, hk, sk, _ in hits]
+    assert names[0] == b"p1"            # sorted by distance
+    assert set(names) == {b"p1", b"p2", b"p3"}
+    near = geo.search_radial(31.2304, 121.4737, 200)
+    assert {sk for _, _, sk, _ in near} == {b"p1", b"p2"}
+    # by-member + distance + count limit
+    bym = geo.search_radial_by_key(b"city", b"p1", 2500, count=2)
+    assert len(bym) == 2
+    d = geo.distance(b"city", b"p1", b"city", b"far")
+    assert 1000_000 < d < 1200_000      # Shanghai-Beijing ~1070km
+    # delete removes from the index
+    geo.delete(b"city", b"p2")
+    after = geo.search_radial(31.2304, 121.4737, 200)
+    assert {sk for _, _, sk, _ in after} == {b"p1"}
+
+
+@pytest.fixture(scope="module")
+def redis_sock(cluster, geo):
+    cli = cluster.create("redis_kv", partitions=2)
+    proxy = RedisProxy(cli, geo=geo).start()
+    sock = socket.create_connection(proxy.address, timeout=10)
+    f = sock.makefile("rwb")
+    yield f
+    sock.close()
+    proxy.stop()
+    cli.close()
+
+
+def resp(f, *args):
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        a = a if isinstance(a, bytes) else str(a).encode()
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    f.write(out)
+    f.flush()
+    return read_reply(f)
+
+
+def read_reply(f):
+    line = f.readline().rstrip(b"\r\n")
+    t, rest = line[:1], line[1:]
+    if t in (b"+", b"-"):
+        return rest
+    if t == b":":
+        return int(rest)
+    if t == b"$":
+        n = int(rest)
+        if n < 0:
+            return None
+        data = f.read(n + 2)[:-2]
+        return data
+    if t == b"*":
+        n = int(rest)
+        if n < 0:
+            return None
+        return [read_reply(f) for _ in range(n)]
+    raise ValueError(line)
+
+
+def test_redis_kv_commands(redis_sock):
+    f = redis_sock
+    assert resp(f, "PING") == b"PONG"
+    assert resp(f, "SET", "rk1", "hello") == b"OK"
+    assert resp(f, "GET", "rk1") == b"hello"
+    assert resp(f, "GET", "missing") is None
+    assert resp(f, "EXISTS", "rk1", "missing") == 1
+    assert resp(f, "SETEX", "rk2", 500, "temp") == b"OK"
+    ttl = resp(f, "TTL", "rk2")
+    assert 490 < ttl <= 500
+    assert resp(f, "PTTL", "rk2") == ttl * 1000
+    assert resp(f, "TTL", "rk1") == -1
+    assert resp(f, "TTL", "missing") == -2
+    assert resp(f, "INCR", "cnt") == 1
+    assert resp(f, "INCRBY", "cnt", 10) == 11
+    assert resp(f, "DECR", "cnt") == 10
+    assert resp(f, "DECRBY", "cnt", 4) == 6
+    assert resp(f, "DEL", "rk1", "missing") == 1
+    assert resp(f, "GET", "rk1") is None
+    assert b"unknown command" in resp(f, "FLUSHALL")
+
+
+def test_redis_geo_commands(redis_sock):
+    f = redis_sock
+    assert resp(f, "GEOADD", "fleet", "121.4737", "31.2304", "car1",
+                "121.4745", "31.2310", "car2") == 2
+    pos = resp(f, "GEOPOS", "fleet", "car1", "nope")
+    assert float(pos[0][0]) == pytest.approx(121.4737, abs=1e-4)
+    assert float(pos[0][1]) == pytest.approx(31.2304, abs=1e-4)
+    assert pos[1] is None
+    dist = float(resp(f, "GEODIST", "fleet", "car1", "car2"))
+    assert 50 < dist < 200
+    members = resp(f, "GEORADIUS", "fleet", "121.4737", "31.2304", "500", "m")
+    assert set(members) == {b"car1", b"car2"}
+    members = resp(f, "GEORADIUSBYMEMBER", "fleet", "car1", "10", "m")
+    assert members == [b"car1"]

@@ -180,17 +180,29 @@ def test_scan_session_keeps_one_context_id(onebox):
     assert r3.error == Status.NOT_FOUND
 
 
-def test_wrong_partition_rejected(onebox):
-    """Partition-hash sanity check (pegasus_server_write.cpp)."""
+def test_wrong_partition_rejected_then_rerouted(onebox):
+    """Partition-hash sanity check (pegasus_server_write.cpp): the server
+    rejects a misrouted request; the client layer re-routes it."""
     from pegasus_tpu.base import key_schema
     from pegasus_tpu.engine import replica_service as codes
+    from pegasus_tpu.rpc import codec
+    from pegasus_tpu.rpc.transport import ERR_INVALID_STATE, RpcError
 
     c = onebox
+    c.set(b"misroute", b"s", b"v")
     key = key_schema.generate_key(b"misroute", b"s")
     h = key_schema.key_hash(key)
     wrong = (h % N_PARTITIONS + 1) % N_PARTITIONS
-    with pytest.raises(PegasusError):
-        c._call(codes.RPC_GET, wrong, h, msg.KeyRequest(key), msg.ReadResponse)
+    # raw call straight at the wrong partition: rejected server-side
+    conn = c.pool.get(c.resolver.resolve(wrong))
+    with pytest.raises(RpcError) as ei:
+        conn.call(codes.RPC_GET, codec.encode(msg.KeyRequest(key)),
+                  app_id=c.resolver.app_id, partition_index=wrong,
+                  partition_hash=h, timeout=5)
+    assert ei.value.err == ERR_INVALID_STATE
+    # the client layer turns the rejection into a transparent re-route
+    r = c._call(codes.RPC_GET, wrong, h, msg.KeyRequest(key), msg.ReadResponse)
+    assert r.error == Status.OK and r.value == b"v"
 
 
 def test_codec_roundtrip_all_messages():
