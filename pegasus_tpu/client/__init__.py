@@ -4,3 +4,6 @@ __all__ = ["PegasusClient", "PegasusError", "Scanner", "StaticResolver"]
 from .meta_resolver import MetaResolver  # noqa: E402
 
 __all__.append("MetaResolver")
+from .factory import close_all, get_client  # noqa: E402
+
+__all__ += ["get_client", "close_all"]

@@ -331,9 +331,15 @@ class LsmEngine:
         self._mem.last_decree = self._last_committed_decree
 
     def _flush_one(self, imm: Memtable) -> None:
+        # event-listener counters (reference pegasus_event_listener.h:30-52)
+        from ..runtime.perf_counters import counters
+
+        t0 = time.perf_counter()
         block = imm.to_block()
         opts = CompactOptions(backend=self.opts.backend, prefix_u32=self.opts.prefix_u32)
         sorted_block = sort_block(block, opts)
+        counters.rate("engine.flush_completed_count").increment()
+        counters.percentile("engine.flush_s").set(time.perf_counter() - t0)
         with self._lock:
             name = self._alloc_file_locked()
             path = os.path.join(self.path, name)
@@ -425,7 +431,12 @@ class LsmEngine:
             runs_sorted=True,
             user_ops=tuple(self.opts.user_ops),
         )
+        from ..runtime.perf_counters import counters
+
+        t0 = time.perf_counter()
         result = compact_blocks(input_blocks, opts)
+        counters.rate("engine.compaction_completed_count").increment()
+        counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
         out_blocks = _split_block(result.block, self.opts.target_file_size_bytes)
         new_ssts = []
         for ob in out_blocks:

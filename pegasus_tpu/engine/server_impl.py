@@ -51,6 +51,7 @@ class PegasusServer:
         self._contexts = ScanContextCache()
         self._app_envs = {}
         self._default_ttl = 0
+        self._slow_query_threshold_ms = 20  # reference default 20ms
         self._pfx = f"app.{app_id}.{pidx}."
         from .manual_compact_service import ManualCompactService
 
@@ -76,6 +77,15 @@ class PegasusServer:
         if ttl is not None:
             self._default_ttl = max(0, int(ttl))
             self.engine.opts.default_ttl = self._default_ttl
+        sq = envs.get(consts.ENV_SLOW_QUERY_THRESHOLD)
+        if sq is not None:
+            # validate ONCE here (the reference validates at env update);
+            # a malformed value must never fail the read path
+            try:
+                self._slow_query_threshold_ms = max(0, int(sq))
+            except (TypeError, ValueError):
+                print(f"[app-envs] bad {consts.ENV_SLOW_QUERY_THRESHOLD}="
+                      f"{sq!r} ignored", flush=True)
         backend = envs.get(consts.COMPACTION_BACKEND_KEY)
         if backend in ("cpu", "tpu"):
             self.engine.opts.backend = backend
@@ -219,9 +229,22 @@ class PegasusServer:
             hk = key  # malformed client key: still account, never raise
         self.cu_calculator.add_read(hk, len(key) + len(resp.value))
         counters.rate(self._pfx + "get_qps").increment()
-        counters.percentile(self._pfx + "get_latency_us").set(
-            int((time.perf_counter() - t0) * 1e6))
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        counters.percentile(self._pfx + "get_latency_us").set(elapsed_us)
+        self._check_slow_query("get", hk, elapsed_us)
         return resp
+
+    def _check_slow_query(self, op: str, hash_key: bytes, elapsed_us: int):
+        """Slow/abnormal query tracing (reference _slow_query_threshold_ns,
+        pegasus_server_impl.cpp:318-332): log offenders, bump the counter."""
+        threshold_ms = self._slow_query_threshold_ms
+        if threshold_ms > 0 and elapsed_us >= threshold_ms * 1000:
+            counters.rate(self._pfx + "recent_abnormal_count").increment()
+            from ..base.utils import c_escape_string
+
+            print(f"[slow-query] app={self.app_id}.{self.pidx} op={op} "
+                  f"hash_key=\"{c_escape_string(hash_key)}\" "
+                  f"time_used={elapsed_us}us", flush=True)
 
     def on_multi_get(self, req: msg.MultiGetRequest, now: int = None) -> msg.MultiGetResponse:
         """src/server/pegasus_server_impl.cpp:343: specified sort_keys, or a
@@ -229,6 +252,7 @@ class PegasusServer:
         LAST max_kv_count/size items of the range and returns them in
         descending sort_key order (the reference iterates with Prev())."""
         now = epoch_now() if now is None else now
+        t0 = time.perf_counter()
         resp = msg.MultiGetResponse(app_id=self.app_id, partition_index=self.pidx,
                                     server=self.server)
         counters.rate(self._pfx + "multi_get_qps").increment()
@@ -241,6 +265,8 @@ class PegasusServer:
                     resp.kvs.append(msg.KeyValue(sk, data))
                     size += len(sk) + len(data)
             self.cu_calculator.add_read(req.hash_key, size)
+            self._check_slow_query("multi_get", req.hash_key,
+                                   int((time.perf_counter() - t0) * 1e6))
             return resp
 
         start = key_schema.generate_key(req.hash_key, req.start_sortkey)
@@ -290,6 +316,8 @@ class PegasusServer:
                 complete = False
                 break
         self.cu_calculator.add_read(req.hash_key, size)
+        self._check_slow_query("multi_get", req.hash_key,
+                               int((time.perf_counter() - t0) * 1e6))
         resp.kvs = out
         resp.error = Status.OK if complete else Status.INCOMPLETE
         return resp

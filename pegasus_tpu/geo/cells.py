@@ -70,8 +70,11 @@ def covering_cells(lat: float, lng: float, radius_m: float, level: int) -> list:
     dlng = math.degrees(radius_m / (EARTH_RADIUS_M * coslat))
     cell_h = 180.0 / (1 << level)   # cell edge in latitude degrees
     cell_w = 360.0 / (1 << level)
-    steps_lat = min(255, int(2 * dlat / cell_h) + 2)
-    steps_lng = min(255, int(2 * dlng / cell_w) + 2)
+    # one sample per cell edge, uncapped — the MAX_COVERING_CELLS early
+    # return below bounds the work; capping the STEP spacing instead would
+    # silently skip cells between samples
+    steps_lat = int(2 * dlat / cell_h) + 2
+    steps_lng = int(2 * dlng / cell_w) + 2
     cells = set()
     for i in range(steps_lat + 1):
         for j in range(steps_lng + 1):

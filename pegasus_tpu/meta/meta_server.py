@@ -190,13 +190,20 @@ class MetaServer:
                 parts.append(pc)
                 children.append((parent, pc))
             app.partition_count = 2 * n
-            envs = json.loads(app.envs_json)
-            envs["replica.partition_version"] = str(2 * n - 1)
-            app.envs_json = json.dumps(envs)
+            parents = list(parts[:n])
             self._persist_locked()
+        # Phase 1: parents learn the NEW partition count FIRST, so any write
+        # still routed with the old count but belonging to a child half is
+        # rejected from here on (client re-resolves). Writes accepted before
+        # this point precede the child learn below and are carried by it —
+        # no write can fall between the two.
+        for pc in parents:
+            self._install_partition(app, pc)
+        # Phase 2: seed every child from its parent's primary (full-copy
+        # learn). Failures are fatal for the split: the stale-key GC mask
+        # must not spread unless every child holds its half.
+        seeded = True
         for parent, pc in children:
-            # seed child from the parent's primary (full-copy learn); then
-            # the view installs with the child's own pidx
             req_open = mm.OpenReplicaRequest(
                 app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
                 ballot=pc.ballot, primary=pc.primary,
@@ -204,12 +211,23 @@ class MetaServer:
                 partition_count=2 * n, learn_from=parent.primary,
                 learn_pidx=parent.pidx)
             for node in [pc.primary] + pc.secondaries:
-                self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
-                                   ignore_errors=True)
-        # re-push parents so they learn the new partition_version env
+                if self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
+                                      ignore_errors=True) is None:
+                    seeded = False
+        if not seeded:
+            return codec.encode(mm.SplitAppResponse(
+                error=1, new_partition_count=2 * n,
+                error_text="child seeding incomplete; GC mask withheld — "
+                           "re-run split to retry"))
+        # Phase 3: with every child seeded, spread the ownership mask so
+        # compaction GCs keys each partition no longer owns.
         with self._lock:
-            parents = list(self._parts[app.app_id][:n])
-        for pc in parents:
+            envs = json.loads(app.envs_json)
+            envs["replica.partition_version"] = str(2 * n - 1)
+            app.envs_json = json.dumps(envs)
+            all_parts = list(self._parts[app.app_id])
+            self._persist_locked()
+        for pc in all_parts:
             self._install_partition(app, pc)
         return codec.encode(mm.SplitAppResponse(new_partition_count=2 * n))
 

@@ -138,8 +138,16 @@ class RedisProxy:
 
     def cmd_set(self, a):
         ttl = 0
-        if len(a) >= 4 and a[2].upper() == b"EX":
-            ttl = int(a[3])
+        i = 2
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == b"EX" and i + 1 < len(a):
+                ttl = int(a[i + 1])
+                i += 2
+            else:
+                # NX/XX/PX/KEEPTTL would silently change semantics if
+                # answered OK as a plain SET — refuse instead
+                return _encode_error(f"unsupported SET option {opt.decode()}")
         self.client.set(a[0], EMPTY_SK, a[1], ttl_seconds=ttl)
         return _encode_simple("OK")
 

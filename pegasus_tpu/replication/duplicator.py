@@ -37,6 +37,7 @@ class MutationDuplicator:
         self._queue = []
         self._cv = threading.Condition()
         self._stop = False
+        self._inflight = False
         self.shipped = 0
         self.skipped = 0
         self.last_shipped_decree = 0
@@ -55,12 +56,19 @@ class MutationDuplicator:
     def _ship_loop(self):
         while True:
             with self._cv:
+                self._inflight = False
+                self._cv.notify_all()
                 while not self._queue and not self._stop:
                     self._cv.wait(0.2)
                 if self._stop and not self._queue:
                     return
                 m = self._queue.pop(0)
-            self._ship_one(m)
+                self._inflight = True
+            try:
+                self._ship_one(m)
+            except Exception as e:  # never let the shipper thread die
+                self.skipped += 1
+                print(f"[duplicator] dropped decree {m.decree}: {e!r}")
 
     def _ship_one(self, m: LogMutation) -> None:
         import time
@@ -68,10 +76,16 @@ class MutationDuplicator:
         for code, body in zip(m.codes, m.bodies):
             if code == RPC_DUPLICATE:
                 continue  # never re-duplicate a duplicate (loop guard)
+            try:
+                key = _routing_key(code, body)
+            except (ValueError, KeyError):
+                # non-duplicable mutation (e.g. bulk-load ingestion commands
+                # have no routing key; each cluster loads its own sets)
+                self.skipped += 1
+                continue
             req = msg.DuplicateRequest(
                 timestamp=m.timestamp_us, task_code=code, raw_message=body,
                 cluster_id=self.cluster_id, verify_timetag=True)
-            key = _routing_key(code, body)
             attempts = 0
             while not self._stop:
                 try:
@@ -105,13 +119,14 @@ class MutationDuplicator:
             raise
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Wait until the backlog drains (tests / graceful shutdown)."""
+        """Wait until the backlog drains AND the in-flight mutation (if any)
+        finished shipping (tests / graceful shutdown)."""
         import time
 
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._cv:
-                if not self._queue:
+                if not self._queue and not self._inflight:
                     return True
             time.sleep(0.01)
         return False

@@ -150,7 +150,9 @@ class ReplicaStub:
                               self.options_factory(),
                               peers=self._peer_factory(req.app_id, req.pidx))
                 self._replicas[key] = rep
-                self._service.add_replica(rep.server, req.partition_count)
+            # (re-)register: partition splits change the count for existing
+            # replicas, which drives the misroute rejection check
+            self._service.add_replica(rep.server, req.partition_count)
         learn_self = (req.learn_from == self.address
                       and (req.learn_pidx < 0 or req.learn_pidx == req.pidx))
         if req.learn_from and not learn_self:

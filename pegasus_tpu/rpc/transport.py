@@ -93,6 +93,7 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers = {}
+        self._middlewares = []   # fn(code, header, body, next) -> body
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -126,6 +127,11 @@ class RpcServer:
         for code, fn in obj.rpc_handlers().items():
             self.register(code, fn)
 
+    def add_middleware(self, mw) -> None:
+        """mw(code, header, body, next_fn) -> response body. The rDSN
+        toollet seam: tracer/profiler/fault-injector wrap every handler."""
+        self._middlewares.append(mw)
+
     def start(self) -> "RpcServer":
         self._thread.start()
         return self
@@ -143,7 +149,11 @@ class RpcServer:
                 resp.error = ERR_HANDLER_NOT_FOUND
                 resp.error_text = header.code
             else:
-                out = fn(header, body)
+                call = fn
+                for mw in reversed(self._middlewares):
+                    call = (lambda h, b, _mw=mw, _next=call:
+                            _mw(h.code, h, b, _next))
+                out = call(header, body)
         except RpcError as e:
             resp.error, resp.error_text = e.err, e.text
         except Exception as e:  # handler bug -> error, not a dead connection

@@ -87,6 +87,9 @@ class MetaApp:
                              config.get_int(section, "port", 34601))
         for code, fn in self.meta.rpc_handlers().items():
             self.rpc.register(code, fn)
+        from .toollets import install_toollets
+
+        install_toollets(self.rpc, config.get_list("core", "toollets", ()))
         self._fd_timer = None
         self._fd_interval = config.get_float("failure_detector",
                                              "check_interval_seconds", 5.0)
@@ -138,6 +141,17 @@ class ReplicaApp:
             options_factory=options_factory)
         self._beacon = config.get_float("failure_detector",
                                         "beacon_interval_seconds", 1.0)
+        from .toollets import install_toollets
+
+        install_toollets(self.stub.rpc,
+                         config.get_list("core", "toollets", ()),
+                         command_service=self.stub.commands)
+        http_port = config.get_int(section, "http_port", -1)
+        self.reporter = None
+        if http_port >= 0:
+            from ..collector.reporter import CounterReporter
+
+            self.reporter = CounterReporter(port=http_port).start()
 
     @property
     def address(self):
@@ -148,6 +162,8 @@ class ReplicaApp:
         return self
 
     def stop(self):
+        if self.reporter:
+            self.reporter.stop()
         self.stub.stop()
 
 

@@ -71,6 +71,12 @@ class Shell:
                               "perf_counters <node> [prefix]"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
+            "sst_dump": (self.cmd_sst_dump,
+                         "sst_dump <file.sst> [max_rows] — offline SST reader"),
+            "mlog_dump": (self.cmd_mlog_dump,
+                          "mlog_dump <plog_dir> [from_decree] — offline log reader"),
+            "local_get": (self.cmd_local_get,
+                          "local_get <replica_data_dir> <hashkey> <sortkey>"),
             "exit": (None, "quit"),
             "quit": (None, "quit"),
         }
@@ -332,6 +338,55 @@ class Shell:
         node, rest = args[0], args[1:]
         self.p(self._node_command(node, "detect_hotkey", rest))
 
+    # offline debuggers ---------------------------------------------------
+    # (reference src/shell/commands/debugger.cpp: sst_dump / mlog_dump /
+    #  local_get read files directly, no cluster needed)
+
+    def cmd_sst_dump(self, args):
+        from ..base.key_schema import restore_key
+        from ..engine.sstable import SSTable
+
+        sst = SSTable(args[0])
+        limit = int(args[1]) if len(args) > 1 else 50
+        self.p(f"records={sst.n} level={sst.meta.get('level')} "
+               f"decree={sst.meta.get('last_flushed_decree')} "
+               f"bytes={sst.data_bytes}")
+        b = sst.block()
+        for i in range(min(sst.n, limit)):
+            hk, sk = restore_key(b.key(i))
+            flags = "DEL" if b.deleted[i] else f"exp={int(b.expire_ts[i])}"
+            self.p(f'"{c_escape_string(hk)}" : "{c_escape_string(sk)}" '
+                   f'[{flags}] => {len(b.value(i))}B')
+        if sst.n > limit:
+            self.p(f"... {sst.n - limit} more")
+
+    def cmd_mlog_dump(self, args):
+        from ..replication.mutation_log import MutationLog
+
+        log = MutationLog(args[0])
+        frm = int(args[1]) if len(args) > 1 else 0
+        n = 0
+        for m in log.replay(frm):
+            self.p(f"decree={m.decree} ballot={m.ballot} ts={m.timestamp_us} "
+                   f"ops={[c.rsplit('_', 1)[-1] for c in m.codes]}")
+            n += 1
+        self.p(f"{n} mutations")
+        log.close()
+
+    def cmd_local_get(self, args):
+        from ..base.key_schema import generate_key
+        from ..base.value_schema import SCHEMAS
+        from ..engine.db import EngineOptions, LsmEngine
+
+        eng = LsmEngine(args[0], EngineOptions(backend="cpu"))
+        raw = eng.get(generate_key(args[1].encode(), args[2].encode()))
+        if raw is None:
+            self.p("not found")
+        else:
+            data = SCHEMAS[eng.data_version()].extract_user_data(raw)
+            self.p(f'"{c_escape_string(data)}"')
+        eng.close()
+
     # ---------------------------------------------------------------- run
 
     def run_line(self, line: str) -> bool:
@@ -348,7 +403,7 @@ class Shell:
             return True
         try:
             ent[0](args)
-        except (PegasusError, RpcError) as e:
+        except (PegasusError, RpcError, OSError) as e:
             self.p(f"ERROR: {e}")
         except (IndexError, ValueError):
             self.p(f"usage: {ent[1]}")

@@ -251,3 +251,94 @@ def test_available_detector_probe(onebox, shell):
     rep = det.report()
     assert rep["minute"] == 1.0
     det.stop()
+
+
+def test_toollets_trace_profile_inject(onebox, shell):
+    from pegasus_tpu.runtime import fail_points
+    from pegasus_tpu.runtime.perf_counters import counters
+    from pegasus_tpu.runtime.toollets import install_toollets
+    from pegasus_tpu.rpc.transport import RpcServer, RpcConnection, RpcError
+    from pegasus_tpu.runtime.remote_command import RemoteCommandService
+
+    srv = RpcServer().start()
+    cmds = RemoteCommandService()
+    srv.register("RPC_TEST_ECHO", lambda h, b: b)
+    srv.register("RPC_CLI_CLI_CALL", cmds.rpc_handler)
+    tools = install_toollets(srv, ["tracer", "profiler", "fault_injector"],
+                             command_service=cmds)
+    conn = RpcConnection(srv.address)
+    try:
+        _, out = conn.call("RPC_TEST_ECHO", b"hello", timeout=5)
+        assert out == b"hello"
+        assert counters.snapshot()["profiler.RPC_TEST_ECHO.qps"] >= 0
+        assert "RPC_TEST_ECHO" in tools["tracer"].dump()
+        # fault injection drops the call
+        fail_points.setup()
+        fail_points.cfg("rpc.RPC_TEST_ECHO", "return()")
+        import pytest as _pytest
+        with _pytest.raises(RpcError):
+            conn.call("RPC_TEST_ECHO", b"x", timeout=5)
+        fail_points.teardown()
+        _, out = conn.call("RPC_TEST_ECHO", b"ok", timeout=5)
+        assert out == b"ok"
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_slow_query_log_and_counter(tmp_path, capsys):
+    from pegasus_tpu.base import consts, key_schema
+    from pegasus_tpu.engine import EngineOptions
+    from pegasus_tpu.engine.server_impl import PegasusServer
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    srv = PegasusServer(str(tmp_path / "sq"), app_id=99, pidx=0,
+                        options=EngineOptions(backend="cpu"))
+    srv.update_app_envs({consts.ENV_SLOW_QUERY_THRESHOLD: "0"})
+    srv.on_get(key_schema.generate_key(b"h", b"s"))
+    # threshold 0 disables the log entirely
+    assert "app.99.0.recent_abnormal_count" not in counters.snapshot()
+    # a sub-microsecond threshold flags every get
+    srv._app_envs[consts.ENV_SLOW_QUERY_THRESHOLD] = "-1"
+    srv._check_slow_query("get", b"h", elapsed_us=50_000)  # forced sample
+    srv.update_app_envs({consts.ENV_SLOW_QUERY_THRESHOLD: "1"})
+    srv._check_slow_query("get", b"h", elapsed_us=50_000)
+    assert counters.snapshot()["app.99.0.recent_abnormal_count"] >= 0
+    assert "[slow-query]" in capsys.readouterr().out
+    srv.close()
+
+
+def test_offline_debuggers(tmp_path, shell):
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    from pegasus_tpu.engine.db import EngineOptions, LsmEngine
+    from pegasus_tpu.replication.mutation_log import LogMutation, MutationLog
+
+    sh, out = shell
+    eng = LsmEngine(str(tmp_path / "ldb"), EngineOptions(backend="cpu"))
+    for i in range(5):
+        eng.put(generate_key(b"oh", b"s%d" % i),
+                SCHEMAS[2].generate_value(0, 0, b"val%d" % i))
+    eng.flush()
+    sst = eng._l0[0].path
+    sh.run_line(f"sst_dump {sst}")
+    assert "records=5" in text(out)
+    sh.run_line(f'local_get {tmp_path / "ldb"} oh s2')
+    assert "val2" in text(out)
+    log = MutationLog(str(tmp_path / "plog"))
+    log.append(LogMutation(decree=1, codes=["RPC_RRDB_RRDB_PUT"], bodies=[b"x"]))
+    log.close()
+    sh.run_line(f'mlog_dump {tmp_path / "plog"}')
+    assert "decree=1" in text(out)
+
+
+def test_client_factory_singleton(onebox, shell):
+    from pegasus_tpu.client import get_client
+
+    sh, _ = shell
+    sh.run_line("create facttest -p 2")
+    c1 = get_client(onebox, "facttest")
+    c2 = get_client([onebox], "facttest")
+    assert c1 is c2
+    c1.set(b"f", b"s", b"v")
+    assert c2.get(b"f", b"s") == b"v"
