@@ -106,7 +106,30 @@ def presort_run(block):
     return block.gather(order[uniq])
 
 
+def _arm_watchdog():
+    """The TPU tunnel can wedge (device-lease retry sleeps forever); a hung
+    bench is worse than a failed one for the driver. Hard-exit with a
+    diagnostic after PEGASUS_BENCH_TIMEOUT_S (0 disables)."""
+    import threading
+
+    budget = int(os.environ.get("PEGASUS_BENCH_TIMEOUT_S", 2400))
+    if budget <= 0:
+        return
+
+    def boom():
+        import sys
+
+        print(f"bench watchdog: no result after {budget}s "
+              f"(TPU tunnel wedged?); aborting", file=sys.stderr, flush=True)
+        os._exit(3)
+
+    t = threading.Timer(budget, boom)
+    t.daemon = True
+    t.start()
+
+
 def main():
+    _arm_watchdog()
     _enable_compile_cache()
     from pegasus_tpu.engine.block import KVBlock
     from pegasus_tpu.ops.compact import (CompactOptions, CpuBackend, TpuBackend,

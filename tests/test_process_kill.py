@@ -1,0 +1,183 @@
+"""Multi-PROCESS kill test: real server processes, SIGKILL, recovery.
+
+The reference's chaos tier (SURVEY §4.3, src/test/kill_test): a
+data_verifier writes self-checking rows while killer_handler_shell
+hard-kills and restarts node processes, then verifies every acknowledged
+write. Here the onebox is 1 meta + 3 replica `python -m pegasus_tpu.server`
+processes on real ports; kills are SIGKILL (no flush, no goodbye) so
+recovery exercises the mutation-log replay + meta FD + learner rebuild
+paths end to end.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
+from pegasus_tpu.rpc.transport import RpcError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+INI = """
+[apps.{name}]
+type = {type}
+run = true
+port = {port}
+state_dir = {root}/meta
+data_dir = {root}/{name}
+
+[pegasus.server]
+meta_servers = 127.0.0.1:{meta_port}
+
+[failure_detector]
+beacon_interval_seconds = 0.3
+grace_seconds = 2.5
+check_interval_seconds = 0.5
+"""
+
+
+class ProcNode:
+    def __init__(self, root, name, type_, port, meta_port):
+        self.root, self.name = root, name
+        self.cfg = os.path.join(root, f"{name}.ini")
+        with open(self.cfg, "w") as f:
+            f.write(INI.format(name=name, type=type_, port=port, root=root,
+                               meta_port=meta_port))
+        self.proc = None
+
+    def start(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        self.log = open(os.path.join(self.root, f"{self.name}.log"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pegasus_tpu.server", "--config", self.cfg,
+             "--app", self.name],
+            env=env, stdout=self.log, stderr=self.log, cwd=self.root)
+        return self
+
+    def kill9(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait()
+
+    def stop(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.log.close()
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait_nodes(meta_addr, want, timeout=30):
+    from pegasus_tpu.meta import messages as mm
+    from pegasus_tpu.meta.meta_server import RPC_CM_LIST_NODES
+    from pegasus_tpu.rpc import codec
+    from pegasus_tpu.rpc.transport import RpcConnection
+
+    host, _, port = meta_addr.rpartition(":")
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            conn = RpcConnection((host, int(port)))
+            _, body = conn.call(RPC_CM_LIST_NODES,
+                                codec.encode(mm.ListNodesRequest()), timeout=3)
+            conn.close()
+            nodes = codec.decode(mm.ListNodesResponse, body).nodes
+            if sum(1 for n in nodes if n.alive) >= want:
+                return True
+        except (RpcError, OSError):
+            pass
+        time.sleep(0.5)
+    return False
+
+
+@pytest.mark.slow
+def test_process_kill_recovery(tmp_path):
+    root = str(tmp_path)
+    meta_port, p1, p2, p3 = _free_ports(4)
+    meta = ProcNode(root, "meta", "meta", meta_port, meta_port).start()
+    replicas = {
+        "replica1": ProcNode(root, "replica1", "replica", p1, meta_port).start(),
+        "replica2": ProcNode(root, "replica2", "replica", p2, meta_port).start(),
+        "replica3": ProcNode(root, "replica3", "replica", p3, meta_port).start(),
+    }
+    meta_addr = f"127.0.0.1:{meta_port}"
+    try:
+        assert _wait_nodes(meta_addr, 3), "replica processes never registered"
+        from pegasus_tpu.meta import messages as mm
+        from pegasus_tpu.meta.meta_server import RPC_CM_CREATE_APP, RPC_CM_QUERY_CONFIG
+        from pegasus_tpu.rpc import codec
+        from pegasus_tpu.rpc.transport import RpcConnection
+
+        host, _, port = meta_addr.rpartition(":")
+        conn = RpcConnection((host, int(port)))
+        _, body = conn.call(RPC_CM_CREATE_APP,
+                            codec.encode(mm.CreateAppRequest("kt", 2, 3)),
+                            timeout=15)
+        assert codec.decode(mm.CreateAppResponse, body).error == 0
+
+        cli = PegasusClient(MetaResolver([meta_addr], "kt"), timeout=15)
+        acked = {}
+        i = 0
+
+        def write_burst(n):
+            nonlocal i
+            for _ in range(n):
+                try:
+                    cli.set(b"pk%d" % i, b"s", b"pv%d" % i)
+                    acked[i] = True
+                except PegasusError:
+                    pass
+                i += 1
+
+        write_burst(30)
+        # find + SIGKILL the node that is primary for partition 0
+        _, body = conn.call(RPC_CM_QUERY_CONFIG,
+                            codec.encode(mm.QueryConfigRequest("kt")), timeout=5)
+        cfg = codec.decode(mm.QueryConfigResponse, body)
+        victim_addr = cfg.partitions[0].primary
+        victim = None
+        for name, node in replicas.items():
+            with open(os.path.join(root, f"{name}.log"), "rb") as f:
+                if victim_addr.encode() in f.read():
+                    victim = name
+        assert victim is not None
+        replicas[victim].kill9()
+        # FD grace is 2.5s; wait for the meta to reconfigure
+        time.sleep(4)
+        write_burst(20)
+        for k in sorted(acked):
+            assert cli.get(b"pk%d" % k, b"s") == b"pv%d" % k, f"lost pk{k}"
+        # restart the killed process: it must rejoin and beacon again
+        replicas[victim].start()
+        assert _wait_nodes(meta_addr, 3), "killed replica never rejoined"
+        write_burst(10)
+        for k in sorted(acked):
+            assert cli.get(b"pk%d" % k, b"s") == b"pv%d" % k
+        assert len(acked) >= 55
+        cli.close()
+        conn.close()
+    finally:
+        for r in replicas.values():
+            r.stop()
+        meta.stop()
