@@ -135,6 +135,10 @@ class LsmEngine:
         self._last_committed_decree = 0
         self._durable_decree = 0
         self._compact_round = {}  # level -> round-robin cursor for cascades
+        # serializes checkpoint create/rename/GC (the shared checkpoint.tmp
+        # dir would otherwise race between the maintenance timer and RPC
+        # threads); RLock so callers can hold it across create+consume
+        self.checkpoint_lock = threading.RLock()
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -513,11 +517,14 @@ class LsmEngine:
 
     # ------------------------------------------------------------- checkpoint
 
-    def checkpoint(self, dest_dir: str) -> int:
+    def checkpoint(self, dest_dir: str, flush: bool = True) -> int:
         """Hardlink-based consistent snapshot into dest_dir
         (reference: sync_checkpoint / copy_checkpoint_to_dir_unsafe,
-        src/server/pegasus_server_impl.cpp:1666,1863). Returns the decree."""
-        self.flush()
+        src/server/pegasus_server_impl.cpp:1666,1863). Returns the decree.
+        flush=False snapshots only the durable state (the reference's
+        async/no-flush variant)."""
+        if flush:
+            self.flush()
         with self._lock:
             os.makedirs(dest_dir, exist_ok=True)
             for sst in self._all_ssts_locked():
@@ -531,18 +538,35 @@ class LsmEngine:
                 json.dump(self._manifest_dict_locked(), f)
             return self.last_durable_decree()
 
-    def sync_checkpoint(self) -> int:
+    def sync_checkpoint(self, flush: bool = True) -> int:
         """Create <path>/checkpoint.{decree}; GC old ones. Returns decree."""
-        decree = self.checkpoint(os.path.join(
-            self.path, f"{CHECKPOINT_PREFIX}tmp"))
-        final = os.path.join(self.path, f"{CHECKPOINT_PREFIX}{decree}")
-        tmp = os.path.join(self.path, f"{CHECKPOINT_PREFIX}tmp")
-        if os.path.exists(final):
-            shutil.rmtree(tmp)
-        else:
-            os.replace(tmp, final)
-        self.gc_checkpoints()
-        return decree
+        with self.checkpoint_lock:
+            decree = self.checkpoint(os.path.join(
+                self.path, f"{CHECKPOINT_PREFIX}tmp"), flush=flush)
+            final = os.path.join(self.path, f"{CHECKPOINT_PREFIX}{decree}")
+            tmp = os.path.join(self.path, f"{CHECKPOINT_PREFIX}tmp")
+            if os.path.exists(final):
+                shutil.rmtree(tmp)
+            else:
+                os.replace(tmp, final)
+            self.gc_checkpoints()
+            return decree
+
+    def async_checkpoint(self):
+        """Background NO-FLUSH checkpoint (the reference's async variant,
+        pegasus_server_impl.cpp:1744: snapshot durable state only, never
+        force a flush). Returns the Thread, or None when the latest
+        checkpoint already covers the durable decree or one is running."""
+        existing = self.list_checkpoints()
+        if existing and existing[-1] >= self.last_durable_decree():
+            return None
+        if not self.checkpoint_lock.acquire(blocking=False):
+            return None  # a checkpoint is already in flight
+        self.checkpoint_lock.release()
+        t = threading.Thread(target=self.sync_checkpoint, kwargs={"flush": False},
+                             daemon=True)
+        t.start()
+        return t
 
     def list_checkpoints(self) -> list:
         """Sorted decrees of existing checkpoint.{decree} dirs
@@ -558,6 +582,10 @@ class LsmEngine:
     def gc_checkpoints(self) -> int:
         """Drop checkpoints beyond the count/time reserves
         (reference gc_checkpoints, pegasus_server_impl.cpp:120-253)."""
+        with self.checkpoint_lock:
+            return self._gc_checkpoints_locked()
+
+    def _gc_checkpoints_locked(self) -> int:
         decrees = self.list_checkpoints()
         keep_min = max(1, self.opts.checkpoint_reserve_min_count)
         dropped = 0

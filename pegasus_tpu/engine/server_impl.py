@@ -94,6 +94,16 @@ class PegasusServer:
 
             self.engine.opts.user_ops = tuple(parse_user_specified_compaction(
                 envs[consts.USER_SPECIFIED_COMPACTION]))
+        for env_key, opt in ((consts.CHECKPOINT_RESERVE_MIN_COUNT,
+                              "checkpoint_reserve_min_count"),
+                             (consts.CHECKPOINT_RESERVE_TIME_SECONDS,
+                              "checkpoint_reserve_time_seconds")):
+            v = envs.get(env_key)
+            if v is not None:
+                try:
+                    setattr(self.engine.opts, opt, max(0, int(v)))
+                except (TypeError, ValueError):
+                    print(f"[app-envs] bad {env_key}={v!r} ignored", flush=True)
         pv = envs.get(consts.REPLICA_PARTITION_VERSION)
         if pv is not None:
             # post-split ownership mask: compaction drops keys whose hash no

@@ -160,7 +160,8 @@ class MetaServer:
                 self._send_to_node(node, RPC_OPEN_REPLICA, mm.OpenReplicaRequest(
                     app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
                     ballot=pc.ballot, primary=pc.primary,
-                    secondaries=pc.secondaries, envs_json=app.envs_json),
+                    secondaries=pc.secondaries, envs_json=app.envs_json,
+                    partition_count=app.partition_count),
                     ignore_errors=True)
         return codec.encode(mm.SetAppEnvsResponse())
 
@@ -243,7 +244,10 @@ class MetaServer:
                                                          error_text="no such app"))
             parts = list(self._parts[app.app_id])
         backup_id = int(time.time() * 1000)
-        base = os.path.join(req.backup_root, str(backup_id), req.app_name)
+        # replicas resolve this path through a block service rooted at "/";
+        # absolutize here so a relative root means the same tree everywhere
+        base = os.path.join(os.path.abspath(req.backup_root),
+                            str(backup_id), req.app_name)
         for pc in parts:
             dest = os.path.join(base, str(pc.pidx))
             out = self._send_to_node(pc.primary, RPC_COLD_BACKUP,
@@ -266,7 +270,8 @@ class MetaServer:
         backup dir at open (reference restore envs ROCKSDB_ENV_RESTORE_*,
         pegasus_server_impl.cpp:1339-1393)."""
         req = codec.decode(mm.RestoreAppRequest, body)
-        meta_file = os.path.join(req.backup_root, str(req.backup_id),
+        backup_root = os.path.abspath(req.backup_root)
+        meta_file = os.path.join(backup_root, str(req.backup_id),
                                  req.old_app_name, "backup_metadata")
         try:
             with open(meta_file) as f:
@@ -298,7 +303,7 @@ class MetaServer:
             self._parts[app.app_id] = parts
             self._persist_locked()
         for pc in parts:
-            src = os.path.join(req.backup_root, str(req.backup_id),
+            src = os.path.join(backup_root, str(req.backup_id),
                                req.old_app_name, str(pc.pidx))
             req_open = mm.OpenReplicaRequest(
                 app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
@@ -323,8 +328,9 @@ class MetaServer:
                 return codec.encode(mm.StartBulkLoadResponse(
                     error=1, error_text="no such app"))
             parts = list(self._parts[app.app_id])
+        provider_root = os.path.abspath(req.provider_root)
         try:
-            with open(bl.metadata_path(req.provider_root, req.app_name)) as f:
+            with open(bl.metadata_path(provider_root, req.app_name)) as f:
                 bmeta = json.load(f)
         except OSError:
             return codec.encode(mm.StartBulkLoadResponse(
@@ -338,7 +344,7 @@ class MetaServer:
         total = 0
         for pc in parts:
             ingest = rpc_msg.BulkLoadIngestRequest(
-                provider_root=req.provider_root, app_name=req.app_name,
+                provider_root=provider_root, app_name=req.app_name,
                 partition_count=app.partition_count)
             # route through the primary's WRITE path: the ingestion command
             # replicates via PacificA so every replica loads the set at the

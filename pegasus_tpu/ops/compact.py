@@ -270,8 +270,10 @@ def _compiled_pipeline(padded_lens: tuple, w: int, has_rank: bool):
     from jax import lax
 
     from .device_sort import merge_two_sorted
+    from .pallas_merge import merge_two_sorted_pallas, pallas_enabled
 
     nk = w + (1 if has_rank else 0) + 1
+    use_pallas = pallas_enabled()
 
     def fn(run_cols, aux, now, pidx, pmask, bottommost, do_filter):
         items = []
@@ -283,10 +285,14 @@ def _compiled_pipeline(padded_lens: tuple, w: int, has_rank: bool):
         while len(items) > 1:
             items.sort(key=lambda t: t[0])
             (la, a), (lb, b) = items[0], items[1]
-            merged = merge_two_sorted(a, b, nk, pad_fill)
-            lm = _pow2ceil(la + lb)
-            if lm > la + lb:
-                merged = [c[: la + lb] for c in merged]
+            if use_pallas:
+                # tier-2 kernel: whole merge in VMEM, ~2 HBM passes
+                merged = merge_two_sorted_pallas(a, b, nk, pad_fill)
+            else:
+                merged = merge_two_sorted(a, b, nk, pad_fill)
+                lm = _pow2ceil(la + lb)
+                if lm > la + lb:
+                    merged = [c[: la + lb] for c in merged]
             items = items[2:] + [(la + lb, merged)]
         _, cols = items[0]
         idx = cols[-1]

@@ -108,9 +108,9 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _partner(c, j):
+def _partner(c, j, mxu=True):
     L = c.shape[0]
-    if j < 128 and L >= _MXU_MIN_L and _on_tpu():
+    if mxu and j < 128 and L >= _MXU_MIN_L and _on_tpu():
         # intra-lane exchange: only worth the matmul machinery where lane
         # padding exists; on CPU the strided reshape is cheap and compiles
         # far faster
@@ -120,13 +120,15 @@ def _partner(c, j):
     return _partner_reshape(c, j)
 
 
-def _exchange(cols, nk, j, flip):
+def _exchange(cols, nk, j, flip, mxu=True):
     """One compare-exchange stage at distance j. flip = is_high ^ is_desc.
     Comparisons are strict both ways so equal pairs stay put (a non-strict
-    form would copy one element over both slots, corrupting payloads)."""
+    form would copy one element over both slots, corrupting payloads).
+    mxu=False forces the reshape/concat partner forms (used inside Pallas
+    kernels, where data is already VMEM-resident)."""
     import jax.numpy as jnp
 
-    px = [_partner(c, j) for c in cols]
+    px = [_partner(c, j, mxu=mxu) for c in cols]
     p_lt, p_eq = lex_cmp(px[:nk], cols[:nk])
     p_gt = ~p_lt & ~p_eq
     take_p = jnp.where(flip, p_gt, p_lt)

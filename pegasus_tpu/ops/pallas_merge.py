@@ -1,0 +1,151 @@
+"""Tier-2 merge kernel: merge-path chunking + whole-merge-in-VMEM Pallas.
+
+The XLA networks in ops.device_sort materialize every compare-exchange
+stage in HBM: a merge of length L costs ~log2(L) full passes (~24 at 16M).
+This kernel cuts that to ~2 HBM passes: the classic GPU "merge path"
+decomposition splits the output into fixed-size chunks along cross
+diagonals of the merge matrix, and a Pallas program per chunk loads its
+two input slices into VMEM, runs the ENTIRE bitonic merge there, and
+writes its finished output chunk once.
+
+  1. diagonal search (plain jnp, outside the kernel): for each output
+     position d = p*CHUNK, binary-search the split (ai, bi), ai+bi=d, such
+     that A[ai-1] < B[bi] and B[bi-1] < A[ai] in the strict lexicographic
+     column order (keys are unique by construction — the packed
+     klen<<8|prio column differs across runs).
+  2. pallas_call over grid=(P,): program p loads A[ai : ai+CHUNK] and
+     B[bi : bi+CHUNK] (padded loads; merge-path guarantees an output chunk
+     consumes at most CHUNK from each side), merges 2*CHUNK elements in
+     VMEM via the same compare-exchange stages as ops.device_sort, and
+     stores the first CHUNK — exactly out[d : d+CHUNK].
+
+Gated OFF by default (PEGASUS_PALLAS=1 enables): Mosaic lowering has not
+been validated on real TPU hardware in this environment (the tunnel was
+down); correctness is pinned against merge_two_sorted by interpret-mode
+tests (tests/test_pallas_merge.py) on the CPU mesh.
+"""
+
+import functools
+import os
+
+import numpy as np
+
+from .device_sort import _exchange
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("PEGASUS_PALLAS", "0") == "1"
+
+
+CHUNK = 2048  # output rows per program; 2*CHUNK*cols*4B stays well in VMEM
+
+
+def _lex_less_at(cols_a, ia, cols_b, ib):
+    """Strict a[ia] < b[ib], vectorized over index arrays (jnp)."""
+    import jax.numpy as jnp
+
+    less = jnp.zeros(ia.shape, dtype=bool)
+    eq = jnp.ones(ia.shape, dtype=bool)
+    for ca, cb in zip(cols_a, cols_b):
+        va = jnp.take(ca, ia, mode="clip")
+        vb = jnp.take(cb, ib, mode="clip")
+        less = less | (eq & (va < vb))
+        eq = eq & (va == vb)
+    return less
+
+
+def _diagonal_splits(a_cols, b_cols, nk, n_chunks):
+    """ai[p] for output diagonals d = p*CHUNK (bi = d - ai). Standard
+    merge-path binary search on the cross-diagonal predicate."""
+    import jax.numpy as jnp
+
+    la = a_cols[0].shape[0]
+    lb = b_cols[0].shape[0]
+    d = jnp.arange(n_chunks, dtype=jnp.int32) * CHUNK
+    lo = jnp.maximum(0, d - lb)
+    hi = jnp.minimum(d, la)
+    # invariant: the split ai is the count of A-elements among the first d
+    # of the merged order = |{i : A[i] < B[d-1-i]}| along the diagonal;
+    # binary search the monotone predicate A[mid] < B[d-1-mid]
+    steps = max(1, int(np.ceil(np.log2(max(2, min(la, lb) + 1)))) + 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        take_a = _lex_less_at(a_cols[:nk], mid, b_cols[:nk], d - 1 - mid)
+        lo = jnp.where(active & take_a, mid + 1, lo)
+        hi = jnp.where(active & ~take_a, mid, hi)
+    return lo  # == hi
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_merge(la, lb, n_ops, nk, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    L_out = la + lb
+    n_chunks = -(-L_out // CHUNK)
+
+    def fn(a_ops, b_ops, pad_fill):
+        # pad inputs so every CHUNK-window load is in bounds; pads sort last
+        # and merge-path never assigns them to a real output chunk
+        a_pad = [jnp.concatenate([c, jnp.full((CHUNK,), f, c.dtype)])
+                 for c, f in zip(a_ops, pad_fill)]
+        b_pad = [jnp.concatenate([c, jnp.full((CHUNK,), f, c.dtype)])
+                 for c, f in zip(b_ops, pad_fill)]
+        ai = _diagonal_splits(a_ops, b_ops, nk, n_chunks)
+        bi = jnp.arange(n_chunks, dtype=jnp.int32) * CHUNK - ai
+
+        # split points + full-array refs with manual dynamic slicing keeps
+        # the spec simple across pallas versions
+        grid = (n_chunks,)
+
+        def kernel(ai_ref, bi_ref, *refs):
+            p = pl.program_id(0)
+            a_refs = refs[:n_ops]
+            b_refs = refs[n_ops : 2 * n_ops]
+            out_refs = refs[2 * n_ops :]
+            a0 = ai_ref[p]
+            b0 = bi_ref[p]
+            cols = []
+            for ar, br in zip(a_refs, b_refs):
+                a = ar[pl.ds(a0, CHUNK)]
+                b = br[pl.ds(b0, CHUNK)]
+                cols.append(jnp.concatenate([a, b[::-1]]))
+            from jax import lax
+
+            L = 2 * CHUNK
+            iota = lax.iota(jnp.uint32, L)
+            j = L // 2
+            while j >= 1:
+                is_high = (iota & jnp.uint32(j)) != 0
+                cols = _exchange(cols, nk, j, is_high, mxu=False)
+                j //= 2
+            for out_ref, c in zip(out_refs, cols):
+                out_ref[pl.ds(p * CHUNK, CHUNK)] = c[:CHUNK]
+
+        out_shapes = [jax.ShapeDtypeStruct((n_chunks * CHUNK,), c.dtype)
+                      for c in a_ops]
+        merged = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 + 2 * n_ops),
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(ai, bi, *a_pad, *b_pad)
+        return [m[:L_out] for m in merged]
+
+    return jax.jit(fn)
+
+
+def merge_two_sorted_pallas(a_ops, b_ops, nk, pad_fill):
+    """Drop-in for device_sort.merge_two_sorted (returns exactly la+lb rows,
+    ascending; same strict-total-order requirement on the key columns)."""
+    import jax
+
+    la = int(a_ops[0].shape[0])
+    lb = int(b_ops[0].shape[0])
+    interpret = jax.default_backend() != "tpu"
+    fn = _compiled_merge(la, lb, len(a_ops), nk, interpret)
+    return fn(tuple(a_ops), tuple(b_ops), tuple(pad_fill))

@@ -266,8 +266,12 @@ class Replica:
 
     # ------------------------------------------------------------- plumbing
 
-    def gc_log(self):
-        self.server.engine.flush()
+    def gc_log(self, flush: bool = False):
+        """Drop log segments the durable SSTs cover. flush=True forces the
+        memtable down first (tests); the maintenance timer must NOT — a
+        periodic forced flush would churn tiny L0 files on idle tables."""
+        if flush:
+            self.server.engine.flush()
         self.plog.gc(self.server.engine.last_durable_decree())
 
     def close(self):

@@ -71,9 +71,11 @@ class _RemotePeer:
 
 class ReplicaStub:
     def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
-                 port: int = 0, options_factory=None):
+                 port: int = 0, options_factory=None,
+                 block_service_provider: str = "local_service"):
         self.root = root
         self.meta_addrs = list(meta_addrs)
+        self.block_service_provider = block_service_provider
         self.options_factory = options_factory or (lambda: EngineOptions(backend="cpu"))
         self.pool = ConnectionPool()
         self._lock = threading.RLock()
@@ -105,12 +107,34 @@ class ReplicaStub:
         self._stop = threading.Event()
         self._beacon_thread = threading.Thread(target=self._beacon_loop,
                                                daemon=True)
+        self._maint_thread = threading.Thread(target=self._maintenance_loop,
+                                              daemon=True)
 
-    def start(self, beacon_interval: float = 1.0) -> "ReplicaStub":
+    def start(self, beacon_interval: float = 1.0,
+              maintenance_interval: float = 60.0) -> "ReplicaStub":
         self._beacon_interval = beacon_interval
+        self._maint_interval = maintenance_interval
         self.send_beacon()
         self._beacon_thread.start()
+        self._maint_thread.start()
         return self
+
+    def _maintenance_loop(self):
+        """Per-replica timers (the reference's replica-level checkpoint timer
+        + manual-compact trigger checks, SURVEY §3.1/§3.5): periodic async
+        checkpoint, plog GC behind the durable decree, and env-driven
+        periodic manual compaction."""
+        while not self._stop.wait(self._maint_interval):
+            with self._lock:
+                reps = list(self._replicas.values())
+            for rep in reps:
+                try:
+                    rep.server.engine.async_checkpoint()
+                    rep.gc_log()
+                    rep.server.manual_compact_service \
+                        .start_manual_compact_if_needed(rep.server.app_envs)
+                except Exception as e:  # keep the timer alive
+                    print(f"[maintenance] {rep.name}: {e!r}", flush=True)
 
     # ------------------------------------------------------------- beacons
 
@@ -175,19 +199,15 @@ class ReplicaStub:
         return codec.encode(mm.OpenReplicaResponse(
             last_committed=rep.last_committed, last_prepared=rep.last_prepared))
 
-    @staticmethod
-    def _seed_from_restore(replica_path: str, restore_dir: str) -> None:
-        """Pre-open restore: copy backup checkpoint files into the data dir
-        (reference restore-rename at open, pegasus_server_impl.cpp:1339)."""
-        import shutil
+    def _seed_from_restore(self, replica_path: str, restore_dir: str) -> None:
+        """Pre-open restore: download backup checkpoint files into the data
+        dir through the block service (reference restore at open,
+        pegasus_server_impl.cpp:1339)."""
+        from ..runtime.block_service import create_block_service
 
         data = os.path.join(replica_path, "data")
-        os.makedirs(data, exist_ok=True)
-        if os.path.isdir(restore_dir):
-            for name in os.listdir(restore_dir):
-                src = os.path.join(restore_dir, name)
-                if os.path.isfile(src):
-                    shutil.copy2(src, os.path.join(data, name))
+        bs = create_block_service(self.block_service_provider, "/")
+        bs.download_dir(restore_dir, data)
 
     def _on_close_replica(self, header, body) -> bytes:
         req = codec.decode(mm.CloseReplicaRequest, body)
@@ -246,13 +266,24 @@ class ReplicaStub:
             last_committed=state["last_committed"], ballot=state["ballot"]))
 
     def _on_cold_backup(self, header, body) -> bytes:
-        """Checkpoint this partition into the backup destination dir."""
+        """Checkpoint this partition, then upload through the block service
+        (reference: copy_checkpoint_to_dir -> block service upload)."""
+        from ..runtime.block_service import create_block_service
+
         req = codec.decode(mm.OpenReplicaRequest, body)
         with self._lock:
             rep = self._replicas.get((req.app_id, req.pidx))
         if rep is None:
             raise RpcError(ERR_OBJECT_NOT_FOUND, "replica not served here")
-        decree = rep.server.engine.checkpoint(req.restore_dir)
+        engine = rep.server.engine
+        # hold the checkpoint lock across create+upload so a concurrent
+        # maintenance checkpoint can neither GC this decree nor swap the
+        # directory under the upload
+        with engine.checkpoint_lock:
+            decree = engine.sync_checkpoint()
+            src = engine.get_checkpoint_dir(decree)
+            bs = create_block_service(self.block_service_provider, "/")
+            bs.upload_dir(src, req.restore_dir)
         return codec.encode(mm.OpenReplicaResponse(last_committed=decree))
 
     def _on_bulk_load(self, header, body) -> bytes:

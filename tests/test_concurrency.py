@@ -1,0 +1,129 @@
+"""Concurrency stress: parallel clients over real sockets.
+
+The reference has no in-tree race detector; correctness under concurrency
+is tested behaviorally (SURVEY §5.2 — sanitizer builds + kill test). This
+tier drives many client threads at one onebox and asserts the atomicity
+contracts PacificA's per-partition write serialization must provide:
+incr is atomic, check_and_set admits exactly one winner, and multi_put
+batches are observed whole.
+"""
+
+import threading
+
+import pytest
+
+from pegasus_tpu.client import MetaResolver, PegasusClient
+from pegasus_tpu.rpc.messages import CasCheckType, Status
+from tests.test_satellites import MiniCluster
+
+N_THREADS = 8
+N_OPS = 25
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniCluster(tmp_path_factory.mktemp("conc"), n_nodes=3)
+    yield c
+    c.stop()
+
+
+def run_parallel(fn):
+    errs = []
+    threads = []
+    for t in range(N_THREADS):
+        def body(tid=t):
+            try:
+                # one client per thread: separate sockets, real contention
+                fn(tid)
+            except Exception as e:  # noqa: BLE001 - collected and asserted
+                errs.append(e)
+
+        threads.append(threading.Thread(target=body))
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errs, errs[:3]
+
+
+def test_concurrent_incr_is_atomic(cluster):
+    cluster.create("conc_incr", partitions=2).close()
+
+    def body(tid):
+        cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_incr"))
+        for _ in range(N_OPS):
+            cli.incr(b"shared", b"counter", 1)
+        cli.close()
+
+    run_parallel(body)
+    cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_incr"))
+    assert cli.get(b"shared", b"counter") == str(N_THREADS * N_OPS).encode()
+    cli.close()
+
+
+def test_concurrent_cas_single_winner_per_round(cluster):
+    cluster.create("conc_cas", partitions=2).close()
+    winners = [[] for _ in range(N_OPS)]
+
+    def body(tid):
+        cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_cas"))
+        for rnd in range(N_OPS):
+            r = cli.check_and_set(b"lock", b"r%d" % rnd,
+                                  CasCheckType.VALUE_NOT_EXIST, b"",
+                                  b"r%d" % rnd, b"owner%d" % tid)
+            if r.error == Status.OK:
+                winners[rnd].append(tid)
+        cli.close()
+
+    run_parallel(body)
+    for rnd, w in enumerate(winners):
+        assert len(w) == 1, f"round {rnd}: winners {w}"
+    cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_cas"))
+    for rnd, w in enumerate(winners):
+        assert cli.get(b"lock", b"r%d" % rnd) == b"owner%d" % w[0]
+    cli.close()
+
+
+def test_concurrent_multi_put_reads_are_whole(cluster):
+    """A reader never observes a half-applied multi_put batch."""
+    cluster.create("conc_mp", partitions=1).close()
+    stop = threading.Event()
+    bad = []
+
+    def writer():
+        cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_mp"))
+        for i in range(60):
+            cli.multi_set(b"row", {b"a": b"g%d" % i, b"b": b"g%d" % i,
+                                   b"c": b"g%d" % i})
+        cli.close()
+        stop.set()
+
+    def reader():
+        cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_mp"))
+        while not stop.is_set():
+            _, kvs = cli.multi_get(b"row")
+            if kvs and len(set(kvs.values())) != 1:
+                bad.append(dict(kvs))
+        cli.close()
+
+    ths = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(3)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    assert not bad, bad[:2]
+
+
+def test_concurrent_disjoint_writers_no_interference(cluster):
+    cluster.create("conc_disj", partitions=4).close()
+
+    def body(tid):
+        cli = PegasusClient(MetaResolver([cluster.meta_addr], "conc_disj"))
+        for i in range(N_OPS):
+            cli.set(b"t%d" % tid, b"s%d" % i, b"v%d.%d" % (tid, i))
+        for i in range(N_OPS):
+            assert cli.get(b"t%d" % tid, b"s%d" % i) == b"v%d.%d" % (tid, i)
+        cli.close()
+
+    run_parallel(body)

@@ -193,3 +193,27 @@ def test_tpu_backend_engine_end_to_end(tmp_path):
         outs[backend] = list(eng.scan(now=100))
     assert outs["cpu"] == outs["tpu"]
     assert len(outs["cpu"]) > 0
+
+
+def test_async_checkpoint_and_reserves(tmp_path):
+    eng = LsmEngine(str(tmp_path / "db"),
+                    EngineOptions(backend="cpu", checkpoint_reserve_min_count=2))
+    for gen in range(4):
+        for i in range(10):
+            eng.put(generate_key(b"h", b"s%02d" % i), enc(b"g%d" % gen))
+        eng.flush()  # async checkpoints snapshot DURABLE state, never flush
+        t = eng.async_checkpoint()
+        if t is not None:
+            t.join(timeout=30)
+    cps = eng.list_checkpoints()
+    assert len(cps) == 2  # count reserve GC'd the older ones
+    assert cps[-1] == eng.last_durable_decree()
+    # an up-to-date engine skips redundant checkpoints
+    assert eng.async_checkpoint() is None
+    # apply the latest checkpoint into a fresh dir: full state restored
+    restored = LsmEngine.apply_checkpoint(eng.get_checkpoint_dir(),
+                                          str(tmp_path / "restored"))
+    for i in range(10):
+        assert restored.get(generate_key(b"h", b"s%02d" % i), now=1) == enc(b"g3")
+    restored.close()
+    eng.close()

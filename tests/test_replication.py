@@ -192,7 +192,7 @@ def test_log_gc_after_flush(group):
     for i in range(30):
         group.write(RPC_PUT, put_req(i))
     prim = group.primary_replica()
-    prim.gc_log()
+    prim.gc_log(flush=True)
     assert prim.server.engine.last_durable_decree() >= 30
     # after gc the log still replays anything undurable (nothing here)
     for i in range(30):

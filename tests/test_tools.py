@@ -342,3 +342,23 @@ def test_client_factory_singleton(onebox, shell):
     assert c1 is c2
     c1.set(b"f", b"s", b"v")
     assert c2.get(b"f", b"s") == b"v"
+
+
+def test_block_service_local_provider(tmp_path):
+    from pegasus_tpu.runtime.block_service import create_block_service
+
+    bs = create_block_service("local_service", str(tmp_path / "store"))
+    src = tmp_path / "f.txt"
+    src.write_bytes(b"hello")
+    bs.upload(str(src), "backups/1/f.txt")
+    assert bs.exists("backups/1/f.txt")
+    assert bs.read("backups/1/f.txt") == b"hello"
+    assert bs.list_dir("backups/1") == ["f.txt"]
+    dst = tmp_path / "out" / "f.txt"
+    bs.download("backups/1/f.txt", str(dst))
+    assert dst.read_bytes() == b"hello"
+    bs.write("direct/x.bin", b"\x00\x01")
+    assert bs.read("direct/x.bin") == b"\x00\x01"
+    import pytest as _p
+    with _p.raises(ValueError):
+        bs.upload(str(src), "../escape.txt")
