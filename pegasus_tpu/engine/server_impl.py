@@ -118,19 +118,27 @@ class PegasusServer:
 
     def set_usage_scenario(self, scenario: str) -> bool:
         """normal / prefer_write / bulk_load tuning profiles
-        (src/server/pegasus_server_impl.cpp:2668-2738) mapped onto engine
-        knobs: write-heavy profiles defer compaction by raising the L0
-        trigger; bulk_load defers flushing too (big memtables)."""
+        (src/server/pegasus_server_impl.cpp:2668-2738) mapped onto the full
+        engine knob set the reference's SetOptions profiles reach:
+        L0 trigger, memtable budget, output file sizing, and level budgets
+        (bulk_load mirrors PrepareForBulkLoad: no auto compaction, huge
+        write buffers, everything deferred to the post-load manual compact)."""
         o = self.engine.opts
         if scenario == consts.USAGE_SCENARIO_NORMAL:
             o.l0_compaction_trigger = 4
             o.memtable_bytes = 64 << 20
+            o.target_file_size_bytes = 64 << 20
+            o.level_base_bytes = 256 << 20
         elif scenario == consts.USAGE_SCENARIO_PREFER_WRITE:
             o.l0_compaction_trigger = 10
             o.memtable_bytes = 128 << 20
+            o.target_file_size_bytes = 128 << 20
+            o.level_base_bytes = 512 << 20
         elif scenario == consts.USAGE_SCENARIO_BULK_LOAD:
             o.l0_compaction_trigger = 1 << 30  # no auto compaction
             o.memtable_bytes = 256 << 20
+            o.target_file_size_bytes = 256 << 20
+            o.level_base_bytes = 1 << 62       # no cascades during the load
         else:
             return False
         self._app_envs[consts.ENV_USAGE_SCENARIO_KEY] = scenario

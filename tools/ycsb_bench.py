@@ -1,0 +1,125 @@
+"""YCSB workload-A style benchmark over a live cluster (BASELINE.json
+config #3: 50/50 read/update, zipfian-ish keys, 32 hash partitions).
+
+Boots an in-process onebox (1 meta + 3 replica nodes over real sockets)
+unless --meta points at a running cluster, loads N records, then drives
+50/50 read/update from T client threads and reports ops/sec + latency
+percentiles as one JSON line.
+
+    python tools/ycsb_bench.py [--records 10000] [--ops 20000] [--threads 4]
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def zipf_key(rng, n, alpha=0.99):
+    """Cheap zipfian-ish pick: power-law over the key space."""
+    u = rng.random()
+    return int(n * (u ** (1.0 / (1.0 - alpha) if alpha != 1.0 else 3)))  # skewed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--meta", default="", help="existing cluster (host:port)")
+    ap.add_argument("--records", type=int, default=10_000)
+    ap.add_argument("--ops", type=int, default=20_000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--partitions", type=int, default=32)
+    ap.add_argument("--value_size", type=int, default=100)
+    ns = ap.parse_args()
+
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+
+    cluster = None
+    if ns.meta:
+        meta_addr = ns.meta
+    else:
+        import tempfile
+
+        from tests.test_satellites import MiniCluster
+
+        class _P:  # tmp_path-like
+            def __init__(self, d):
+                self.d = d
+
+            def __truediv__(self, other):
+                return _P(os.path.join(self.d, str(other)))
+
+            def __str__(self):
+                return self.d
+
+        cluster = MiniCluster(_P(tempfile.mkdtemp(prefix="ycsb_")), n_nodes=3)
+        meta_addr = cluster.meta_addr
+        cluster.create("ycsb", partitions=ns.partitions).close()
+
+    value = os.urandom(ns.value_size)
+    load_cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
+    t0 = time.perf_counter()
+    for i in range(ns.records):
+        load_cli.set(b"user%012d" % i, b"f0", value)
+    load_s = time.perf_counter() - t0
+    load_cli.close()
+
+    lat_us = []
+    lat_lock = threading.Lock()
+    errors = [0]
+
+    def worker(tid):
+        rng = random.Random(tid)
+        cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
+        local = []
+        for _ in range(ns.ops // ns.threads):
+            k = b"user%012d" % (zipf_key(rng, ns.records) % ns.records)
+            s = time.perf_counter()
+            try:
+                if rng.random() < 0.5:
+                    cli.get(k, b"f0")
+                else:
+                    cli.set(k, b"f0", value)
+            except Exception:
+                errors[0] += 1
+            local.append((time.perf_counter() - s) * 1e6)
+        with lat_lock:
+            lat_us.extend(local)
+        cli.close()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(ns.threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    run_s = time.perf_counter() - t0
+
+    lat_us.sort()
+    n = len(lat_us)
+    result = {
+        "metric": f"YCSB-A 50/50 read-update, {ns.partitions} partitions, "
+                  f"{ns.threads} threads, {ns.records} records",
+        "value": round(n / run_s, 1),
+        "unit": "ops/s",
+        "detail": {
+            "load_s": round(load_s, 2),
+            "load_ops_s": round(ns.records / load_s, 1),
+            "run_s": round(run_s, 2),
+            "avg_us": round(sum(lat_us) / n, 1),
+            "p99_us": round(lat_us[int(n * 0.99)], 1),
+            "errors": errors[0],
+        },
+    }
+    print(json.dumps(result))
+    if cluster is not None:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()
