@@ -139,6 +139,7 @@ class LsmEngine:
         # dir would otherwise race between the maintenance timer and RPC
         # threads); RLock so callers can hold it across create+consume
         self.checkpoint_lock = threading.RLock()
+        self._flush_lock = threading.Lock()  # one flush drainer at a time
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -166,6 +167,7 @@ class LsmEngine:
         atomically under the engine lock."""
         if _fail("db_write"):
             raise IOError("injected db_write failure")
+        rotated = False
         with self._lock:
             for op in batch.ops:
                 kind, key, value, expire = op
@@ -184,6 +186,12 @@ class LsmEngine:
             self._mem.last_decree = decree
             if self._mem.approximate_bytes >= self.opts.memtable_bytes:
                 self._rotate_memtable_locked()
+                rotated = True
+        if rotated:
+            # a full memtable must reach disk; done outside the mutation
+            # loop's critical section (the reference stalls writes the same
+            # way when memtables back up)
+            self._drain_imms()
 
     def put(self, key: bytes, value: bytes, expire_ts: int = 0, decree: int = None):
         d = decree if decree is not None else self._last_committed_decree + 1
@@ -323,9 +331,20 @@ class LsmEngine:
         order and the durable-decree invariant."""
         with self._lock:
             self._rotate_memtable_locked()
-            imms = list(self._imm)
-        for imm in reversed(imms):
-            self._flush_one(imm)
+        self._drain_imms()
+
+    def _drain_imms(self) -> None:
+        """Flush pending immutables oldest-first. The flush lock serializes
+        concurrent drainers (writer threads + explicit flush calls): without
+        it two threads could flush the same memtable, or a newer one could
+        reach disk first and falsely advance the durable decree."""
+        with self._flush_lock:
+            while True:
+                with self._lock:
+                    if not self._imm:
+                        return
+                    imm = self._imm[-1]  # list is newest-first: take oldest
+                self._flush_one(imm)
 
     def _rotate_memtable_locked(self):
         if len(self._mem) == 0:
