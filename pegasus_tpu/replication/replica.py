@@ -59,6 +59,17 @@ class GroupView:
     secondaries: list
 
 
+class _WriteSlot:
+    __slots__ = ("code", "req", "resp", "err", "done")
+
+    def __init__(self, code, req):
+        self.code = code
+        self.req = req
+        self.resp = None
+        self.err = None
+        self.done = False
+
+
 class Replica:
     """One partition replica. `peers` is a callable transport:
     peers(name) -> Replica-like proxy (direct object in-process; an RPC stub
@@ -81,6 +92,9 @@ class Replica:
                                     pidx=pidx, options=options, server=name)
         self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
         self._uncommitted = {}   # decree -> LogMutation (prepared, not applied)
+        self._batch_cv = threading.Condition()
+        self._batch_pending = []      # _WriteSlots awaiting a group commit
+        self._batch_leader_active = False
         self.commit_hooks = []   # fn(LogMutation) after commit (duplication)
         self.last_committed = self.server.engine.last_committed_decree()
         self.last_prepared = self.last_committed
@@ -115,29 +129,74 @@ class Replica:
     # -------------------------------------------------------------- primary
 
     def client_write(self, code: str, req, now: int = None):
-        """The write path: 2PC from the primary (SURVEY §3.2 hot path)."""
-        with self._lock:
-            if self.status != PRIMARY:
-                raise ReplicaError(f"{self.name} is not primary")
-            decree = self.last_prepared + 1
-            m = LogMutation(decree=decree, ballot=self.ballot,
-                            timestamp_us=int(time.time() * 1e6),
-                            codes=[code], bodies=[codec.encode(req)])
-            self.plog.append(m)
-            self.last_prepared = decree
-            self._uncommitted[decree] = m
-            acks = 1
-            alive = []
-            for peer_name in self.view.secondaries:
-                if self._send_prepare(peer_name, m):
-                    acks += 1
-                    alive.append(peer_name)
-            if acks < self.quorum:
-                # cannot commit; leave prepared (a later view change decides)
-                raise ReplicaError(
-                    f"quorum lost: {acks}/{self.quorum} for decree {decree}")
-            resp = self._apply_up_to(decree, now=now)
-            return resp
+        """The write path: 2PC from the primary (SURVEY §3.2 hot path).
+
+        Batchable codes (put/remove) GROUP-COMMIT: concurrent writers
+        coalesce into one decree — one log append and one prepare round for
+        the whole batch, the reference's on_batched_writes shape
+        (src/server/pegasus_server_write.cpp:64-110). Non-batchable codes
+        (read-modify-write) commit alone."""
+        from ..rpc.task_codes import BATCHABLE
+
+        if code not in BATCHABLE:
+            with self._lock:
+                return self._commit_batch([(code, req)], now=now)[0]
+        slot = _WriteSlot(code, req)
+        with self._batch_cv:
+            self._batch_pending.append(slot)
+        while True:
+            with self._batch_cv:
+                if slot.done:
+                    break
+                if self._batch_leader_active:
+                    self._batch_cv.wait(0.05)
+                    continue
+                self._batch_leader_active = True
+                batch = self._batch_pending
+                self._batch_pending = []
+            # this thread leads one group commit (outside the cv so arriving
+            # writers can queue for the NEXT batch meanwhile)
+            try:
+                with self._lock:
+                    resps = self._commit_batch(
+                        [(s.code, s.req) for s in batch], now=now)
+                for s, r in zip(batch, resps):
+                    s.resp = r
+            except Exception as e:  # every waiter must see the failure, not
+                for s in batch:     # a silent resp=None "success"
+                    s.err = e if isinstance(e, ReplicaError) \
+                        else ReplicaError(f"group commit failed: {e!r}")
+            finally:
+                with self._batch_cv:
+                    self._batch_leader_active = False
+                    for s in batch:
+                        s.done = True
+                    self._batch_cv.notify_all()
+        if slot.err is not None:
+            raise slot.err
+        return slot.resp
+
+    def _commit_batch(self, reqs, now=None):
+        """One decree for `reqs`; caller holds self._lock."""
+        if self.status != PRIMARY:
+            raise ReplicaError(f"{self.name} is not primary")
+        decree = self.last_prepared + 1
+        m = LogMutation(decree=decree, ballot=self.ballot,
+                        timestamp_us=int(time.time() * 1e6),
+                        codes=[c for c, _ in reqs],
+                        bodies=[codec.encode(r) for _, r in reqs])
+        self.plog.append(m)
+        self.last_prepared = decree
+        self._uncommitted[decree] = m
+        acks = 1
+        for peer_name in self.view.secondaries:
+            if self._send_prepare(peer_name, m):
+                acks += 1
+        if acks < self.quorum:
+            # cannot commit; leave prepared (a later view change decides)
+            raise ReplicaError(
+                f"quorum lost: {acks}/{self.quorum} for decree {decree}")
+        return self._apply_up_to(decree, now=now)
 
     def _send_prepare(self, peer_name: str, m: LogMutation) -> bool:
         try:
@@ -185,8 +244,10 @@ class Replica:
     # ---------------------------------------------------------------- apply
 
     def _apply_up_to(self, decree: int, now: int = None):
-        """Commit staged mutations in order through the storage engine."""
-        last_resp = None
+        """Commit staged mutations in order through the storage engine.
+        Returns the response LIST of the final decree applied (the group
+        commit's per-request responses, in request order)."""
+        last_resps = None
         while self.last_committed < decree:
             d = self.last_committed + 1
             m = self._uncommitted.pop(d, None)
@@ -198,11 +259,11 @@ class Replica:
                 reqs.append((code, codec.decode(req_cls, body)))
             resps = self.server.on_batched_write_requests(
                 d, m.timestamp_us, reqs, now=now)
-            last_resp = resps[0] if resps else None
+            last_resps = resps
             self.last_committed = d
             for hook in self.commit_hooks:
                 hook(m)
-        return last_resp
+        return last_resps
 
     # --------------------------------------------------------------- learner
 
