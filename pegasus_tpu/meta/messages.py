@@ -103,6 +103,32 @@ class BeaconResponse:
 
 
 @dataclass
+class ProposeRequest:
+    """Move a partition's primary (the balancer's move_primary action)."""
+
+    app_name: str = ""
+    pidx: int = 0
+    target: str = ""                  # must be a current secondary
+
+
+@dataclass
+class ProposeResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+@dataclass
+class BalanceRequest:
+    pass
+
+
+@dataclass
+class BalanceResponse:
+    error: int = 0
+    moved: int = 0
+
+
+@dataclass
 class NodeInfo:
     address: str = ""
     alive: bool = True

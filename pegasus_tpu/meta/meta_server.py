@@ -32,6 +32,8 @@ RPC_CM_SPLIT_APP = "RPC_CM_START_PARTITION_SPLIT"
 RPC_CM_BACKUP_APP = "RPC_CM_START_BACKUP_APP"
 RPC_CM_RESTORE_APP = "RPC_CM_START_RESTORE"
 RPC_CM_START_BULK_LOAD = "RPC_CM_START_BULK_LOAD"
+RPC_CM_PROPOSE = "RPC_CM_PROPOSE_BALANCER"
+RPC_CM_BALANCE = "RPC_CM_START_BALANCE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
 # meta -> replica node
@@ -70,6 +72,8 @@ class MetaServer:
             RPC_CM_BACKUP_APP: self._on_backup_app,
             RPC_CM_RESTORE_APP: self._on_restore_app,
             RPC_CM_START_BULK_LOAD: self._on_start_bulk_load,
+            RPC_CM_PROPOSE: self._on_propose,
+            RPC_CM_BALANCE: self._on_balance,
             RPC_FD_BEACON: self._on_beacon,
         }
 
@@ -361,6 +365,73 @@ class MetaServer:
                     error=1, error_text=f"partition {pc.pidx} ingest error"))
             total += resp.ingested_records
         return codec.encode(mm.StartBulkLoadResponse(ingested_records=total))
+
+    # --------------------------------------------------------------- balance
+
+    def _on_propose(self, header, body) -> bytes:
+        """Move one partition's primary to a named secondary (the
+        greedy_load_balancer's move_primary proposal, shell `propose`)."""
+        req = codec.decode(mm.ProposeRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.ProposeResponse(error=1,
+                                                       error_text="no such app"))
+            parts = self._parts[app.app_id]
+            if not (0 <= req.pidx < len(parts)):
+                return codec.encode(mm.ProposeResponse(error=1,
+                                                       error_text="bad pidx"))
+            pc = parts[req.pidx]
+            if req.target not in pc.secondaries:
+                return codec.encode(mm.ProposeResponse(
+                    error=1, error_text=f"{req.target} is not a secondary"))
+            pc.ballot += 1
+            pc.secondaries.remove(req.target)
+            pc.secondaries.append(pc.primary)
+            pc.primary = req.target
+            self._persist_locked()
+        self._install_partition(app, pc)
+        return codec.encode(mm.ProposeResponse())
+
+    def _on_balance(self, header, body) -> bytes:
+        """Greedy primary balancing: while the most-loaded node holds 2+
+        more primaries than the least-loaded, demote one whose partition
+        has a secondary on the lighter node (the greedy_load_balancer's
+        primary-count equalization)."""
+        moved = 0
+        for _ in range(64):  # bounded passes
+            with self._lock:
+                alive = self._alive_nodes_locked()
+                if len(alive) < 2:
+                    break
+                counts = {a: 0 for a in alive}
+                for parts in self._parts.values():
+                    for pc in parts:
+                        if pc.primary in counts:
+                            counts[pc.primary] += 1
+                heavy = max(alive, key=lambda a: counts[a])
+                light = min(alive, key=lambda a: counts[a])
+                if counts[heavy] - counts[light] < 2:
+                    break
+                move = None
+                for app in self._apps.values():
+                    for pc in self._parts[app.app_id]:
+                        if pc.primary == heavy and light in pc.secondaries:
+                            move = (app, pc)
+                            break
+                    if move:
+                        break
+                if move is None:
+                    break
+                app, pc = move
+                pc.ballot += 1
+                pc.secondaries.remove(light)
+                pc.secondaries.append(pc.primary)
+                pc.primary = light
+                self._persist_locked()
+            self._install_partition(app, pc)
+            moved += 1
+        return codec.encode(mm.BalanceResponse(moved=moved))
 
     def _on_list_nodes(self, header, body) -> bytes:
         with self._lock:

@@ -71,6 +71,9 @@ class Shell:
                               "perf_counters <node> [prefix]"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
+            "propose": (self.cmd_propose,
+                        "propose <pidx> <target_node> — move primary"),
+            "balance": (self.cmd_balance, "equalize primary counts"),
             "sst_dump": (self.cmd_sst_dump,
                          "sst_dump <file.sst> [max_rows] — offline SST reader"),
             "mlog_dump": (self.cmd_mlog_dump,
@@ -337,6 +340,22 @@ class Shell:
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]
         self.p(self._node_command(node, "detect_hotkey", rest))
+
+    def cmd_propose(self, args):
+        from ..meta.meta_server import RPC_CM_PROPOSE
+
+        r = self._meta_call(RPC_CM_PROPOSE,
+                            mm.ProposeRequest(self.current_app, int(args[0]),
+                                              args[1]),
+                            mm.ProposeResponse)
+        self.p(f"ERROR: {r.error_text}" if r.error else "OK")
+
+    def cmd_balance(self, args):
+        from ..meta.meta_server import RPC_CM_BALANCE
+
+        r = self._meta_call(RPC_CM_BALANCE, mm.BalanceRequest(),
+                            mm.BalanceResponse)
+        self.p(f"moved {r.moved} primaries")
 
     # offline debuggers ---------------------------------------------------
     # (reference src/shell/commands/debugger.cpp: sst_dump / mlog_dump /

@@ -169,3 +169,36 @@ def test_meta_state_survives_restart(tmp_path):
         assert len(m2._parts[m2._apps["t6"].app_id]) == 2
     finally:
         c.stop()
+
+
+def test_propose_and_balance(cluster):
+    c = make_client(cluster, app="bal", partitions=8)
+    for i in range(16):
+        c.set(b"balk%d" % i, b"s", b"v%d" % i)
+    app_id = c.resolver.app_id
+    pc = cluster.meta._parts[app_id][0]
+    target = pc.secondaries[0]
+    old_primary = pc.primary
+    r = cluster.ddl("RPC_CM_PROPOSE_BALANCER",
+                    mm.ProposeRequest("bal", 0, target), mm.ProposeResponse)
+    assert r.error == 0
+    assert pc.primary == target and old_primary in pc.secondaries
+    # data still fully served after the primary move
+    for i in range(16):
+        assert c.get(b"balk%d" % i, b"s") == b"v%d" % i
+    # skew primaries onto one node, then balance
+    node0 = cluster.meta._alive_nodes_locked()[0]
+    for pc in cluster.meta._parts[app_id]:
+        if pc.primary != node0 and node0 in pc.secondaries:
+            cluster.ddl("RPC_CM_PROPOSE_BALANCER",
+                        mm.ProposeRequest("bal", pc.pidx, node0),
+                        mm.ProposeResponse)
+    r = cluster.ddl("RPC_CM_START_BALANCE", mm.BalanceRequest(),
+                    mm.BalanceResponse)
+    counts = {}
+    for pc in cluster.meta._parts[app_id]:
+        counts[pc.primary] = counts.get(pc.primary, 0) + 1
+    assert max(counts.values()) - min(counts.values()) <= 2
+    for i in range(16):
+        assert c.get(b"balk%d" % i, b"s") == b"v%d" % i
+    c.close()
