@@ -315,10 +315,13 @@ class Scanner:
             raise PegasusError(resp.error)
         self._batch = resp.kvs
         self._bi = 0
-        if resp.context_id == consts.SCAN_CONTEXT_ID_COMPLETED or not resp.kvs:
+        if resp.context_id == consts.SCAN_CONTEXT_ID_COMPLETED:
             self._ctx = None
             self._cur += 1
         else:
+            # an EMPTY batch can still be incomplete: the server's range
+            # limiter may spend its whole budget on filtered-out rows —
+            # keep the session and fetch again
             self._ctx = resp.context_id
 
     def close(self):

@@ -396,30 +396,27 @@ class PegasusServer:
             if pstart > start:
                 start = pstart
         it = self.engine.scan(start, stop, now=now)
+        return self._fill_scan_batch(resp, it, req, now)
 
-        def filtered():
-            first = True
-            for k, raw, expire in it:
-                if first and not req.start_inclusive and k == req.start_key:
-                    first = False
-                    continue
-                first = False
-                if req.stop_key and k == req.stop_key and not req.stop_inclusive:
-                    continue
-                hk, sk = key_schema.restore_key(k)
-                if not match_filter(req.hash_key_filter_type,
-                                    req.hash_key_filter_pattern, hk):
-                    continue
-                if not match_filter(req.sort_key_filter_type,
-                                    req.sort_key_filter_pattern, sk):
-                    continue
-                if req.validate_partition_hash and self.engine.opts.partition_mask > 0:
-                    if not key_schema.check_key_hash(k, self.pidx,
-                                                     self.engine.opts.partition_mask):
-                        continue
-                yield k, raw, expire
-
-        return self._fill_scan_batch(resp, filtered(), req, now)
+    def _scan_row_passes(self, req, k: bytes) -> bool:
+        """The per-row filter set of append_key_value_for_scan
+        (pegasus_server_impl.cpp:2094-2166)."""
+        if not req.start_inclusive and k == req.start_key:
+            return False
+        if req.stop_key and k == req.stop_key and not req.stop_inclusive:
+            return False
+        hk, sk = key_schema.restore_key(k)
+        if not match_filter(req.hash_key_filter_type,
+                            req.hash_key_filter_pattern, hk):
+            return False
+        if not match_filter(req.sort_key_filter_type,
+                            req.sort_key_filter_pattern, sk):
+            return False
+        if req.validate_partition_hash and self.engine.opts.partition_mask > 0:
+            if not key_schema.check_key_hash(k, self.pidx,
+                                             self.engine.opts.partition_mask):
+                return False
+        return True
 
     def on_scan(self, req: msg.ScanRequest, now: int = None) -> msg.ScanResponse:
         """src/server/pegasus_server_impl.cpp:1151: resume a pinned session."""
@@ -437,14 +434,26 @@ class PegasusServer:
         self._contexts.remove(context_id)
 
     def _fill_scan_batch(self, resp, iterator, req, now, ctx=None):
+        """Pull RAW engine rows: every iterated row (filtered out or not)
+        charges the per-RPC limiter, so sparse-filter scans cannot pin a
+        read thread unboundedly (reference scan loop under
+        range_read_limiter, pegasus_server_impl.cpp:1000-1150)."""
         batch = max(1, req.batch_size)
+        limiter = self._make_limiter()
         n = 0
         exhausted = True
         for k, raw, expire in iterator:
+            limiter.add_count()
+            if not limiter.valid():
+                exhausted = False  # partial batch; session continues
+                break
+            if not self._scan_row_passes(req, k):
+                continue
             data = b"" if req.no_value else self._schema.extract_user_data(raw)
             kv = msg.KeyValue(k, data)
             if req.return_expire_ts:
                 kv.expire_ts_seconds = expire
+            limiter.add_size(len(k) + len(data))
             resp.kvs.append(kv)
             n += 1
             if n >= batch:

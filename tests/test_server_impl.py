@@ -318,3 +318,32 @@ def test_ttl_expired_read_returns_not_found(srv):
     put(srv, b"exp", b"s", b"v", expire=100)
     assert get(srv, b"exp", b"s", now=99) == b"v"
     assert get(srv, b"exp", b"s", now=101) is None
+
+
+def test_scan_limiter_partial_batches_resume(srv):
+    """A sparse filter over a big range must not pin the read thread: the
+    limiter yields partial (even empty) batches that resume by context."""
+    for i in range(120):
+        put(srv, b"scl", b"s%03d" % i, b"v")
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "25"})
+    try:
+        req = msg.GetScannerRequest(
+            start_key=key_schema.generate_key(b"scl", b""),
+            stop_key=key_schema.generate_next_bytes(b"scl"),
+            batch_size=1000, validate_partition_hash=False,
+            sort_key_filter_type=FilterType.MATCH_POSTFIX,
+            sort_key_filter_pattern=b"7")  # 12 of 120 rows match
+        r = srv.on_get_scanner(req)
+        got = [kv.key for kv in r.kvs]
+        rounds = 1
+        while r.context_id >= 0:
+            r = srv.on_scan(msg.ScanRequest(r.context_id))
+            got.extend(kv.key for kv in r.kvs)
+            rounds += 1
+            assert rounds < 50
+        assert rounds >= 4  # the 25-row budget forced several round trips
+        from pegasus_tpu.base.key_schema import restore_key
+        assert sorted(restore_key(k)[1] for k in got) == \
+            sorted(b"s%03d" % i for i in range(120) if (b"s%03d" % i).endswith(b"7"))
+    finally:
+        srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "1000"})
