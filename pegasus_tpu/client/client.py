@@ -47,18 +47,31 @@ class StaticResolver:
     def refresh(self) -> None:
         pass  # static map: nothing to re-query
 
+    def secondaries(self, pidx: int) -> list:
+        return []  # static maps carry no membership info
+
     def resolve(self, pidx: int, refresh: bool = False):
         return self._addresses[pidx]
 
 
+_READ_CODES = frozenset({codes.RPC_GET, codes.RPC_MULTI_GET, codes.RPC_TTL,
+                         codes.RPC_SORTKEY_COUNT})
+
+
 class PegasusClient:
-    """Synchronous client for one table (app)."""
+    """Synchronous client for one table (app).
+
+    backup_request=True sends failed READS to a secondary before waiting
+    on reconfiguration (the reference's backup-request path: lower tail
+    latency and availability at the cost of possibly-stale reads; scans
+    stay on the primary — their sessions are server-pinned)."""
 
     def __init__(self, resolver, pool: ConnectionPool = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, backup_request: bool = False):
         self.resolver = resolver
         self.pool = pool or ConnectionPool()
         self.timeout = timeout
+        self.backup_request = backup_request
 
     # ------------------------------------------------------------ internals
 
@@ -87,15 +100,39 @@ class PegasusClient:
             except OSError as e:  # dead node: connect refused/reset
                 last = e
                 self.pool.invalidate(addr)
+                backup = self._try_backup_read(code, body, pidx, phash, resp_cls)
+                if backup is not None:
+                    return backup[0]
                 continue
             except RpcError as e:
                 last = e
                 if e.err in (ERR_NETWORK_FAILURE, ERR_TIMEOUT,
                              ERR_OBJECT_NOT_FOUND, ERR_INVALID_STATE):
                     self.pool.invalidate(addr)
+                    if e.err in (ERR_NETWORK_FAILURE, ERR_TIMEOUT):
+                        backup = self._try_backup_read(code, body, pidx,
+                                                       phash, resp_cls)
+                        if backup is not None:
+                            return backup[0]
                     continue  # re-resolve (reconfiguration / failover)
                 raise PegasusError(Status.IO_ERROR, str(e))
         raise PegasusError(Status.TRY_AGAIN, str(last))
+
+    def _try_backup_read(self, code, body, pidx, phash, resp_cls):
+        """-> (decoded,) from a secondary, or None. Reads only."""
+        if not self.backup_request or code not in _READ_CODES:
+            return None
+        for addr in self.resolver.secondaries(pidx):
+            try:
+                conn = self.pool.get(addr)
+                _, rbody = conn.call(code, body, app_id=self.resolver.app_id,
+                                     partition_index=pidx, partition_hash=phash,
+                                     timeout=self.timeout)
+                return (codec.decode(resp_cls, rbody) if resp_cls else None,)
+            except (RpcError, OSError):
+                self.pool.invalidate(addr)
+                continue
+        return None
 
     def _key_call(self, code, hash_key, sort_key, resp_cls):
         key = key_schema.generate_key(hash_key, sort_key)

@@ -37,6 +37,17 @@ class MetaResolver:
     def refresh(self) -> None:
         self._refresh()
 
+    def secondaries(self, pidx: int) -> list:
+        """(host, port) of the partition's secondaries — the backup-request
+        targets (reads only; may serve slightly stale data)."""
+        with self._lock:
+            secs = list(self._partitions[pidx].secondaries)
+        out = []
+        for s in secs:
+            host, _, port = s.rpartition(":")
+            out.append((host, int(port)))
+        return out
+
     def resolve(self, pidx: int, refresh: bool = False):
         if refresh:
             self._refresh()
