@@ -35,8 +35,14 @@ import numpy as np
 
 
 def _enable_compile_cache():
+    import jax
+
     from pegasus_tpu.base.utils import enable_compile_cache
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the image re-asserts the axon platform over the env var; the
+        # config API wins over both (matches tests/conftest + dryrun)
+        jax.config.update("jax_platforms", "cpu")
     enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -119,8 +125,10 @@ def _arm_watchdog():
     def boom():
         import sys
 
-        print(f"bench watchdog: no result after {budget}s "
-              f"(TPU tunnel wedged?); aborting", file=sys.stderr, flush=True)
+        print(f"bench watchdog: no result after {budget}s — the TPU device "
+              f"tunnel is likely wedged (device-lease retry loop; observed "
+              f"after clients are killed mid-run). Last recorded measurements "
+              f"are in BASELINE.md. Aborting.", file=sys.stderr, flush=True)
         os._exit(3)
 
     t = threading.Timer(budget, boom)
