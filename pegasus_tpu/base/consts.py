@@ -52,6 +52,9 @@ USER_SPECIFIED_COMPACTION = "user_specified_compaction"
 # the partition no longer owns (reference set_partition_version)
 REPLICA_PARTITION_VERSION = "replica.partition_version"
 
+# per-table SST compression (the rocksdb compression_type knob)
+ROCKSDB_COMPRESSION_TYPE = "rocksdb.compression_type"
+
 # range-read limiter thresholds (src/server/range_read_limiter.h flags)
 ROCKSDB_ITERATION_THRESHOLD_COUNT = "replica.rocksdb_max_iteration_count"
 ROCKSDB_ITERATION_THRESHOLD_SIZE = "replica.rocksdb_max_iteration_size"

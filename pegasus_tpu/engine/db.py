@@ -75,6 +75,7 @@ class EngineOptions:
     checkpoint_reserve_min_count: int = 2
     checkpoint_reserve_time_seconds: int = 0  # 0 = no time-based retention
     user_ops: tuple = ()            # parsed user-specified compaction rules
+    compression: str = "none"       # SST section compression: none | zlib
 
 
 @dataclass
@@ -367,7 +368,8 @@ class LsmEngine:
             name = self._alloc_file_locked()
             path = os.path.join(self.path, name)
         write_sst(path, sorted_block, {"level": 0,
-                                       "last_flushed_decree": imm.last_decree})
+                                       "last_flushed_decree": imm.last_decree},
+                  compression=self.opts.compression)
         with self._lock:
             self._l0.insert(0, SSTable(path))
             self._imm.remove(imm)
@@ -466,7 +468,8 @@ class LsmEngine:
             with self._lock:
                 path = os.path.join(self.path, self._alloc_file_locked())
             write_sst(path, ob, {"level": target_level,
-                                 "last_flushed_decree": self._durable_decree})
+                                 "last_flushed_decree": self._durable_decree},
+                      compression=self.opts.compression)
             new_ssts.append(SSTable(path))
         with self._lock:
             # swap the new files in and every input file out atomically —
@@ -527,7 +530,8 @@ class LsmEngine:
         with self._lock:
             path = os.path.join(self.path, self._alloc_file_locked())
         write_sst(path, block, {"level": 0, "ingested": True,
-                                "last_flushed_decree": self._durable_decree})
+                                "last_flushed_decree": self._durable_decree},
+                  compression=self.opts.compression)
         with self._lock:
             self._l0.insert(0, SSTable(path))
             self._write_manifest_locked()

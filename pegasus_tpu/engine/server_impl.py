@@ -104,6 +104,9 @@ class PegasusServer:
                     setattr(self.engine.opts, opt, max(0, int(v)))
                 except (TypeError, ValueError):
                     print(f"[app-envs] bad {env_key}={v!r} ignored", flush=True)
+        comp = envs.get(consts.ROCKSDB_COMPRESSION_TYPE)
+        if comp in ("none", "zlib"):
+            self.engine.opts.compression = comp
         pv = envs.get(consts.REPLICA_PARTITION_VERSION)
         if pv is not None:
             # post-split ownership mask: compaction drops keys whose hash no

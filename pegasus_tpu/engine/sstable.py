@@ -56,18 +56,29 @@ def _bloom_build(hash32: np.ndarray) -> tuple:
     return bits.tobytes(), log2m
 
 
-def write_sst(path: str, block: KVBlock, meta: dict = None) -> dict:
-    """Write atomically (tmp+rename). Returns the header dict."""
+def write_sst(path: str, block: KVBlock, meta: dict = None,
+              compression: str = "none") -> dict:
+    """Write atomically (tmp+rename). Returns the header dict.
+
+    compression="zlib" deflates each section (the per-table rocksdb
+    compression knob, reference value-compression options); readers
+    auto-detect from the header, so tables can mix files."""
+    import zlib
+
     sections = {}
     payload = []
     offset = 0
     for name, dtype in _COLUMNS:
         arr = np.ascontiguousarray(getattr(block, name), dtype=dtype)
         raw = arr.tobytes()
-        sections[name] = {"offset": offset, "nbytes": len(raw), "dtype": np.dtype(dtype).str,
-                          "shape": list(arr.shape)}
-        payload.append(raw)
-        offset += len(raw)
+        stored = zlib.compress(raw, 1) if compression == "zlib" else raw
+        sections[name] = {"offset": offset, "nbytes": len(stored),
+                          "raw_nbytes": len(raw),
+                          "dtype": np.dtype(dtype).str,
+                          "shape": list(arr.shape),
+                          "compression": compression}
+        payload.append(stored)
+        offset += len(stored)
     bloom_hex, bloom_log2m = "", 0
     if block.n:
         bloom_bits, bloom_log2m = _bloom_build(block.hash32)
@@ -119,6 +130,10 @@ def read_sst(path: str) -> tuple:
             sec = header["sections"][name]
             f.seek(base + sec["offset"])
             raw = f.read(sec["nbytes"])
+            if sec.get("compression", "none") == "zlib":
+                import zlib
+
+                raw = zlib.decompress(raw)
             cols[name] = np.frombuffer(raw, dtype=np.dtype(sec["dtype"])).reshape(sec["shape"]).copy()
     return KVBlock(**cols), header
 
