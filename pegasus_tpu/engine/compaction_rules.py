@@ -222,7 +222,9 @@ def apply_operations(block, ops, now: int):
     if not ops or n == 0:
         return drop, False
     ctx = {"block": block, "now": now, "parts": _key_parts_matrix(block)}
-    unhandled = np.ones(n, dtype=bool)
+    # Deletion markers are never offered to the filter (RocksDB invokes
+    # compaction filters on values only, never on tombstones).
+    unhandled = ~np.asarray(block.deleted, dtype=bool)
     changed = False
     for op in ops:
         mask = op.all_rules_match(ctx) & unhandled
@@ -243,12 +245,19 @@ def _rewrite_expire(block, new_expire: np.ndarray, mask: np.ndarray) -> None:
     """In-place expire_ts rewrite in both the column and the value bytes
     (v0/v1: offset 0; self-describing v2: offset 1)."""
     idx = np.nonzero(mask)[0]
+    block.expire_ts[idx] = new_expire[idx]
+    # Records whose serialized value cannot hold the expire header (zero-
+    # length tombstone/empty values) must not be written through: 4 bytes at
+    # their offset land in the NEXT record's header (or off the arena end).
+    idx = idx[block.val_len[idx] > 0]
+    if len(idx) == 0:
+        return
     off = block.val_off[idx]
-    has_hdr = block.val_len[idx] > 0
-    first = np.where(has_hdr,
-                     block.val_arena[np.minimum(off, max(len(block.val_arena) - 1, 0))], 0)
-    off = off + np.where((first & 0x80) != 0, 1, 0)
+    first = block.val_arena[off]
+    is_v2 = (first & 0x80) != 0
+    fits = block.val_len[idx] >= np.where(is_v2, 5, 4)
+    idx, off, is_v2 = idx[fits], off[fits], is_v2[fits]
+    off = off + np.where(is_v2, 1, 0)
     vals = new_expire[idx]
     for j, shift in enumerate((24, 16, 8, 0)):
         block.val_arena[off + j] = ((vals >> shift) & 0xFF).astype(np.uint8)
-    block.expire_ts[idx] = vals

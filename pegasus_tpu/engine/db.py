@@ -141,6 +141,11 @@ class LsmEngine:
         # threads); RLock so callers can hold it across create+consume
         self.checkpoint_lock = threading.RLock()
         self._flush_lock = threading.Lock()  # one flush drainer at a time
+        # serializes compact()/_maybe_cascade()/manual_compact() merge
+        # phases: two concurrent merges over overlapping input snapshots
+        # would write the same records into two output sets and double-
+        # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
+        self._compaction_lock = threading.RLock()
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -392,19 +397,21 @@ class LsmEngine:
         into range-partitioned L1 output — the CompactRange analogue and the
         TPU seam (reference executor: src/server/pegasus_server_impl.cpp:2814).
         Cascades size-triggered single-file compactions down the levels."""
-        with self._lock:
-            inputs = list(self._l0)
-            nonzero = [s for s in inputs if s.n]
-            if not nonzero:
-                return {"input_records": 0, "output_records": 0, "dropped": 0}
-            lo = min(s.min_key for s in nonzero)
-            hi = max(s.max_key for s in nonzero)
-            overlap = self._overlapping_locked(1, lo, hi)
-        bm = self._bottommost(1) if bottommost is None else bottommost
-        stats = self._merge_to_level(inputs, overlap, target_level=1,
-                                     bottommost=bm, now=now)
-        self._maybe_cascade(now)
-        return stats
+        with self._compaction_lock:
+            with self._lock:
+                inputs = list(self._l0)
+                nonzero = [s for s in inputs if s.n]
+                if not nonzero:
+                    return {"input_records": 0, "output_records": 0,
+                            "dropped": 0}
+                lo = min(s.min_key for s in nonzero)
+                hi = max(s.max_key for s in nonzero)
+                overlap = self._overlapping_locked(1, lo, hi)
+            bm = self._bottommost(1) if bottommost is None else bottommost
+            stats = self._merge_to_level(inputs, overlap, target_level=1,
+                                         bottommost=bm, now=now)
+            self._maybe_cascade(now)
+            return stats
 
     def _overlapping_locked(self, level: int, lo: bytes, hi: bytes):
         out = []
@@ -418,20 +425,22 @@ class LsmEngine:
     def _maybe_cascade(self, now=None):
         """While a level exceeds its byte budget, push one file (plus the
         next level's overlap) down — bounded-input leveled compaction."""
-        for lv in range(1, self.opts.max_levels):
-            while True:
-                with self._lock:
-                    files = list(self._levels.get(lv, []))
-                    if not files or self._level_bytes(lv) <= self._level_budget(lv):
-                        break
-                    cursor = self._compact_round.get(lv, 0) % len(files)
-                    self._compact_round[lv] = cursor + 1
-                    victim = files[cursor]
-                    overlap = self._overlapping_locked(
-                        lv + 1, victim.min_key, victim.max_key)
-                self._merge_to_level([victim], overlap, target_level=lv + 1,
-                                     bottommost=self._bottommost(lv + 1),
-                                     now=now)
+        with self._compaction_lock:
+            for lv in range(1, self.opts.max_levels):
+                while True:
+                    with self._lock:
+                        files = list(self._levels.get(lv, []))
+                        if (not files
+                                or self._level_bytes(lv) <= self._level_budget(lv)):
+                            break
+                        cursor = self._compact_round.get(lv, 0) % len(files)
+                        self._compact_round[lv] = cursor + 1
+                        victim = files[cursor]
+                        overlap = self._overlapping_locked(
+                            lv + 1, victim.min_key, victim.max_key)
+                    self._merge_to_level([victim], overlap, target_level=lv + 1,
+                                         bottommost=self._bottommost(lv + 1),
+                                         now=now)
 
     def _level_bytes(self, lv: int) -> int:
         return sum(s.data_bytes for s in self._levels.get(lv, []))
@@ -503,18 +512,19 @@ class LsmEngine:
         (default: the bottommost configured level)."""
         self.flush()
         tl = target_level or self.opts.max_levels
-        with self._lock:
-            newer = list(self._l0)
-            for lv in sorted(self._levels):
-                if lv < tl:
-                    newer.extend(self._levels.get(lv, []))
-            older = list(self._levels.get(tl, []))
         stats = {"input_records": 0, "output_records": 0, "dropped": 0}
-        if newer or older:
-            # inputs stay visible to readers until _merge_to_level swaps the
-            # output in; a failed merge leaves the level structure untouched
-            stats = self._merge_to_level(newer, older, target_level=tl,
-                                         bottommost=bottommost, now=now)
+        with self._compaction_lock:
+            with self._lock:
+                newer = list(self._l0)
+                for lv in sorted(self._levels):
+                    if lv < tl:
+                        newer.extend(self._levels.get(lv, []))
+                older = list(self._levels.get(tl, []))
+            if newer or older:
+                # inputs stay visible to readers until _merge_to_level swaps
+                # the output in; a failed merge leaves the levels untouched
+                stats = self._merge_to_level(newer, older, target_level=tl,
+                                             bottommost=bottommost, now=now)
         self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
         with self._lock:
             self._write_manifest_locked()

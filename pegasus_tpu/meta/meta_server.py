@@ -515,11 +515,17 @@ class MetaServer:
                 learners = [new_node]
             self._persist_locked()
         self._install_partition(app, pc, learners=learners)
-        with self._lock:
-            for ln in learners:
-                if ln not in pc.secondaries:
-                    pc.secondaries.append(ln)
-            self._persist_locked()
+        if learners:
+            with self._lock:
+                for ln in learners:
+                    if ln not in pc.secondaries:
+                        pc.secondaries.append(ln)
+                self._persist_locked()
+            # Re-push the updated view so the primary's in-memory membership
+            # includes the new member and it starts receiving prepares;
+            # without this the learner is fresh only as of the learn snapshot
+            # while meta reports it as a full secondary.
+            self._install_partition(app, pc)
 
     def _install_partition(self, app, pc: mm.PartitionConfig, learners=()):
         """Push the view to every member (primary first), seed learners."""

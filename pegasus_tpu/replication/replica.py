@@ -230,7 +230,12 @@ class Replica:
             if ballot < self.ballot:
                 raise PrepareRejected("stale_ballot", self.last_prepared)
             self.ballot = ballot
-            if m.decree <= self.last_prepared:
+            if m.decree <= self.last_committed:
+                # already committed: drop — staging it would leak, since
+                # _apply_up_to only ever pops decrees > last_committed
+                # (ADVICE r2 low)
+                pass
+            elif m.decree <= self.last_prepared:
                 # duplicate (catch-up overlap): keep newest copy staged
                 self._uncommitted.setdefault(m.decree, m)
             elif m.decree == self.last_prepared + 1:

@@ -132,6 +132,33 @@ def test_dead_node_replicas_rebuilt_on_survivor(cluster):
     c.close()
 
 
+def test_rebuilt_learner_joins_primary_view_and_receives_writes(tmp_path):
+    """After a node death rebuilds redundancy onto a spare node, the
+    primary's live view must include the promoted learner so it receives
+    subsequent prepares — not just meta's persisted table (ADVICE r2 med)."""
+    c = Cluster(tmp_path, n_nodes=4)
+    try:
+        cl = make_client(c, app="t7", partitions=1)
+        app_id = cl.resolver.app_id
+        for i in range(10):
+            cl.set(b"lk%d" % i, b"s", b"v%d" % i)
+        pc = c.meta._parts[app_id][0]
+        members = [pc.primary] + list(pc.secondaries)
+        spare = next(a for a in c.nodes if a not in members)
+        c.kill_node(pc.secondaries[0])
+        assert spare in pc.secondaries
+        prim_rep = c.nodes[pc.primary]._replicas[(app_id, 0)]
+        assert spare in prim_rep.view.secondaries
+        # new writes actually reach the new member
+        for i in range(10, 20):
+            cl.set(b"lk%d" % i, b"s", b"v%d" % i)
+        spare_rep = c.nodes[spare]._replicas[(app_id, 0)]
+        assert spare_rep.last_prepared >= prim_rep.last_committed
+        cl.close()
+    finally:
+        c.stop()
+
+
 def test_app_envs_propagate_to_replicas(cluster):
     c = make_client(cluster, app="t5", partitions=2)
     r = cluster.ddl(RPC_CM_SET_APP_ENVS,

@@ -135,6 +135,34 @@ def test_update_ttl_from_now_and_current_and_timestamp():
     assert list(b4.expire_ts) == [5000]
 
 
+def test_update_ttl_skips_tombstones_and_headerless():
+    """A tombstone (zero-length value) sits before a live record in arena
+    order; FRT_TTL_RANGE 0/0 matches expire==0 — which every tombstone has.
+    The rewrite must not touch the tombstone's (absent) value bytes, or it
+    clobbers the NEXT record's expire header / runs off the arena."""
+    now = 500
+    rules = [("FRT_TTL_RANGE", {"start_ttl": 0, "stop_ttl": 0})]
+    ops = parse_user_specified_compaction(spec(op(
+        "COT_UPDATE_TTL", {"type": "UTOT_FROM_NOW", "value": 9}, rules)))
+    # tombstone first, then a live no-ttl record whose header must survive
+    blk = make_block([(b"h", b"a_dead", b"", 0, True),
+                      (b"h", b"b_live", b"payload", 0, False)])
+    _, changed = apply_operations(blk, ops, now=now)
+    assert changed
+    # tombstone untouched entirely (filters never see deletion markers)
+    assert blk.expire_ts[0] == 0 and blk.val_len[0] == 0
+    # live record rewritten correctly — in column AND value bytes
+    assert blk.expire_ts[1] == now + 9
+    assert SCHEMAS[2].extract_expire_ts(blk.value(1)) == now + 9
+    assert SCHEMAS[2].extract_user_data(blk.value(1)) == b"payload"
+    # tombstone LAST in the arena: the unmasked write used to raise/overrun
+    blk2 = make_block([(b"h", b"a_live", b"payload", 0, False),
+                       (b"h", b"z_dead", b"", 0, True)])
+    apply_operations(blk2, ops, now=now)
+    assert blk2.expire_ts[1] == 0
+    assert SCHEMAS[2].extract_user_data(blk2.value(0)) == b"payload"
+
+
 def test_first_matching_op_wins():
     blk = make_block([(b"h", b"s", b"v", 0, False)])
     rules = [("FRT_HASHKEY_PATTERN",

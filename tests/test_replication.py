@@ -100,6 +100,30 @@ def test_primary_failover_preserves_committed(group):
     assert group.read(K(99)).error == Status.OK
 
 
+def test_duplicate_committed_prepares_not_staged(group):
+    """Prepares at decrees <= last_committed (normal during catch-up
+    overlap) must be dropped, not staged: _apply_up_to only pops decrees
+    above last_committed, so staged duplicates would leak forever
+    (ADVICE r2 low)."""
+    for i in range(5):
+        group.write(RPC_PUT, put_req(i))
+    prim = group.primary_replica()
+    sec = next(r for n, r in group.alive.items() if n != prim.name)
+    # force-commit everything on the secondary, then re-deliver old decrees
+    sec.on_prepare(prim.ballot,
+                   LogMutation(decree=sec.last_prepared, ballot=prim.ballot,
+                               codes=["RPC_RRDB_RRDB_PUT"], bodies=[b"x"]),
+                   sec.last_prepared)
+    assert sec.last_committed == sec.last_prepared
+    before = len(sec._uncommitted)
+    for d in range(1, sec.last_committed + 1):
+        sec.on_prepare(prim.ballot,
+                       LogMutation(decree=d, ballot=prim.ballot,
+                                   codes=["RPC_RRDB_RRDB_PUT"], bodies=[b"x"]),
+                       sec.last_committed)
+    assert len(sec._uncommitted) == before
+
+
 def test_quorum_loss_rejects_writes(group):
     names = list(group.alive)
     group.kill(names[0])
