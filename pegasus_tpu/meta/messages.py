@@ -94,6 +94,10 @@ class SetAppEnvsResponse:
 class BeaconRequest:
     node: str = ""                    # replica node address
     alive_replicas: List[str] = field(default_factory=list)  # "app_id.pidx"
+    # per-partition duplication confirmed decrees from this node's primaries:
+    # "app_id.pidx.dupid:decree" — the meta folds them into its dup entries
+    # (the reference's duplication_info.progress sync)
+    dup_progress: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -284,3 +288,171 @@ class LearnResponse:
     tail: List[bytes] = field(default_factory=list)   # encoded LogMutations
     last_committed: int = 0
     ballot: int = 0
+
+
+# --- duplication lifecycle DDL (reference duplication.cpp:32-260) ---
+
+@dataclass
+class DupEntry:
+    dupid: int = 0
+    remote: str = ""                  # remote cluster name
+    status: str = "init"              # init | start | pause  (removed = gone)
+    fail_mode: str = "slow"           # slow | skip
+    create_ts_ms: int = 0
+
+
+@dataclass
+class AddDuplicationRequest:
+    app_name: str = ""
+    remote_cluster: str = ""
+    freeze: bool = False              # start in DS_INIT (no shipping yet)
+
+
+@dataclass
+class AddDuplicationResponse:
+    error: int = 0
+    error_text: str = ""
+    app_id: int = 0
+    dupid: int = 0
+
+
+@dataclass
+class QueryDuplicationRequest:
+    app_name: str = ""
+
+
+@dataclass
+class QueryDuplicationResponse:
+    error: int = 0
+    error_text: str = ""
+    app_id: int = 0
+    entries: List[DupEntry] = field(default_factory=list)
+
+
+@dataclass
+class ModifyDuplicationRequest:
+    app_name: str = ""
+    dupid: int = 0
+    status: str = ""                  # "" = keep; start | pause | removed
+    fail_mode: str = ""               # "" = keep; slow | skip
+
+
+@dataclass
+class ModifyDuplicationResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+# --- periodic backup policies (reference cold_backup.cpp policy surface) ---
+
+@dataclass
+class BackupPolicyInfo:
+    name: str = ""
+    backup_root: str = ""
+    apps: List[str] = field(default_factory=list)
+    interval_seconds: int = 86400
+    history_count: int = 3            # retention: newest N backups kept
+    enabled: bool = True
+    next_backup_ts: int = 0           # unix seconds; 0 = due immediately
+    recent_backup_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class AddBackupPolicyRequest:
+    policy: BackupPolicyInfo = field(default_factory=BackupPolicyInfo)
+
+
+@dataclass
+class AddBackupPolicyResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+@dataclass
+class LsBackupPolicyRequest:
+    name: str = ""                    # "" = all
+
+
+@dataclass
+class LsBackupPolicyResponse:
+    error: int = 0
+    error_text: str = ""
+    policies: List[BackupPolicyInfo] = field(default_factory=list)
+
+
+@dataclass
+class ModifyBackupPolicyRequest:
+    name: str = ""
+    enabled: int = -1                 # -1 keep, 0 disable, 1 enable
+    interval_seconds: int = 0         # 0 = keep
+    history_count: int = 0            # 0 = keep
+    add_apps: List[str] = field(default_factory=list)
+    remove_apps: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ModifyBackupPolicyResponse:
+    error: int = 0
+    error_text: str = ""
+
+
+# --- disaster recovery (reference recovery.cpp `recover`, ddd_diagnose) ---
+
+@dataclass
+class ReplicaInfo:
+    """One replica as reported by a node (RPC_QUERY_REPLICA_INFO)."""
+
+    app_name: str = ""
+    app_id: int = 0
+    pidx: int = 0
+    partition_count: int = 0
+    ballot: int = 0
+    last_committed: int = 0
+    last_prepared: int = 0
+    last_durable: int = 0
+    envs_json: str = "{}"
+
+
+@dataclass
+class QueryReplicaInfoRequest:
+    pass
+
+
+@dataclass
+class QueryReplicaInfoResponse:
+    error: int = 0
+    replicas: List[ReplicaInfo] = field(default_factory=list)
+
+
+@dataclass
+class RecoverRequest:
+    nodes: List[str] = field(default_factory=list)   # addr list to rebuild from
+
+
+@dataclass
+class RecoverResponse:
+    error: int = 0
+    error_text: str = ""
+    recovered_apps: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DddPartitionInfo:
+    app_name: str = ""
+    pidx: int = 0
+    reason: str = ""
+    candidates: List[str] = field(default_factory=list)  # "addr ballot=N lc=N"
+    action: str = ""                  # "" or "promoted <addr>"
+
+
+@dataclass
+class DddDiagnoseRequest:
+    app_name: str = ""                # "" = all apps
+    force: bool = False               # actually promote the best candidate
+
+
+@dataclass
+class DddDiagnoseResponse:
+    error: int = 0
+    error_text: str = ""
+    partitions: List[DddPartitionInfo] = field(default_factory=list)

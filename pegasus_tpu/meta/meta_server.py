@@ -34,6 +34,14 @@ RPC_CM_RESTORE_APP = "RPC_CM_START_RESTORE"
 RPC_CM_START_BULK_LOAD = "RPC_CM_START_BULK_LOAD"
 RPC_CM_PROPOSE = "RPC_CM_PROPOSE_BALANCER"
 RPC_CM_BALANCE = "RPC_CM_START_BALANCE"
+RPC_CM_ADD_DUPLICATION = "RPC_CM_ADD_DUPLICATION"
+RPC_CM_QUERY_DUPLICATION = "RPC_CM_QUERY_DUPLICATION"
+RPC_CM_MODIFY_DUPLICATION = "RPC_CM_MODIFY_DUPLICATION"
+RPC_CM_ADD_BACKUP_POLICY = "RPC_CM_ADD_BACKUP_POLICY"
+RPC_CM_LS_BACKUP_POLICY = "RPC_CM_QUERY_BACKUP_POLICY"
+RPC_CM_MODIFY_BACKUP_POLICY = "RPC_CM_MODIFY_BACKUP_POLICY"
+RPC_CM_RECOVER = "RPC_CM_START_RECOVERY"
+RPC_CM_DDD_DIAGNOSE = "RPC_CM_DDD_DIAGNOSE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
 # meta -> replica node
@@ -42,6 +50,7 @@ RPC_CLOSE_REPLICA = "RPC_CONFIG_PROPOSAL_CLOSE_REPLICA"
 RPC_REPLICA_STATE = "RPC_QUERY_REPLICA_STATE"
 RPC_COLD_BACKUP = "RPC_COLD_BACKUP"
 RPC_BULK_LOAD = "RPC_BULK_LOAD"
+RPC_QUERY_REPLICA_INFO = "RPC_QUERY_REPLICA_INFO"
 
 
 class MetaServer:
@@ -54,7 +63,11 @@ class MetaServer:
         self._apps = {}          # name -> AppInfo
         self._parts = {}         # app_id -> list[PartitionConfig]
         self._nodes = {}         # addr -> last_beacon_monotonic
+        self._node_replicas = {} # addr -> ["app_id.pidx"] from the last beacon
+        self._dups = {}          # app_id -> list[dict] duplication entries
+        self._policies = {}      # name -> dict (BackupPolicyInfo fields)
         self._next_app_id = 1
+        self._next_dupid = 1
         self.pool = ConnectionPool()
         self._load()
 
@@ -74,6 +87,14 @@ class MetaServer:
             RPC_CM_START_BULK_LOAD: self._on_start_bulk_load,
             RPC_CM_PROPOSE: self._on_propose,
             RPC_CM_BALANCE: self._on_balance,
+            RPC_CM_ADD_DUPLICATION: self._on_add_dup,
+            RPC_CM_QUERY_DUPLICATION: self._on_query_dup,
+            RPC_CM_MODIFY_DUPLICATION: self._on_modify_dup,
+            RPC_CM_ADD_BACKUP_POLICY: self._on_add_backup_policy,
+            RPC_CM_LS_BACKUP_POLICY: self._on_ls_backup_policy,
+            RPC_CM_MODIFY_BACKUP_POLICY: self._on_modify_backup_policy,
+            RPC_CM_RECOVER: self._on_recover,
+            RPC_CM_DDD_DIAGNOSE: self._on_ddd_diagnose,
             RPC_FD_BEACON: self._on_beacon,
         }
 
@@ -157,17 +178,23 @@ class MetaServer:
             app.envs_json = json.dumps(envs)
             parts = list(self._parts[app.app_id])
             self._persist_locked()
-        # push to every serving node (reference: meta spreads app envs to
-        # replicas which hot-apply them, pegasus_server_impl.cpp:2406)
+        self._push_app_envs(app, parts)
+        return codec.encode(mm.SetAppEnvsResponse())
+
+    def _push_app_envs(self, app, parts) -> None:
+        """Spread app envs to every serving node (reference: meta spreads
+        app envs to replicas which hot-apply them,
+        pegasus_server_impl.cpp:2406)."""
         for pc in parts:
             for node in [pc.primary] + pc.secondaries:
+                if not node:
+                    continue
                 self._send_to_node(node, RPC_OPEN_REPLICA, mm.OpenReplicaRequest(
                     app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
                     ballot=pc.ballot, primary=pc.primary,
                     secondaries=pc.secondaries, envs_json=app.envs_json,
                     partition_count=app.partition_count),
                     ignore_errors=True)
-        return codec.encode(mm.SetAppEnvsResponse())
 
     # ------------------------------------------------------ split/backup/load
 
@@ -241,17 +268,25 @@ class MetaServer:
         root (block-service local-FS provider), then backup metadata lands
         beside them (reference cold backup to block service, SURVEY §2.4)."""
         req = codec.decode(mm.BackupAppRequest, body)
+        err, backup_id = self._do_backup(req.app_name, req.backup_root)
+        if err:
+            return codec.encode(mm.BackupAppResponse(error=1, error_text=err))
+        return codec.encode(mm.BackupAppResponse(backup_id=backup_id))
+
+    def _do_backup(self, app_name: str, backup_root: str,
+                   backup_id: int = None):
+        """-> (error_text or None, backup_id). One full app backup into
+        backup_root/<backup_id>/<app_name>/<pidx>/ + backup_metadata."""
         with self._lock:
-            app = self._apps.get(req.app_name)
+            app = self._apps.get(app_name)
             if app is None:
-                return codec.encode(mm.BackupAppResponse(error=1,
-                                                         error_text="no such app"))
+                return "no such app", 0
             parts = list(self._parts[app.app_id])
-        backup_id = int(time.time() * 1000)
+        backup_id = backup_id or int(time.time() * 1000)
         # replicas resolve this path through a block service rooted at "/";
         # absolutize here so a relative root means the same tree everywhere
-        base = os.path.join(os.path.abspath(req.backup_root),
-                            str(backup_id), req.app_name)
+        base = os.path.join(os.path.abspath(backup_root),
+                            str(backup_id), app_name)
         for pc in parts:
             dest = os.path.join(base, str(pc.pidx))
             out = self._send_to_node(pc.primary, RPC_COLD_BACKUP,
@@ -260,13 +295,12 @@ class MetaServer:
                                          restore_dir=dest),
                                      ignore_errors=True)
             if out is None:
-                return codec.encode(mm.BackupAppResponse(
-                    error=1, error_text=f"partition {pc.pidx} backup failed"))
+                return f"partition {pc.pidx} backup failed", 0
         with open(os.path.join(base, "backup_metadata"), "w") as f:
             json.dump({"app_name": app.app_name, "app_id": app.app_id,
                        "partition_count": app.partition_count,
                        "backup_id": backup_id, "envs_json": app.envs_json}, f)
-        return codec.encode(mm.BackupAppResponse(backup_id=backup_id))
+        return None, backup_id
 
     def _on_restore_app(self, header, body) -> bytes:
         """Restore a backup into a NEW table: create the app with the
@@ -433,6 +467,336 @@ class MetaServer:
             moved += 1
         return codec.encode(mm.BalanceResponse(moved=moved))
 
+    # ---------------------------------------------------------- duplication
+
+    def _refresh_dup_env_locked(self, app) -> None:
+        """Mirror the app's dup entries into the reserved app-env; replicas
+        reconcile their shippers from it on every view/env install."""
+        from ..base import consts
+
+        envs = json.loads(app.envs_json)
+        # always present (possibly "[]"): replica-side env application is a
+        # MERGE, so deleting the key would leave stale entries live forever
+        envs[consts.ENV_DUPLICATION_KEY] = json.dumps(
+            self._dups.get(app.app_id, []))
+        app.envs_json = json.dumps(envs)
+
+    def _on_add_dup(self, header, body) -> bytes:
+        """add_dup <app> <remote_cluster> [freeze] (reference
+        duplication.cpp:32-96 via meta_duplication_service::add_duplication).
+        freeze=True creates the dup in DS_INIT: registered but not shipping
+        until start_dup."""
+        req = codec.decode(mm.AddDuplicationRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.AddDuplicationResponse(
+                    error=1, error_text="no such app"))
+            dups = self._dups.setdefault(app.app_id, [])
+            for e in dups:
+                if e["remote"] == req.remote_cluster:
+                    return codec.encode(mm.AddDuplicationResponse(
+                        error=1,
+                        error_text=f"duplication to {req.remote_cluster} "
+                                   f"already exists (dupid {e['dupid']})"))
+            dupid = self._next_dupid
+            self._next_dupid += 1
+            entry = {"dupid": dupid, "remote": req.remote_cluster,
+                     "status": "init" if req.freeze else "start",
+                     "fail_mode": "slow",
+                     "create_ts_ms": int(time.time() * 1000)}
+            dups.append(entry)
+            self._refresh_dup_env_locked(app)
+            parts = list(self._parts[app.app_id])
+            self._persist_locked()
+        self._push_app_envs(app, parts)
+        return codec.encode(mm.AddDuplicationResponse(
+            app_id=app.app_id, dupid=dupid))
+
+    def _on_query_dup(self, header, body) -> bytes:
+        req = codec.decode(mm.QueryDuplicationRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.QueryDuplicationResponse(
+                    error=1, error_text="no such app"))
+            entries = [mm.DupEntry(dupid=e["dupid"], remote=e["remote"],
+                                   status=e["status"],
+                                   fail_mode=e["fail_mode"],
+                                   create_ts_ms=e["create_ts_ms"])
+                       for e in self._dups.get(app.app_id, [])]
+        return codec.encode(mm.QueryDuplicationResponse(
+            app_id=app.app_id, entries=entries))
+
+    def _on_modify_dup(self, header, body) -> bytes:
+        """start_dup / pause_dup / remove_dup / set_dup_fail_mode
+        (reference change_dup_status + set_dup_fail_mode,
+        duplication.cpp:174-260)."""
+        req = codec.decode(mm.ModifyDuplicationRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.ModifyDuplicationResponse(
+                    error=1, error_text="no such app"))
+            dups = self._dups.get(app.app_id, [])
+            entry = next((e for e in dups if e["dupid"] == req.dupid), None)
+            if entry is None:
+                return codec.encode(mm.ModifyDuplicationResponse(
+                    error=1, error_text=f"no dup {req.dupid} [duplication "
+                                        "not found]"))
+            # validate EVERYTHING before mutating anything: a half-applied
+            # modify must not survive in memory after an error response
+            if req.status and req.status not in ("start", "pause", "removed"):
+                return codec.encode(mm.ModifyDuplicationResponse(
+                    error=1, error_text=f"bad status {req.status}"))
+            if req.fail_mode and req.fail_mode not in ("slow", "skip"):
+                return codec.encode(mm.ModifyDuplicationResponse(
+                    error=1, error_text=f"bad fail_mode {req.fail_mode}"))
+            if req.status == "removed":
+                dups.remove(entry)
+            elif req.status:
+                entry["status"] = req.status
+            if req.fail_mode:
+                entry["fail_mode"] = req.fail_mode
+            self._refresh_dup_env_locked(app)
+            parts = list(self._parts[app.app_id])
+            self._persist_locked()
+        self._push_app_envs(app, parts)
+        return codec.encode(mm.ModifyDuplicationResponse())
+
+    # ------------------------------------------------------- backup policies
+
+    def _on_add_backup_policy(self, header, body) -> bytes:
+        req = codec.decode(mm.AddBackupPolicyRequest, body)
+        p = req.policy
+        with self._lock:
+            if p.name in self._policies:
+                return codec.encode(mm.AddBackupPolicyResponse(
+                    error=1, error_text=f"policy {p.name} exists"))
+            if not p.name or not p.backup_root or not p.apps:
+                return codec.encode(mm.AddBackupPolicyResponse(
+                    error=1, error_text="name, backup_root and apps required"))
+            missing = [a for a in p.apps if a not in self._apps]
+            if missing:
+                return codec.encode(mm.AddBackupPolicyResponse(
+                    error=1, error_text=f"no such app(s): {missing}"))
+            self._policies[p.name] = {
+                "name": p.name, "backup_root": p.backup_root,
+                "apps": list(p.apps),
+                "interval_seconds": max(1, p.interval_seconds),
+                "history_count": max(1, p.history_count),
+                "enabled": bool(p.enabled),
+                "next_backup_ts": int(p.next_backup_ts),
+                "recent_backup_ids": []}
+            self._persist_locked()
+        return codec.encode(mm.AddBackupPolicyResponse())
+
+    def _on_ls_backup_policy(self, header, body) -> bytes:
+        req = codec.decode(mm.LsBackupPolicyRequest, body)
+        with self._lock:
+            if req.name:
+                pols = [self._policies[req.name]] \
+                    if req.name in self._policies else []
+                if not pols:
+                    return codec.encode(mm.LsBackupPolicyResponse(
+                        error=1, error_text=f"no policy {req.name}"))
+            else:
+                pols = list(self._policies.values())
+            return codec.encode(mm.LsBackupPolicyResponse(
+                policies=[mm.BackupPolicyInfo(**p) for p in pols]))
+
+    def _on_modify_backup_policy(self, header, body) -> bytes:
+        req = codec.decode(mm.ModifyBackupPolicyRequest, body)
+        with self._lock:
+            p = self._policies.get(req.name)
+            if p is None:
+                return codec.encode(mm.ModifyBackupPolicyResponse(
+                    error=1, error_text=f"no policy {req.name}"))
+            if req.enabled in (0, 1):
+                p["enabled"] = bool(req.enabled)
+            if req.interval_seconds > 0:
+                p["interval_seconds"] = req.interval_seconds
+            if req.history_count > 0:
+                p["history_count"] = req.history_count
+            for a in req.add_apps:
+                if a not in self._apps:
+                    return codec.encode(mm.ModifyBackupPolicyResponse(
+                        error=1, error_text=f"no such app {a}"))
+                if a not in p["apps"]:
+                    p["apps"].append(a)
+            for a in req.remove_apps:
+                if a in p["apps"]:
+                    p["apps"].remove(a)
+            self._persist_locked()
+        return codec.encode(mm.ModifyBackupPolicyResponse())
+
+    def run_backup_policies(self, now: int = None) -> list:
+        """Execute every enabled policy that is due; prune history beyond
+        history_count (reference policy scheduler in meta backup_service,
+        SURVEY §2.4 'Cold backup'). Called from the meta app's timer (and
+        directly by tests with a pinned `now`). Returns [(policy, app,
+        backup_id or None)]."""
+        import shutil
+
+        now = int(time.time()) if now is None else now
+        ran = []
+        with self._lock:
+            due = [dict(p) for p in self._policies.values()
+                   if p["enabled"] and p["next_backup_ts"] <= now]
+        for p in due:
+            # one backup_id per policy run, shared by all its apps (the
+            # reference's per-policy backup_id), so retention prunes runs;
+            # derived from `now` so tests with a pinned clock stay stable.
+            # Each policy backs up under backup_root/<policy_name>/ so two
+            # policies sharing a root can never collide on a run id and
+            # retention-prune each other's trees.
+            run_id = now * 1000
+            root = os.path.join(p["backup_root"], p["name"])
+            new_ids = []
+            for app_name in p["apps"]:
+                err, bid = self._do_backup(app_name, root,
+                                           backup_id=run_id)
+                ran.append((p["name"], app_name, None if err else bid))
+                if err:
+                    print(f"[backup-policy {p['name']}] {app_name}: {err}",
+                          flush=True)
+                else:
+                    new_ids.append(bid)
+            with self._lock:
+                live = self._policies.get(p["name"])
+                if live is None:
+                    continue
+                ids = sorted(set(live["recent_backup_ids"]) | set(new_ids))
+                # retention: newest history_count backups stay on disk
+                while len(ids) > live["history_count"]:
+                    victim = ids.pop(0)
+                    shutil.rmtree(os.path.join(
+                        os.path.abspath(live["backup_root"]), live["name"],
+                        str(victim)), ignore_errors=True)
+                live["recent_backup_ids"] = ids
+                live["next_backup_ts"] = now + live["interval_seconds"]
+                self._persist_locked()
+        return ran
+
+    # -------------------------------------------------- disaster recovery
+
+    def _on_recover(self, header, body) -> bytes:
+        """Rebuild app + partition state from the replicas the given nodes
+        actually hold — the reference `recover` command for a meta that
+        lost its state (recovery.cpp / meta_service recover-from-replicas).
+        Only apps unknown to this meta are recovered; the member with the
+        highest (ballot, last_committed) becomes primary."""
+        req = codec.decode(mm.RecoverRequest, body)
+        reports = {}
+        for node in req.nodes:
+            out = self._send_to_node(node, RPC_QUERY_REPLICA_INFO,
+                                     mm.QueryReplicaInfoRequest(),
+                                     ignore_errors=True)
+            if out is None:
+                continue
+            resp = codec.decode(mm.QueryReplicaInfoResponse, out)
+            with self._lock:
+                self._nodes.setdefault(node, time.monotonic())
+            for ri in resp.replicas:
+                reports.setdefault(ri.app_id, {}).setdefault(
+                    ri.pidx, []).append((node, ri))
+        recovered = []
+        with self._lock:
+            known_ids = {a.app_id for a in self._apps.values()}
+            for app_id in sorted(reports):
+                if app_id in known_ids:
+                    continue
+                by_pidx = reports[app_id]
+                any_ri = next(iter(by_pidx.values()))[0][1]
+                if not any_ri.app_name or any_ri.app_name in self._apps:
+                    continue
+                pcount = max(r.partition_count
+                             for rs in by_pidx.values() for _, r in rs)
+                pcount = max(pcount, max(by_pidx) + 1)
+                app = mm.AppInfo(app_name=any_ri.app_name, app_id=app_id,
+                                 partition_count=pcount,
+                                 replica_count=max(len(rs) for rs
+                                                   in by_pidx.values()),
+                                 envs_json=any_ri.envs_json)
+                parts = []
+                for pidx in range(pcount):
+                    holders = sorted(
+                        by_pidx.get(pidx, []),
+                        key=lambda t: (t[1].ballot, t[1].last_committed),
+                        reverse=True)
+                    if holders:
+                        primary = holders[0][0]
+                        ballot = holders[0][1].ballot + 1
+                        secondaries = [n for n, _ in holders[1:]]
+                    else:
+                        primary, ballot, secondaries = "", 1, []
+                    parts.append(mm.PartitionConfig(
+                        pidx=pidx, ballot=ballot, primary=primary,
+                        secondaries=secondaries))
+                self._apps[app.app_name] = app
+                self._parts[app_id] = parts
+                self._next_app_id = max(self._next_app_id, app_id + 1)
+                recovered.append(app.app_name)
+            self._persist_locked()
+        for name in recovered:
+            app = self._apps[name]
+            for pc in self._parts[app.app_id]:
+                if pc.primary:
+                    self._install_partition(app, pc)
+        return codec.encode(mm.RecoverResponse(recovered_apps=recovered))
+
+    def _on_ddd_diagnose(self, header, body) -> bytes:
+        """Diagnose 'double-dead' partitions — every member lost, primary
+        left empty by reconfiguration — and (with force) promote the
+        best-qualified holder among currently-alive nodes (reference
+        ddd_diagnose, shell/commands/recovery.cpp + ddd_partition_info)."""
+        req = codec.decode(mm.DddDiagnoseRequest, body)
+        with self._lock:
+            if req.app_name and req.app_name not in self._apps:
+                # a typo with force=True must NOT widen to a cluster-wide fix
+                return codec.encode(mm.DddDiagnoseResponse(
+                    error=1, error_text=f"no such app {req.app_name}"))
+            apps = ([self._apps[req.app_name]] if req.app_name
+                    else list(self._apps.values()))
+            alive = self._alive_nodes_locked()
+            dead_parts = []
+            for app in apps:
+                for pc in self._parts[app.app_id]:
+                    members = [m for m in [pc.primary] + pc.secondaries if m]
+                    if not members or not any(m in alive for m in members):
+                        dead_parts.append((app, pc))
+        out = []
+        for app, pc in dead_parts:
+            info = mm.DddPartitionInfo(
+                app_name=app.app_name, pidx=pc.pidx,
+                reason="no alive member in config")
+            holders = []
+            for node in alive:
+                key = f"{app.app_id}.{pc.pidx}"
+                with self._lock:
+                    has = key in self._node_replicas.get(node, ())
+                if not has:
+                    continue
+                st = self._query_replica_state(node, app.app_id, pc.pidx)
+                if st is not None and not st.error:
+                    holders.append((node, st))
+                    info.candidates.append(
+                        f"{node} ballot={st.ballot} lc={st.last_committed}")
+            if req.force and holders:
+                holders.sort(key=lambda t: (t[1].ballot, t[1].last_committed),
+                             reverse=True)
+                best = holders[0][0]
+                with self._lock:
+                    pc.ballot = max(pc.ballot,
+                                    max(st.ballot for _, st in holders)) + 1
+                    pc.primary = best
+                    pc.secondaries = [n for n, _ in holders[1:]]
+                    self._persist_locked()
+                self._install_partition(app, pc)
+                info.action = f"promoted {best}"
+            out.append(info)
+        return codec.encode(mm.DddDiagnoseResponse(partitions=out))
+
     def _on_list_nodes(self, header, body) -> bytes:
         with self._lock:
             nodes = []
@@ -453,6 +817,23 @@ class MetaServer:
         with self._lock:
             known = req.node in self._nodes
             self._nodes[req.node] = time.monotonic()
+            # what the node actually holds — ddd_diagnose candidate source
+            self._node_replicas[req.node] = set(req.alive_replicas)
+            # fold primary-reported dup confirmed decrees into the entries
+            # (reference duplication progress sync); not persisted per
+            # beacon — losing it on meta restart only means extra plog
+            # retention + at-least-once re-shipping, both safe
+            for item in req.dup_progress:
+                try:
+                    ids, decree = item.split(":")
+                    app_id, pidx, dupid = (int(x) for x in ids.split("."))
+                    decree = int(decree)
+                except ValueError:
+                    continue
+                for e in self._dups.get(app_id, []):
+                    if e["dupid"] == dupid:
+                        conf = e.setdefault("confirmed", {})
+                        conf[str(pidx)] = max(conf.get(str(pidx), 0), decree)
         if not known:
             self._persist()
         return codec.encode(mm.BeaconResponse(allowed=True))
@@ -529,6 +910,12 @@ class MetaServer:
 
     def _install_partition(self, app, pc: mm.PartitionConfig, learners=()):
         """Push the view to every member (primary first), seed learners."""
+        with self._lock:
+            # fresh dup entries (incl. beacon-folded confirmed decrees) ride
+            # every install: a promoted primary starts its shippers at the
+            # meta-confirmed floor instead of re-shipping from zero
+            if self._dups.get(app.app_id) is not None:
+                self._refresh_dup_env_locked(app)
         req = mm.OpenReplicaRequest(
             app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
             ballot=pc.ballot, primary=pc.primary, secondaries=pc.secondaries,
@@ -593,10 +980,13 @@ class MetaServer:
     def _persist_locked(self):
         state = {
             "next_app_id": self._next_app_id,
+            "next_dupid": self._next_dupid,
             "apps": {n: vars(a) for n, a in self._apps.items()},
             "parts": {str(aid): [vars(pc) for pc in parts]
                       for aid, parts in self._parts.items()},
             "nodes": list(self._nodes),
+            "dups": {str(aid): entries for aid, entries in self._dups.items()},
+            "policies": self._policies,
         }
         tmp = self.state_path + ".tmp"
         os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
@@ -610,8 +1000,12 @@ class MetaServer:
         with open(self.state_path) as f:
             state = json.load(f)
         self._next_app_id = state["next_app_id"]
+        self._next_dupid = state.get("next_dupid", 1)
         self._apps = {n: mm.AppInfo(**a) for n, a in state["apps"].items()}
         self._parts = {int(aid): [mm.PartitionConfig(**pc) for pc in parts]
                        for aid, parts in state["parts"].items()}
+        self._dups = {int(aid): entries
+                      for aid, entries in state.get("dups", {}).items()}
+        self._policies = state.get("policies", {})
         # nodes must re-beacon after a meta restart
         self._nodes = {}

@@ -11,6 +11,8 @@ resolution via the value-schema timetag (verify_timetag). Shipping is
 in-order overall, which subsumes the reference's per-hash FIFO guarantee.
 """
 
+import json
+import os
 import threading
 
 from ..base import key_schema
@@ -26,29 +28,83 @@ class MutationDuplicator:
     """Attach with `replica.commit_hooks.append(dup.on_commit)`."""
 
     def __init__(self, remote_resolver, cluster_id: int = 1,
-                 fail_mode: str = "slow"):
+                 fail_mode: str = "slow", dupid: int = 0,
+                 progress_dir: str = None, confirmed_floor: int = 0,
+                 paused: bool = False):
         """remote_resolver: client resolver for the remote table;
         fail_mode: 'slow' blocks/retries (default), 'skip' drops on error
-        (reference dup fail-mode knob)."""
+        (reference dup fail-mode knob); progress_dir: local persistence of
+        the confirmed decree; confirmed_floor: the meta-held confirmed
+        decree for this partition (beacon-reported; survives failover the
+        way the reference's meta duplication_info.progress does) — shipping
+        starts past max(local, floor). Create with paused=True and unpause
+        only after catch_up(): otherwise a live hook mutation can ship
+        first and advance the confirmed decree past the unshipped
+        backlog, which would then be skipped forever."""
         self.resolver = remote_resolver
         self.cluster_id = cluster_id
         self.fail_mode = fail_mode
+        self.dupid = dupid
         self.pool = ConnectionPool()
         self._queue = []
         self._cv = threading.Condition()
         self._stop = False
+        self._paused = paused
         self._inflight = False
         self.shipped = 0
         self.skipped = 0
-        self.last_shipped_decree = 0
+        self._progress_path = (os.path.join(progress_dir, f"dup_{dupid}.json")
+                               if progress_dir else None)
+        self.last_shipped_decree = max(self._load_progress(), confirmed_floor)
         self._thread = threading.Thread(target=self._ship_loop, daemon=True)
         self._thread.start()
+
+    # ------------------------------------------------------------- progress
+
+    def _load_progress(self) -> int:
+        if self._progress_path and os.path.exists(self._progress_path):
+            try:
+                with open(self._progress_path) as f:
+                    return int(json.load(f)["confirmed_decree"])
+            except (OSError, ValueError, KeyError):
+                pass
+        return 0
+
+    def _save_progress(self) -> None:
+        if not self._progress_path:
+            return
+        tmp = self._progress_path + ".tmp"
+        os.makedirs(os.path.dirname(self._progress_path), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"dupid": self.dupid,
+                       "confirmed_decree": self.last_shipped_decree}, f)
+        os.replace(tmp, self._progress_path)
+
+    def catch_up(self, plog) -> int:
+        """Backfill the ship queue from the plog past the confirmed decree —
+        how a fresh duplicator (dup add, restart, failover promotion) ships
+        history it never saw via the commit hook. Shipping is at-least-once:
+        overlap with live hook traffic resolves at the remote via the
+        timetag LWW (verify_timetag). Returns the number backfilled."""
+        backlog = [m for m in plog.replay(self.last_shipped_decree)]
+        with self._cv:
+            self._queue[:0] = backlog
+            self._cv.notify()
+        return len(backlog)
 
     # ----------------------------------------------------------------- hook
 
     def on_commit(self, m: LogMutation) -> None:
         with self._cv:
             self._queue.append(m)
+            self._cv.notify()
+
+    def set_paused(self, paused: bool) -> None:
+        """Pause = stop shipping but KEEP queueing (the backlog survives;
+        the plog + persisted progress cover a process restart while
+        paused)."""
+        with self._cv:
+            self._paused = paused
             self._cv.notify()
 
     # ----------------------------------------------------------------- ship
@@ -58,21 +114,27 @@ class MutationDuplicator:
             with self._cv:
                 self._inflight = False
                 self._cv.notify_all()
-                while not self._queue and not self._stop:
+                while (not self._queue or self._paused) and not self._stop:
                     self._cv.wait(0.2)
-                if self._stop and not self._queue:
+                if self._stop and (not self._queue or self._paused):
                     return
                 m = self._queue.pop(0)
                 self._inflight = True
             try:
-                self._ship_one(m)
+                if self._ship_one(m):
+                    self._save_progress()
             except Exception as e:  # never let the shipper thread die
                 self.skipped += 1
                 print(f"[duplicator] dropped decree {m.decree}: {e!r}")
 
-    def _ship_one(self, m: LogMutation) -> None:
+    def _ship_one(self, m: LogMutation) -> bool:
+        """-> True when the decree is confirmed (shipped, or skipped by
+        policy). stop() mid-retry returns False: the decree was NOT
+        delivered and must not be recorded as confirmed."""
         import time
 
+        if m.decree <= self.last_shipped_decree:
+            return True  # catch_up/live-hook overlap: already confirmed
         for code, body in zip(m.codes, m.bodies):
             if code == RPC_DUPLICATE:
                 continue  # never re-duplicate a duplicate (loop guard)
@@ -87,7 +149,9 @@ class MutationDuplicator:
                 timestamp=m.timestamp_us, task_code=code, raw_message=body,
                 cluster_id=self.cluster_id, verify_timetag=True)
             attempts = 0
-            while not self._stop:
+            while True:
+                if self._stop:
+                    return False  # interrupted mid-retry: NOT confirmed
                 try:
                     self._send(req, key, refresh=attempts > 0)
                     self.shipped += 1
@@ -101,6 +165,7 @@ class MutationDuplicator:
                     # (the reference's dup_fail_mode=slow holds the pipeline)
                     time.sleep(min(2.0, 0.05 * attempts))
         self.last_shipped_decree = max(self.last_shipped_decree, m.decree)
+        return True
 
     def _send(self, req: msg.DuplicateRequest, key: bytes,
               refresh: bool = False) -> None:

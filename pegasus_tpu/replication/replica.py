@@ -96,6 +96,9 @@ class Replica:
         self._batch_pending = []      # _WriteSlots awaiting a group commit
         self._batch_leader_active = False
         self.commit_hooks = []   # fn(LogMutation) after commit (duplication)
+        self.duplicators = {}    # dupid -> MutationDuplicator (stub-managed)
+        self.app_name = ""       # set by the stub at open
+        self.partition_count = 0
         self.last_committed = self.server.engine.last_committed_decree()
         self.last_prepared = self.last_committed
         self._recover_from_log()
@@ -335,11 +338,39 @@ class Replica:
     def gc_log(self, flush: bool = False):
         """Drop log segments the durable SSTs cover. flush=True forces the
         memtable down first (tests); the maintenance timer must NOT — a
-        periodic forced flush would churn tiny L0 files on idle tables."""
+        periodic forced flush would churn tiny L0 files on idle tables.
+        Active duplications hold the log at their confirmed decree: a
+        restarted/promoted shipper must be able to catch_up() from plog
+        (the reference keeps plog for dup the same way)."""
         if flush:
             self.server.engine.flush()
-        self.plog.gc(self.server.engine.last_durable_decree())
+        floor = self.server.engine.last_durable_decree()
+        for d in self.duplicators.values():
+            floor = min(floor, d.last_shipped_decree)
+        # SECONDARIES hold the log too: they run no shippers, but on
+        # promotion the new primary catches up from ITS plog at the
+        # meta-confirmed decree (beacon-folded into the dup env entries) —
+        # gc'ing past that floor would open a silent duplication gap
+        for e in self._dup_env_entries():
+            if e.get("status") in ("init", "start", "pause"):
+                floor = min(floor, int(
+                    e.get("confirmed", {}).get(str(self.pidx), 0)))
+        self.plog.gc(floor)
+
+    def _dup_env_entries(self) -> list:
+        import json
+
+        from ..base import consts
+
+        try:
+            return json.loads(
+                self.server.app_envs.get(consts.ENV_DUPLICATION_KEY, "[]"))
+        except ValueError:
+            return []
 
     def close(self):
+        for d in self.duplicators.values():
+            d.stop()
+        self.duplicators.clear()
         self.plog.close()
         self.server.close()

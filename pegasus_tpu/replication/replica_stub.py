@@ -72,10 +72,17 @@ class _RemotePeer:
 class ReplicaStub:
     def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
                  port: int = 0, options_factory=None,
-                 block_service_provider: str = "local_service"):
+                 block_service_provider: str = "local_service",
+                 remote_clusters: dict = None, cluster_id: int = 1):
         self.root = root
         self.meta_addrs = list(meta_addrs)
         self.block_service_provider = block_service_provider
+        # [pegasus.clusters]: remote cluster name -> meta address list, the
+        # duplication target directory (reference pegasus_const cluster
+        # section; dup entries name clusters, this resolves them)
+        self.remote_clusters = {k: (v if isinstance(v, list) else [v])
+                                for k, v in (remote_clusters or {}).items()}
+        self.cluster_id = cluster_id
         self.options_factory = options_factory or (lambda: EngineOptions(backend="cpu"))
         self.pool = ConnectionPool()
         self._lock = threading.RLock()
@@ -87,6 +94,9 @@ class ReplicaStub:
         self.rpc.register(RPC_OPEN_REPLICA, self._on_open_replica)
         self.rpc.register(RPC_CLOSE_REPLICA, self._on_close_replica)
         self.rpc.register(RPC_REPLICA_STATE, self._on_replica_state)
+        from ..meta.meta_server import RPC_QUERY_REPLICA_INFO
+
+        self.rpc.register(RPC_QUERY_REPLICA_INFO, self._on_query_replica_info)
         from ..meta.meta_server import RPC_BULK_LOAD, RPC_COLD_BACKUP
 
         self.rpc.register(RPC_COLD_BACKUP, self._on_cold_backup)
@@ -148,7 +158,12 @@ class ReplicaStub:
     def send_beacon(self):
         with self._lock:
             alive = [f"{a}.{p}" for (a, p) in self._replicas]
-        req = mm.BeaconRequest(node=self.address, alive_replicas=alive)
+            progress = [
+                f"{a}.{p}.{dupid}:{d.last_shipped_decree}"
+                for (a, p), rep in self._replicas.items()
+                for dupid, d in rep.duplicators.items()]
+        req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
+                               dup_progress=progress)
         for meta in self.meta_addrs:
             host, _, port = meta.rpartition(":")
             try:
@@ -192,12 +207,90 @@ class ReplicaStub:
                 with self._lock:
                     self._service.remove_replica(req.app_id, req.pidx)
                     self._service.add_replica(rep.server, req.partition_count)
+        rep.app_name = req.app_name or rep.app_name
+        rep.partition_count = req.partition_count or rep.partition_count
         rep.assume_view(GroupView(req.ballot, req.primary, req.secondaries))
         envs = json.loads(req.envs_json or "{}")
         if envs:
             rep.server.update_app_envs(envs)
+        self._sync_duplications(rep)
         return codec.encode(mm.OpenReplicaResponse(
             last_committed=rep.last_committed, last_prepared=rep.last_prepared))
+
+    def _sync_duplications(self, rep) -> None:
+        """Reconcile the replica's mutation shippers against the dup entries
+        the meta mirrors into the reserved app-env. Only the PRIMARY ships
+        (the reference's duplication also runs on primaries); a demoted or
+        removed primary tears its shippers down, a promoted one builds them
+        and catches up from its plog + persisted confirmed decree."""
+        from ..base import consts
+        from ..client import MetaResolver
+        from .duplicator import MutationDuplicator
+
+        try:
+            entries = json.loads(
+                rep.server.app_envs.get(consts.ENV_DUPLICATION_KEY, "[]"))
+        except ValueError:
+            entries = []
+        is_primary = rep.view is not None and rep.view.primary == rep.name
+        want = {}
+        if is_primary:
+            for e in entries:
+                if e.get("status") in ("start", "pause"):
+                    want[int(e["dupid"])] = e
+        for dupid in list(rep.duplicators):
+            if dupid not in want:
+                d = rep.duplicators.pop(dupid)
+                try:
+                    rep.commit_hooks.remove(d.on_commit)
+                except ValueError:
+                    pass
+                d.stop()
+        for dupid, e in want.items():
+            d = rep.duplicators.get(dupid)
+            if d is None:
+                metas = self.remote_clusters.get(e["remote"])
+                if not metas:
+                    print(f"[dup {dupid}] unknown remote cluster "
+                          f"{e['remote']!r} (configure [pegasus.clusters])",
+                          flush=True)
+                    continue
+                try:
+                    resolver = MetaResolver(list(metas), rep.app_name)
+                except Exception as ex:  # remote may be down; retry on next
+                    print(f"[dup {dupid}] remote resolve failed: {ex!r}",
+                          flush=True)                     # view/env install
+                    continue
+                floor = int(e.get("confirmed", {}).get(str(rep.pidx), 0))
+                # born paused: catch_up must order the plog backlog ahead of
+                # live hook traffic before anything ships, or a live decree
+                # would advance the confirmed point past the backlog
+                d = MutationDuplicator(
+                    resolver, cluster_id=self.cluster_id,
+                    fail_mode=e.get("fail_mode", "slow"), dupid=dupid,
+                    progress_dir=os.path.join(rep.path, "dup"),
+                    confirmed_floor=floor, paused=True)
+                rep.duplicators[dupid] = d
+                rep.commit_hooks.append(d.on_commit)
+                d.catch_up(rep.plog)
+            d.fail_mode = e.get("fail_mode", "slow")
+            d.set_paused(e.get("status") == "pause")
+
+    def _on_query_replica_info(self, header, body) -> bytes:
+        """Everything this node holds — the disaster-recovery scan the meta
+        `recover` command aggregates (reference query_replica_info)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        out = []
+        for rep in reps:
+            out.append(mm.ReplicaInfo(
+                app_name=rep.app_name, app_id=rep.app_id, pidx=rep.pidx,
+                partition_count=rep.partition_count, ballot=rep.ballot,
+                last_committed=rep.last_committed,
+                last_prepared=rep.last_prepared,
+                last_durable=rep.server.engine.last_durable_decree(),
+                envs_json=json.dumps(rep.server.app_envs)))
+        return codec.encode(mm.QueryReplicaInfoResponse(replicas=out))
 
     def _seed_from_restore(self, replica_path: str, restore_dir: str) -> None:
         """Pre-open restore: download backup checkpoint files into the data

@@ -114,9 +114,28 @@ class MetaApp:
         self._fd_timer.daemon = True
         self._fd_timer.start()
 
+        # backup policies run on their OWN timer: a long synchronous backup
+        # inside the FD tick would stall lease checks for its whole duration
+        def policy_tick():
+            try:
+                self.meta.run_backup_policies()
+            except Exception as e:  # policy failure must not kill the timer
+                print(f"[meta] backup policy run failed: {e!r}", flush=True)
+            self._policy_timer = threading.Timer(
+                max(self._fd_interval, 5.0), policy_tick)
+            self._policy_timer.daemon = True
+            self._policy_timer.start()
+
+        self._policy_timer = threading.Timer(
+            max(self._fd_interval, 5.0), policy_tick)
+        self._policy_timer.daemon = True
+        self._policy_timer.start()
+
     def stop(self):
         if self._fd_timer:
             self._fd_timer.cancel()
+        if getattr(self, "_policy_timer", None):
+            self._policy_timer.cancel()
         self.rpc.stop()
 
 
@@ -134,11 +153,20 @@ class ReplicaApp:
         def options_factory():
             return EngineOptions(backend=backend)
 
+        # [pegasus.clusters]: name = comma-separated meta list; the
+        # duplication target directory (reference config.ini cluster section)
+        remote_clusters = {}
+        if "pegasus.clusters" in config.sections():
+            for key in config.keys("pegasus.clusters"):
+                remote_clusters[key] = config.get_list("pegasus.clusters",
+                                                       key, [])
         self.stub = ReplicaStub(
             data_dir, list(metas),
             host=config.get_string(section, "host", "127.0.0.1"),
             port=config.get_int(section, "port", 0),
-            options_factory=options_factory)
+            options_factory=options_factory,
+            remote_clusters=remote_clusters,
+            cluster_id=config.get_int("pegasus.server", "cluster_id", 1))
         self._beacon = config.get_float("failure_detector",
                                         "beacon_interval_seconds", 1.0)
         from .toollets import install_toollets

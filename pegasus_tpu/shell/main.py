@@ -74,6 +74,37 @@ class Shell:
             "propose": (self.cmd_propose,
                         "propose <pidx> <target_node> — move primary"),
             "balance": (self.cmd_balance, "equalize primary counts"),
+            "add_dup": (self.cmd_add_dup,
+                        "add_dup <app> <remote_cluster> [-f] — freeze=no ship yet"),
+            "query_dup": (self.cmd_query_dup, "query_dup <app>"),
+            "start_dup": (self.cmd_start_dup, "start_dup <app> <dupid>"),
+            "pause_dup": (self.cmd_pause_dup, "pause_dup <app> <dupid>"),
+            "remove_dup": (self.cmd_remove_dup, "remove_dup <app> <dupid>"),
+            "set_dup_fail_mode": (self.cmd_set_dup_fail_mode,
+                                  "set_dup_fail_mode <app> <dupid> <slow|skip>"),
+            "backup_app": (self.cmd_backup_app,
+                           "backup_app <app> <backup_root> — one-shot backup"),
+            "restore_app": (self.cmd_restore_app,
+                            "restore_app <backup_root> <backup_id> <old_app> <new_app>"),
+            "add_backup_policy": (self.cmd_add_backup_policy,
+                                  "add_backup_policy <name> <backup_root> <apps,csv> "
+                                  "<interval_s> [history_count] — backups land in "
+                                  "<backup_root>/<name>/<backup_id>/"),
+            "ls_backup_policy": (self.cmd_ls_backup_policy,
+                                 "ls_backup_policy [name]"),
+            "modify_backup_policy": (self.cmd_modify_backup_policy,
+                                     "modify_backup_policy <name> [-i sec] [-c count] "
+                                     "[--add app,..] [--remove app,..]"),
+            "enable_backup_policy": (self.cmd_enable_backup_policy,
+                                     "enable_backup_policy <name>"),
+            "disable_backup_policy": (self.cmd_disable_backup_policy,
+                                      "disable_backup_policy <name>"),
+            "start_bulk_load": (self.cmd_start_bulk_load,
+                                "start_bulk_load <app> <provider_root>"),
+            "recover": (self.cmd_recover,
+                        "recover <node> [node...] — rebuild meta state from nodes"),
+            "ddd_diagnose": (self.cmd_ddd_diagnose,
+                             "ddd_diagnose [app] [-f] — find/fix double-dead partitions"),
             "sst_dump": (self.cmd_sst_dump,
                          "sst_dump <file.sst> [max_rows] — offline SST reader"),
             "mlog_dump": (self.cmd_mlog_dump,
@@ -356,6 +387,201 @@ class Shell:
         r = self._meta_call(RPC_CM_BALANCE, mm.BalanceRequest(),
                             mm.BalanceResponse)
         self.p(f"moved {r.moved} primaries")
+
+    # duplication ---------------------------------------------------------
+    # (reference src/shell/commands/duplication.cpp:32-260)
+
+    def cmd_add_dup(self, args):
+        from ..meta.meta_server import RPC_CM_ADD_DUPLICATION
+
+        freeze = "-f" in args or "--freeze" in args
+        pos = [a for a in args if not a.startswith("-")]
+        r = self._meta_call(RPC_CM_ADD_DUPLICATION,
+                            mm.AddDuplicationRequest(pos[0], pos[1], freeze),
+                            mm.AddDuplicationResponse)
+        if r.error:
+            self.p(f"adding duplication failed: {r.error_text}")
+        else:
+            self.p(f"adding duplication succeed [app: {pos[0]}, remote: "
+                   f"{pos[1]}, appid: {r.app_id}, dupid: {r.dupid}, "
+                   f"freeze: {str(freeze).lower()}]")
+
+    def cmd_query_dup(self, args):
+        from ..meta.meta_server import RPC_CM_QUERY_DUPLICATION
+
+        r = self._meta_call(RPC_CM_QUERY_DUPLICATION,
+                            mm.QueryDuplicationRequest(args[0]),
+                            mm.QueryDuplicationResponse)
+        if r.error:
+            self.p(f"ERROR: {r.error_text}")
+            return
+        self.p(f"duplications of app [{args[0]}]:")
+        for e in r.entries:
+            created = time.strftime("%Y-%m-%d %H:%M:%S",
+                                    time.localtime(e.create_ts_ms / 1000))
+            self.p(f"  dupid={e.dupid} status={e.status} remote={e.remote} "
+                   f"fail_mode={e.fail_mode} create_time={created}")
+        if not r.entries:
+            self.p("  (none)")
+
+    def _modify_dup(self, app, dupid, status="", fail_mode="", verb=""):
+        from ..meta.meta_server import RPC_CM_MODIFY_DUPLICATION
+
+        r = self._meta_call(RPC_CM_MODIFY_DUPLICATION,
+                            mm.ModifyDuplicationRequest(
+                                app, int(dupid), status, fail_mode),
+                            mm.ModifyDuplicationResponse)
+        self.p(f"{verb} failed: {r.error_text}" if r.error else f"{verb} succeed")
+
+    def cmd_start_dup(self, args):
+        self._modify_dup(args[0], args[1], status="start",
+                         verb=f"starting duplication({args[1]})")
+
+    def cmd_pause_dup(self, args):
+        self._modify_dup(args[0], args[1], status="pause",
+                         verb=f"pausing duplication({args[1]})")
+
+    def cmd_remove_dup(self, args):
+        self._modify_dup(args[0], args[1], status="removed",
+                         verb=f"removing duplication({args[1]})")
+
+    def cmd_set_dup_fail_mode(self, args):
+        if args[2] not in ("slow", "skip"):
+            self.p('fail_mode must be "slow" or "skip"')
+            return
+        self._modify_dup(args[0], args[1], fail_mode=args[2],
+                         verb=f"setting fail_mode({args[2]})")
+
+    # backup / restore ----------------------------------------------------
+    # (reference src/shell/commands/cold_backup.cpp incl. policy surface)
+
+    def cmd_backup_app(self, args):
+        from ..meta.meta_server import RPC_CM_BACKUP_APP
+
+        r = self._meta_call(RPC_CM_BACKUP_APP,
+                            mm.BackupAppRequest(args[0], args[1]),
+                            mm.BackupAppResponse)
+        if r.error:
+            self.p(f"backup failed: {r.error_text}")
+        else:
+            self.p(f"backup succeed, backup_id={r.backup_id}")
+
+    def cmd_restore_app(self, args):
+        from ..meta.meta_server import RPC_CM_RESTORE_APP
+
+        r = self._meta_call(RPC_CM_RESTORE_APP,
+                            mm.RestoreAppRequest(args[0], int(args[1]),
+                                                 args[2], args[3]),
+                            mm.RestoreAppResponse)
+        if r.error:
+            self.p(f"restore failed: {r.error_text}")
+        else:
+            self.p(f"restore succeed, new app_id={r.app_id}")
+
+    def cmd_add_backup_policy(self, args):
+        from ..meta.meta_server import RPC_CM_ADD_BACKUP_POLICY
+
+        pol = mm.BackupPolicyInfo(
+            name=args[0], backup_root=args[1], apps=args[2].split(","),
+            interval_seconds=int(args[3]),
+            history_count=int(args[4]) if len(args) > 4 else 3)
+        r = self._meta_call(RPC_CM_ADD_BACKUP_POLICY,
+                            mm.AddBackupPolicyRequest(pol),
+                            mm.AddBackupPolicyResponse)
+        self.p(f"ERROR: {r.error_text}" if r.error else "OK")
+
+    def cmd_ls_backup_policy(self, args):
+        from ..meta.meta_server import RPC_CM_LS_BACKUP_POLICY
+
+        r = self._meta_call(RPC_CM_LS_BACKUP_POLICY,
+                            mm.LsBackupPolicyRequest(args[0] if args else ""),
+                            mm.LsBackupPolicyResponse)
+        if r.error:
+            self.p(f"ERROR: {r.error_text}")
+            return
+        for p in r.policies:
+            self.p(f"name={p.name} enabled={p.enabled} "
+                   f"interval={p.interval_seconds}s history={p.history_count} "
+                   f"root={p.backup_root}")
+            self.p(f"  apps: {','.join(p.apps)}")
+            self.p(f"  recent backups: {p.recent_backup_ids}")
+        if not r.policies:
+            self.p("(no policies)")
+
+    def _modify_policy(self, req):
+        from ..meta.meta_server import RPC_CM_MODIFY_BACKUP_POLICY
+
+        r = self._meta_call(RPC_CM_MODIFY_BACKUP_POLICY, req,
+                            mm.ModifyBackupPolicyResponse)
+        self.p(f"ERROR: {r.error_text}" if r.error else "OK")
+
+    def cmd_modify_backup_policy(self, args):
+        req = mm.ModifyBackupPolicyRequest(name=args[0])
+        i = 1
+        while i < len(args):
+            if args[i] == "-i":
+                req.interval_seconds = int(args[i + 1]); i += 2
+            elif args[i] == "-c":
+                req.history_count = int(args[i + 1]); i += 2
+            elif args[i] == "--add":
+                req.add_apps = args[i + 1].split(","); i += 2
+            elif args[i] == "--remove":
+                req.remove_apps = args[i + 1].split(","); i += 2
+            else:
+                raise ValueError(args[i])
+        self._modify_policy(req)
+
+    def cmd_enable_backup_policy(self, args):
+        self._modify_policy(mm.ModifyBackupPolicyRequest(name=args[0],
+                                                         enabled=1))
+
+    def cmd_disable_backup_policy(self, args):
+        self._modify_policy(mm.ModifyBackupPolicyRequest(name=args[0],
+                                                         enabled=0))
+
+    # bulk load / disaster recovery ---------------------------------------
+    # (reference src/shell/commands/{bulk_load,recovery}.cpp)
+
+    def cmd_start_bulk_load(self, args):
+        from ..meta.meta_server import RPC_CM_START_BULK_LOAD
+
+        r = self._meta_call(RPC_CM_START_BULK_LOAD,
+                            mm.StartBulkLoadRequest(args[0], args[1]),
+                            mm.StartBulkLoadResponse)
+        if r.error:
+            self.p(f"bulk load failed: {r.error_text}")
+        else:
+            self.p(f"bulk load succeed, ingested {r.ingested_records} records")
+
+    def cmd_recover(self, args):
+        from ..meta.meta_server import RPC_CM_RECOVER
+
+        r = self._meta_call(RPC_CM_RECOVER, mm.RecoverRequest(list(args)),
+                            mm.RecoverResponse)
+        if r.error:
+            self.p(f"recover failed: {r.error_text}")
+        else:
+            self.p(f"recovered apps: {r.recovered_apps or '(none)'}")
+
+    def cmd_ddd_diagnose(self, args):
+        from ..meta.meta_server import RPC_CM_DDD_DIAGNOSE
+
+        force = "-f" in args or "--force" in args
+        pos = [a for a in args if not a.startswith("-")]
+        r = self._meta_call(RPC_CM_DDD_DIAGNOSE,
+                            mm.DddDiagnoseRequest(pos[0] if pos else "", force),
+                            mm.DddDiagnoseResponse)
+        if r.error:
+            self.p(f"ERROR: {r.error_text}")
+            return
+        if not r.partitions:
+            self.p("no double-dead partitions")
+            return
+        for d in r.partitions:
+            self.p(f"[{d.app_name}.{d.pidx}] {d.reason}")
+            for c in d.candidates:
+                self.p(f"  candidate: {c}")
+            self.p(f"  action: {d.action or '(none; rerun with -f to fix)'}")
 
     # offline debuggers ---------------------------------------------------
     # (reference src/shell/commands/debugger.cpp: sst_dump / mlog_dump /

@@ -21,7 +21,8 @@ from pegasus_tpu.rpc.transport import RpcServer
 
 
 class Cluster:
-    def __init__(self, root, n_nodes=3, fd_grace=60.0):
+    def __init__(self, root, n_nodes=3, fd_grace=60.0, remote_clusters=None,
+                 cluster_id=1):
         self.meta = MetaServer(str(root / "meta" / "state.json"),
                                fd_grace_seconds=fd_grace)
         self.meta_rpc = RpcServer().start()
@@ -31,7 +32,9 @@ class Cluster:
         self.nodes = {}
         for i in range(n_nodes):
             stub = ReplicaStub(str(root / f"node{i}"), [self.meta_addr],
-                               options_factory=lambda: EngineOptions(backend="cpu"))
+                               options_factory=lambda: EngineOptions(backend="cpu"),
+                               remote_clusters=remote_clusters,
+                               cluster_id=cluster_id)
             stub.start(beacon_interval=0.2)
             self.nodes[stub.address] = stub
 

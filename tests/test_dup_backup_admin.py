@@ -1,0 +1,268 @@
+"""Duplication DDL, backup policies, and disaster-recovery admin.
+
+VERDICT-r2 items 6 (dup lifecycle + backup policies + shell families):
+the reference surfaces are src/shell/commands/duplication.cpp:32-260
+(add/query/start/pause/remove/set_dup_fail_mode), cold_backup.cpp's policy
+schedule + retention, and recovery.cpp (`recover`, `ddd_diagnose`). Here
+each is driven end-to-end over real sockets through the Shell command
+layer, including a full dup setup between two onebox clusters.
+"""
+
+import io
+import time
+
+import pytest
+
+from pegasus_tpu.client import MetaResolver, PegasusClient
+from pegasus_tpu.meta import MetaServer
+from pegasus_tpu.meta import messages as mm
+from pegasus_tpu.rpc.transport import RpcServer
+from pegasus_tpu.shell.main import Shell
+from tests.test_cluster import Cluster, make_client
+
+
+def wait_until(fn, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def shell_run(cluster, line: str) -> str:
+    out = io.StringIO()
+    sh = Shell([cluster.meta_addr], out=out)
+    sh.run_line(line)
+    return out.getvalue()
+
+
+# ------------------------------------------------------------- duplication
+
+
+@pytest.fixture
+def two_clusters(tmp_path):
+    b = Cluster(tmp_path / "west", n_nodes=3, cluster_id=2)
+    a = Cluster(tmp_path / "east", n_nodes=3, cluster_id=1,
+                remote_clusters={"west": [b.meta_addr]})
+    try:
+        yield a, b
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_duplication_lifecycle_between_clusters(two_clusters):
+    a, b = two_clusters
+    ca = make_client(a, app="dt", partitions=2)
+    cb = make_client(b, app="dt", partitions=2)
+
+    # --- add_dup via shell; entries queryable
+    out = shell_run(a, "add_dup dt west")
+    assert "succeed" in out and "dupid: 1" in out
+    out = shell_run(a, "query_dup dt")
+    assert "dupid=1" in out and "status=start" in out and "remote=west" in out
+    # duplicate add rejected
+    assert "already exists" in shell_run(a, "add_dup dt west")
+
+    # --- writes on A ship to B (history + live)
+    for i in range(10):
+        ca.set(b"dk%d" % i, b"s", b"v%d" % i)
+    assert wait_until(lambda: all(
+        cb.get(b"dk%d" % i, b"s") == b"v%d" % i for i in range(10)))
+
+    # --- pause: new writes are queued, not shipped
+    assert "succeed" in shell_run(a, "pause_dup dt 1")
+    time.sleep(0.3)  # let the pause reach the shippers
+    for i in range(10, 15):
+        ca.set(b"dk%d" % i, b"s", b"v%d" % i)
+    time.sleep(1.0)
+    assert all(cb.get(b"dk%d" % i, b"s") is None for i in range(10, 15))
+
+    # --- start again: the retained backlog ships
+    assert "succeed" in shell_run(a, "start_dup dt 1")
+    assert wait_until(lambda: all(
+        cb.get(b"dk%d" % i, b"s") == b"v%d" % i for i in range(10, 15)))
+
+    # --- fail-mode propagates to the live shippers
+    assert "succeed" in shell_run(a, "set_dup_fail_mode dt 1 skip")
+    assert wait_until(lambda: any(
+        d.fail_mode == "skip"
+        for stub in a.nodes.values()
+        for rep in stub._replicas.values()
+        for d in rep.duplicators.values()))
+
+    # --- remove: shippers torn down, writes stop flowing
+    assert "succeed" in shell_run(a, "remove_dup dt 1")
+    assert wait_until(lambda: all(
+        not rep.duplicators for stub in a.nodes.values()
+        for rep in stub._replicas.values()))
+    ca.set(b"post_remove", b"s", b"x")
+    time.sleep(0.8)
+    assert cb.get(b"post_remove", b"s") is None
+    assert "dupid" not in shell_run(a, "query_dup dt").replace("(none)", "")
+    ca.close()
+    cb.close()
+
+
+def test_duplication_freeze_then_start(two_clusters):
+    a, b = two_clusters
+    ca = make_client(a, app="fz", partitions=1)
+    cb = make_client(b, app="fz", partitions=1)
+    out = shell_run(a, "add_dup fz west -f")
+    assert "freeze: true" in out
+    ca.set(b"h", b"s", b"frozen")
+    time.sleep(0.8)
+    assert cb.get(b"h", b"s") is None            # DS_INIT: not shipping
+    assert "succeed" in shell_run(a, "start_dup fz 1")
+    # catch_up replays the plog history written while frozen
+    assert wait_until(lambda: cb.get(b"h", b"s") == b"frozen")
+    ca.close()
+    cb.close()
+
+
+def test_duplication_survives_primary_failover(two_clusters):
+    a, b = two_clusters
+    ca = make_client(a, app="fo", partitions=1)
+    cb = make_client(b, app="fo", partitions=1)
+    shell_run(a, "add_dup fo west")
+    for i in range(5):
+        ca.set(b"pre%d" % i, b"s", b"v%d" % i)
+    assert wait_until(lambda: cb.get(b"pre4", b"s") == b"v4")
+    # beacons fold the primary's confirmed decree into the meta's dup entry;
+    # the promoted primary will start its shipper at that floor
+    app_id = ca.resolver.app_id
+    assert wait_until(lambda: any(
+        int(v) > 0 for e in a.meta._dups.get(app_id, [])
+        for v in e.get("confirmed", {}).values()))
+    victim = a.meta._parts[app_id][0].primary
+    a.kill_node(victim)
+    # the promoted primary rebuilds its shipper (catch_up from its plog)
+    for i in range(5, 10):
+        ca.set(b"pre%d" % i, b"s", b"v%d" % i)
+    assert wait_until(lambda: all(
+        cb.get(b"pre%d" % i, b"s") == b"v%d" % i for i in range(10)))
+    ca.close()
+    cb.close()
+
+
+# ---------------------------------------------------------- backup policies
+
+
+def test_backup_policy_schedule_and_retention(tmp_path):
+    c = Cluster(tmp_path / "c")
+    try:
+        cl = make_client(c, app="bp", partitions=2)
+        for i in range(20):
+            cl.set(b"bk%d" % i, b"s", b"v%d" % i)
+        root = str(tmp_path / "backups")
+        out = shell_run(c, f"add_backup_policy daily {root} bp 100 2")
+        assert "OK" in out
+        assert "name=daily" in shell_run(c, "ls_backup_policy")
+        # three due runs with an advancing pinned clock; retention = 2
+        ran1 = c.meta.run_backup_policies(now=1000)
+        ran2 = c.meta.run_backup_policies(now=1100)
+        ran3 = c.meta.run_backup_policies(now=1200)
+        assert all(bid for _, _, bid in ran1 + ran2 + ran3)
+        # not due again until interval passes
+        assert c.meta.run_backup_policies(now=1201) == []
+        import os
+
+        kept = sorted(os.listdir(os.path.join(root, "daily")))
+        assert kept == ["1100000", "1200000"], kept
+        # restore from the newest retained backup into a new table
+        out = shell_run(c, f"restore_app {root}/daily 1200000 bp bp_restored")
+        assert "succeed" in out
+        cr = PegasusClient(MetaResolver([c.meta_addr], "bp_restored"))
+        for i in range(20):
+            assert cr.get(b"bk%d" % i, b"s") == b"v%d" % i
+        cr.close()
+        # disable stops the schedule
+        assert "OK" in shell_run(c, "disable_backup_policy daily")
+        assert c.meta.run_backup_policies(now=5000) == []
+        # modify: interval + history + app set
+        assert "OK" in shell_run(c, "modify_backup_policy daily -i 7 -c 5")
+        pol = c.meta._policies["daily"]
+        assert pol["interval_seconds"] == 7 and pol["history_count"] == 5
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_backup_policy_validation(tmp_path):
+    c = Cluster(tmp_path / "c", n_nodes=1)
+    try:
+        out = shell_run(c, "add_backup_policy p1 /tmp/x nosuchapp 60")
+        assert "no such app" in out
+    finally:
+        c.stop()
+
+
+# ------------------------------------------------------- disaster recovery
+
+
+def test_recover_rebuilds_meta_from_nodes(tmp_path):
+    c = Cluster(tmp_path / "c")
+    try:
+        cl = make_client(c, app="rc", partitions=2)
+        for i in range(30):
+            cl.set(b"rk%d" % i, b"s", b"v%d" % i)
+        cl.close()
+        nodes = list(c.nodes)
+        # a BRAND NEW meta with empty state (the disaster): knows nothing
+        m2 = MetaServer(str(tmp_path / "meta2" / "state.json"))
+        rpc2 = RpcServer().start()
+        for code, fn in m2.rpc_handlers().items():
+            rpc2.register(code, fn)
+        addr2 = f"{rpc2.address[0]}:{rpc2.address[1]}"
+        try:
+            out = io.StringIO()
+            sh = Shell([addr2], out=out)
+            sh.run_line("recover " + " ".join(nodes))
+            assert "rc" in out.getvalue()
+            assert "rc" in m2._apps
+            assert len(m2._parts[m2._apps["rc"].app_id]) == 2
+            # the recovered table serves reads through the NEW meta
+            cr = PegasusClient(MetaResolver([addr2], "rc"))
+            for i in range(30):
+                assert cr.get(b"rk%d" % i, b"s") == b"v%d" % i
+            cr.close()
+        finally:
+            rpc2.stop()
+    finally:
+        c.stop()
+
+
+def test_ddd_diagnose_finds_and_fixes(tmp_path):
+    c = Cluster(tmp_path / "c")
+    try:
+        cl = make_client(c, app="dd", partitions=1)
+        for i in range(10):
+            cl.set(b"ddk%d" % i, b"s", b"v%d" % i)
+        app_id = cl.resolver.app_id
+        pc = c.meta._parts[app_id][0]
+        members = [pc.primary] + list(pc.secondaries)
+        # every member "dies" (lease-expired) -> partition left memberless;
+        # the processes themselves keep running and keep beaconing, the
+        # classic double-dead state after a rolling outage
+        for m in members:
+            c.meta.mark_node_dead(m)
+        assert pc.primary == "" and pc.secondaries == []
+        # beacons revive the nodes as FD-alive
+        assert wait_until(lambda: len(c.meta._alive_nodes_locked()) == 3,
+                          timeout=5)
+        out = shell_run(c, "ddd_diagnose dd")
+        assert "no alive member" in out and "candidate:" in out
+        assert "(none; rerun with -f to fix)" in out
+        out = shell_run(c, "ddd_diagnose dd -f")
+        assert "promoted" in out
+        assert pc.primary in members
+        # a fresh client reads everything back
+        cr = PegasusClient(MetaResolver([c.meta_addr], "dd"))
+        for i in range(10):
+            assert cr.get(b"ddk%d" % i, b"s") == b"v%d" % i
+        cr.close()
+        assert "no double-dead partitions" in shell_run(c, "ddd_diagnose dd")
+        cl.close()
+    finally:
+        c.stop()
