@@ -63,3 +63,10 @@ ROCKSDB_ITERATION_THRESHOLD_TIME_MS = ITERATION_THRESHOLD_TIME_MS
 # duplication config travels to replicas as a reserved app-env (the meta
 # pushes it with the normal env spread; replicas reconcile duplicators)
 ENV_DUPLICATION_KEY = "__duplication__"
+
+# abnormal-size read tracing thresholds (reference _abnormal_* gflags,
+# pegasus_server_impl.h:317-343); hot-applied app-envs here, 0 = disabled
+ENV_ABNORMAL_GET_SIZE = "replica.abnormal_get_size_threshold"
+ENV_ABNORMAL_MULTI_GET_SIZE = "replica.abnormal_multi_get_size_threshold"
+ENV_ABNORMAL_MULTI_GET_ITERATE_COUNT = \
+    "replica.abnormal_multi_get_iterate_count_threshold"

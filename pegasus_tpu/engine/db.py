@@ -258,12 +258,20 @@ class LsmEngine:
         return block.value(i)
 
     def scan(self, start_key: bytes = b"", stop_key: bytes = None, now: int = None,
-             include_deleted: bool = False, reverse: bool = False):
+             include_deleted: bool = False, reverse: bool = False,
+             hash32=None):
         """Merged iterator over [start_key, stop_key): yields (key, value,
         expire_ts) newest-version-wins, tombstones/expired filtered.
         reverse=True iterates the same range descending (the engine-level
         Prev() the reference's reverse multi_get uses), so a bounded reader
-        sees the TAIL of the range first."""
+        sees the TAIL of the range first.
+
+        hash32: when the whole range lives under ONE hashkey (multi_get /
+        sortkey_count / hash scans), its 32-bit hashkey hash lets the file
+        walk probe each SST's hashkey bloom and skip files that cannot hold
+        the hashkey — the reference's prefix-bloom range pruning
+        (src/server/hashkey_transform.h:31-60 + ReadOptions prefix_same_as_
+        start), which min/max-key overlap alone cannot provide."""
         now = epoch_now() if now is None else now
         with self._lock:
             mem_snapshot = sorted(
@@ -290,6 +298,8 @@ class LsmEngine:
             if stop_key is not None and sst.min_key and sst.min_key >= stop_key:
                 return
             if start_key and sst.max_key and sst.max_key < start_key:
+                return
+            if hash32 is not None and not sst.maybe_contains_hash(hash32):
                 return
             b = sst.block()
             lo = sst.lower_bound(start_key) if start_key else 0
@@ -698,6 +708,35 @@ class LsmEngine:
         if not os.path.exists(mpath):
             self._meta = {META_DATA_VERSION: self.opts.data_version}
             self._durable_meta = {}
+            # repair path: adopt orphan SSTs (a replica dir from another
+            # build / a manifest lost to a crash) into their header level,
+            # newest file id first — the upgrade tier's "new server opens an
+            # old dir" requirement (reference: rocksdb repair semantics)
+            orphans = sorted(f for f in os.listdir(self.path)
+                             if f.endswith(".sst"))
+            for fname in orphans:
+                try:
+                    sst = SSTable(os.path.join(self.path, fname))
+                except (ValueError, KeyError, OSError) as e:
+                    print(f"[engine] skipping unreadable orphan {fname}: "
+                          f"{e!r}", flush=True)
+                    continue
+                lv = int(sst.meta.get("level", 0))
+                if lv <= 0:
+                    self._l0.insert(0, sst)
+                else:
+                    self._levels.setdefault(lv, []).append(sst)
+                self._durable_decree = max(
+                    self._durable_decree,
+                    int(sst.meta.get("last_flushed_decree", 0)))
+                num = os.path.splitext(fname)[0]
+                if num.isdigit():
+                    self._next_file = max(self._next_file, int(num) + 1)
+            if orphans:
+                for lv in self._levels:
+                    self._levels[lv].sort(key=lambda s: s.min_key or b"")
+                self._meta[META_LAST_FLUSHED_DECREE] = self._durable_decree
+                self._last_committed_decree = self._durable_decree
             self._write_manifest_locked()
             return
         with open(mpath) as f:

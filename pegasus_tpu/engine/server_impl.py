@@ -10,6 +10,7 @@ engine write; multi_put/incr/CAS/... routed to single handlers), dynamic
 behavior driven by app-envs (update_app_envs :2406).
 """
 
+import struct
 import time
 
 from ..base import consts, key_schema
@@ -28,6 +29,18 @@ from ..rpc.task_codes import (BATCHABLE, RPC_BULK_LOAD_INGEST,  # noqa: F401
                               RPC_CHECK_AND_MUTATE, RPC_CHECK_AND_SET,
                               RPC_DUPLICATE, RPC_INCR, RPC_MULTI_PUT,
                               RPC_MULTI_REMOVE, RPC_PUT, RPC_REMOVE)
+
+
+def _hk_hash32(hash_key: bytes):
+    """32-bit hashkey hash for SST bloom probes — the same truncation the
+    engine stores per record (db.get) and _bloom_build indexes. Returns
+    None (= no pruning) for the EMPTY hashkey: key_hash falls back to
+    hashing the sort key then (key_schema.py:60-72), so records under
+    b'' carry per-sortkey hashes and no single probe covers them."""
+    if not hash_key:
+        return None
+    return key_schema.key_hash(
+        key_schema.generate_key(hash_key, b"")) & 0xFFFFFFFF
 
 
 class PegasusServer:
@@ -52,6 +65,9 @@ class PegasusServer:
         self._app_envs = {}
         self._default_ttl = 0
         self._slow_query_threshold_ms = 20  # reference default 20ms
+        self._abnormal_get_size = 0                  # bytes; 0 = disabled
+        self._abnormal_multi_get_size = 0            # bytes; 0 = disabled
+        self._abnormal_multi_get_iterate_count = 0   # rows;  0 = disabled
         self._pfx = f"app.{app_id}.{pidx}."
         from .manual_compact_service import ManualCompactService
 
@@ -86,6 +102,21 @@ class PegasusServer:
             except (TypeError, ValueError):
                 print(f"[app-envs] bad {consts.ENV_SLOW_QUERY_THRESHOLD}="
                       f"{sq!r} ignored", flush=True)
+        # abnormal request/response SIZE tracing (reference
+        # pegasus_server_impl.h:317-343 _abnormal_*_threshold gflags;
+        # 0 = disabled): oversized reads are logged + counted even when fast
+        for env_key, attr in (
+                (consts.ENV_ABNORMAL_GET_SIZE, "_abnormal_get_size"),
+                (consts.ENV_ABNORMAL_MULTI_GET_SIZE,
+                 "_abnormal_multi_get_size"),
+                (consts.ENV_ABNORMAL_MULTI_GET_ITERATE_COUNT,
+                 "_abnormal_multi_get_iterate_count")):
+            v = envs.get(env_key)
+            if v is not None:
+                try:
+                    setattr(self, attr, max(0, int(v)))
+                except (TypeError, ValueError):
+                    print(f"[app-envs] bad {env_key}={v!r} ignored", flush=True)
         backend = envs.get(consts.COMPACTION_BACKEND_KEY)
         if backend in ("cpu", "tpu"):
             self.engine.opts.backend = backend
@@ -248,12 +279,29 @@ class PegasusServer:
             hk, _ = key_schema.restore_key(key)
         except ValueError:
             hk = key  # malformed client key: still account, never raise
-        self.cu_calculator.add_read(hk, len(key) + len(resp.value))
+        self.cu_calculator.add_get_cu(hk, key, resp.value)
+        self._check_abnormal_size("get", hk, len(key) + len(resp.value),
+                                  self._abnormal_get_size)
         counters.rate(self._pfx + "get_qps").increment()
         elapsed_us = int((time.perf_counter() - t0) * 1e6)
         counters.percentile(self._pfx + "get_latency_us").set(elapsed_us)
         self._check_slow_query("get", hk, elapsed_us)
         return resp
+
+    def _check_abnormal_size(self, op: str, hash_key: bytes, size: int,
+                             size_thr: int, rows: int = 0,
+                             rows_thr: int = 0) -> None:
+        """Oversized-read tracing (reference _abnormal_*_threshold,
+        pegasus_server_impl.h:317-343): a read can be fast AND abusive;
+        size/row thresholds flag it independently of latency."""
+        if (size_thr and size >= size_thr) or (rows_thr and rows >= rows_thr):
+            from ..base.utils import c_escape_string
+
+            counters.rate(self._pfx + "recent_abnormal_count").increment()
+            print(f"[abnormal-size] {op} hash_key="
+                  f"\"{c_escape_string(hash_key[:64])}\" size={size}B "
+                  f"rows={rows} (thresholds {size_thr}B/{rows_thr})",
+                  flush=True)
 
     def _check_slow_query(self, op: str, hash_key: bytes, elapsed_us: int):
         """Slow/abnormal query tracing (reference _slow_query_threshold_ns,
@@ -285,7 +333,11 @@ class PegasusServer:
                     data = b"" if req.no_value else self._schema.extract_user_data(raw)
                     resp.kvs.append(msg.KeyValue(sk, data))
                     size += len(sk) + len(data)
-            self.cu_calculator.add_read(req.hash_key, size)
+            self.cu_calculator.add_multi_get_cu(req.hash_key, resp.kvs)
+            self._check_abnormal_size(
+                "multi_get", req.hash_key, size, self._abnormal_multi_get_size,
+                rows=len(req.sort_keys),
+                rows_thr=self._abnormal_multi_get_iterate_count)
             self._check_slow_query("multi_get", req.hash_key,
                                    int((time.perf_counter() - t0) * 1e6))
             return resp
@@ -302,11 +354,14 @@ class PegasusServer:
         limiter = self._make_limiter()
         out, complete = [], True
         size = 0
+        iterated = 0
+        h32 = _hk_hash32(req.hash_key)
         if req.reverse:
             scan_hi = stop + b"\x00" if req.stop_inclusive else stop
-            it = self.engine.scan(start, scan_hi, now=now, reverse=True)
+            it = self.engine.scan(start, scan_hi, now=now, reverse=True,
+                                  hash32=h32)
         else:
-            it = self.engine.scan(start, None, now=now)
+            it = self.engine.scan(start, None, now=now, hash32=h32)
         for k, raw, _ in it:
             if req.reverse:
                 if k == start and not req.start_inclusive:
@@ -320,6 +375,7 @@ class PegasusServer:
                 if not req.start_inclusive and k == start:
                     continue
             limiter.add_count()
+            iterated += 1
             if not limiter.valid():
                 complete = False
                 break
@@ -336,7 +392,10 @@ class PegasusServer:
                 out.pop()
                 complete = False
                 break
-        self.cu_calculator.add_read(req.hash_key, size)
+        self.cu_calculator.add_multi_get_cu(req.hash_key, out)
+        self._check_abnormal_size(
+            "multi_get", req.hash_key, size, self._abnormal_multi_get_size,
+            rows=iterated, rows_thr=self._abnormal_multi_get_iterate_count)
         self._check_slow_query("multi_get", req.hash_key,
                                int((time.perf_counter() - t0) * 1e6))
         resp.kvs = out
@@ -352,14 +411,15 @@ class PegasusServer:
         stop = key_schema.generate_next_bytes(hash_key)
         limiter = self._make_limiter(count_only=True)
         count = 0
-        for _ in self.engine.scan(start, stop, now=now):
+        for _ in self.engine.scan(start, stop, now=now,
+                                  hash32=_hk_hash32(hash_key)):
             limiter.add_count()
             if not limiter.valid():
                 resp.error = Status.INCOMPLETE
                 break
             count += 1
         resp.count = count
-        self.cu_calculator.add_read(hash_key, count)
+        self.cu_calculator.add_sortkey_count_cu(hash_key)
         counters.rate(self._pfx + "scan_qps").increment()
         return resp
 
@@ -374,6 +434,10 @@ class PegasusServer:
             return resp
         expire = self._schema.extract_expire_ts(raw)
         resp.ttl_seconds = (expire - now) if expire > 0 else -1
+        try:
+            self.cu_calculator.add_ttl_cu(key_schema.restore_key(key)[0], key)
+        except ValueError:
+            pass
         return resp
 
     # ------------------------------------------------------------- scans
@@ -398,7 +462,18 @@ class PegasusServer:
             pstart = key_schema.generate_key(req.hash_key_filter_pattern, b"")
             if pstart > start:
                 start = pstart
-        it = self.engine.scan(start, stop, now=now)
+        # single-hashkey scans (the client's hash_scan shape) carry the
+        # hashkey hash down so the file walk can bloom-prune
+        h32 = None
+        try:
+            hk_start, _ = key_schema.restore_key(start)
+            if hk_start and stop is not None and (
+                    stop == key_schema.generate_next_bytes(hk_start)
+                    or key_schema.restore_key(stop)[0] == hk_start):
+                h32 = _hk_hash32(hk_start)
+        except (ValueError, IndexError, struct.error):
+            pass
+        it = self.engine.scan(start, stop, now=now, hash32=h32)
         return self._fill_scan_batch(resp, it, req, now)
 
     def _scan_row_passes(self, req, k: bytes) -> bool:
@@ -462,6 +537,7 @@ class PegasusServer:
             if n >= batch:
                 exhausted = False
                 break
+        self.cu_calculator.add_scan_cu(resp.kvs)
         if exhausted:
             resp.context_id = consts.SCAN_CONTEXT_ID_COMPLETED
         else:

@@ -195,6 +195,9 @@ class SSTable:
 
     def block(self) -> KVBlock:
         if self._block is None:
+            from ..runtime.perf_counters import counters
+
+            counters.rate("engine.sst_block_load").increment()
             self._block, _ = read_sst(self.path)
         return self._block
 

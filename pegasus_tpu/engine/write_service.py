@@ -57,11 +57,8 @@ class WriteService:
         self._batch = None
         self.cu_calculator = None  # set by PegasusServer
 
-    def _add_write_cu(self, key_or_hash: bytes, nbytes: int, is_key=True):
-        if self.cu_calculator is None:
-            return
-        hk = key_schema.restore_key(key_or_hash)[0] if is_key else key_or_hash
-        self.cu_calculator.add_write(hk, nbytes)
+    def _hk(self, key: bytes) -> bytes:
+        return key_schema.restore_key(key)[0]
 
     # ----------------------------------------------------------- helpers
 
@@ -100,13 +97,15 @@ class WriteService:
         resp = self._fill(msg.UpdateResponse(), decree)
         value = self._encode(req.value, req.expire_ts_seconds, timestamp_us)
         self.engine.write(WriteBatch().put(req.key, value, req.expire_ts_seconds), decree)
-        self._add_write_cu(req.key, len(req.key) + len(req.value))
+        if self.cu_calculator:
+            self.cu_calculator.add_put_cu(self._hk(req.key), req.key, req.value)
         return resp
 
     def remove(self, decree: int, key: bytes):
         resp = self._fill(msg.UpdateResponse(), decree)
         self.engine.write(WriteBatch().delete(key), decree)
-        self._add_write_cu(key, len(key))
+        if self.cu_calculator:
+            self.cu_calculator.add_remove_cu(self._hk(key), key)
         return resp
 
     def multi_put(self, decree: int, req: msg.MultiPutRequest, timestamp_us: int = 0):
@@ -123,7 +122,8 @@ class WriteService:
             batch.put(key, value, req.expire_ts_seconds)
             total += len(key) + len(kv.value)
         self.engine.write(batch, decree)
-        self._add_write_cu(req.hash_key, total, is_key=False)
+        if self.cu_calculator:
+            self.cu_calculator.add_multi_put_cu(req.hash_key, req.kvs)
         return resp
 
     def multi_remove(self, decree: int, req: msg.MultiRemoveRequest):
@@ -138,7 +138,8 @@ class WriteService:
             batch.delete(key_schema.generate_key(req.hash_key, sk))
             total += len(req.hash_key) + len(sk)
         self.engine.write(batch, decree)
-        self._add_write_cu(req.hash_key, total, is_key=False)
+        if self.cu_calculator:
+            self.cu_calculator.add_multi_remove_cu(req.hash_key, req.sort_keys)
         resp.count = len(req.sort_keys)
         return resp
 
@@ -175,7 +176,8 @@ class WriteService:
                 new_expire = req.expire_ts_seconds
         value = self._encode(str(new_value).encode(), new_expire)
         self.engine.write(WriteBatch().put(req.key, value, new_expire), decree)
-        self._add_write_cu(req.key, len(req.key) + len(value))
+        if self.cu_calculator:  # RMW: read CU for the old value + write CU
+            self.cu_calculator.add_incr_cu(self._hk(req.key), req.key)
         resp.new_value = new_value
         return resp
 
@@ -210,7 +212,9 @@ class WriteService:
         self.engine.write(
             WriteBatch().put(set_key, value, req.set_expire_ts_seconds), decree
         )
-        self._add_write_cu(req.hash_key, len(set_key) + len(value), is_key=False)
+        if self.cu_calculator:  # RMW: the check read charges read CU too
+            self.cu_calculator.add_check_and_set_cu(
+                req.hash_key, req.check_sort_key, set_sk, req.set_value)
         return resp
 
     def check_and_mutate(self, decree: int, req: msg.CheckAndMutateRequest, now: int = None):
@@ -254,7 +258,9 @@ class WriteService:
                 batch.delete(key)
                 total += len(key)
         self.engine.write(batch, decree)
-        self._add_write_cu(req.hash_key, total, is_key=False)
+        if self.cu_calculator:  # RMW: the check read charges read CU too
+            self.cu_calculator.add_check_and_mutate_cu(
+                req.hash_key, req.check_sort_key, total, len(req.mutate_list))
         return resp
 
     def ingestion_files(self, decree: int, req: msg.BulkLoadIngestRequest):

@@ -564,6 +564,26 @@ class MetaServer:
         self._push_app_envs(app, parts)
         return codec.encode(mm.ModifyDuplicationResponse())
 
+    def push_dup_envs(self) -> None:
+        """Periodic refresh of dup entries (incl. beacon-folded confirmed
+        decrees) to every replica of dup'd apps — the reference's dup-sync
+        cadence. Without this, secondaries' plog-GC floors only advance on
+        view changes and the log pins at the dup-creation decree forever."""
+        with self._lock:
+            targets = [(self._apps_by_id_locked(aid), entries)
+                       for aid, entries in self._dups.items() if entries]
+            targets = [(app, list(self._parts[app.app_id]))
+                       for app, entries in targets if app is not None]
+            for app, _ in targets:
+                self._refresh_dup_env_locked(app)
+            self._persist_locked()
+        for app, parts in targets:
+            self._push_app_envs(app, parts)
+
+    def _apps_by_id_locked(self, app_id: int):
+        return next((a for a in self._apps.values() if a.app_id == app_id),
+                    None)
+
     # ------------------------------------------------------- backup policies
 
     def _on_add_backup_policy(self, header, body) -> bytes:

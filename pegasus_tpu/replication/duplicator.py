@@ -56,6 +56,8 @@ class MutationDuplicator:
         self._progress_path = (os.path.join(progress_dir, f"dup_{dupid}.json")
                                if progress_dir else None)
         self.last_shipped_decree = max(self._load_progress(), confirmed_floor)
+        self._saved_decree = self.last_shipped_decree
+        self._saved_at = 0.0
         self._thread = threading.Thread(target=self._ship_loop, daemon=True)
         self._thread.start()
 
@@ -70,15 +72,32 @@ class MutationDuplicator:
                 pass
         return 0
 
-    def _save_progress(self) -> None:
+    _SAVE_EVERY_DECREES = 64
+    _SAVE_EVERY_SECONDS = 1.0
+
+    def _save_progress(self, force: bool = False) -> None:
+        """Batched persistence: the file is a restart HINT (catch_up + the
+        meta confirmed floor cover a stale value, shipping is at-least-
+        once), so a write+rename per confirmed decree buys nothing."""
+        import time
+
         if not self._progress_path:
             return
+        if not force:
+            due = (self.last_shipped_decree - self._saved_decree
+                   >= self._SAVE_EVERY_DECREES
+                   or time.monotonic() - self._saved_at
+                   >= self._SAVE_EVERY_SECONDS)
+            if not due:
+                return
         tmp = self._progress_path + ".tmp"
         os.makedirs(os.path.dirname(self._progress_path), exist_ok=True)
         with open(tmp, "w") as f:
             json.dump({"dupid": self.dupid,
                        "confirmed_decree": self.last_shipped_decree}, f)
         os.replace(tmp, self._progress_path)
+        self._saved_decree = self.last_shipped_decree
+        self._saved_at = time.monotonic()
 
     def catch_up(self, plog) -> int:
         """Backfill the ship queue from the plog past the confirmed decree —
@@ -201,6 +220,10 @@ class MutationDuplicator:
             self._stop = True
             self._cv.notify()
         self._thread.join(timeout=5)
+        try:
+            self._save_progress(force=True)
+        except OSError:
+            pass
         self.pool.close()
 
 

@@ -13,6 +13,31 @@ payload = codec-encoded LogMutation. Torn tails (crash mid-append) are
 detected by length/crc and truncated at recovery, like mutation_log's
 replay cursor. Segments roll at `segment_bytes`; GC drops whole segments
 whose decrees are all <= the durable decree.
+
+Why plog-only (no shared log / slog) — a deliberate redesign, not a gap.
+The reference historically wrote every mutation TWICE: once to a
+node-global shared log (batched, sequential — the commit-latency path)
+and once to a per-replica private log (the replay/learn path), because
+hundreds of replicas each fsyncing a private WAL would shatter a
+spinning disk's sequential bandwidth (config.ini:192-260 tunes both).
+Pegasus itself later deprecated the slog (it is absent from modern
+apache/incubator-pegasus; log_shared_* knobs were removed) for the same
+reasons that apply here, only stronger:
+
+  * this build acknowledges writes from the 2PC quorum over PacificA with
+    group commit — one plog append per CONCURRENT BATCH, not per write,
+    so the append rate is bounded by batch rounds, not ops;
+  * plog appends are buffered sequential writes with fsync optional
+    (`fsync=False` default, like log_private flush cadence), so there is
+    no per-replica-seek penalty to amortize on modern storage;
+  * a single log keyed by decree keeps recovery single-source: replay,
+    learner catch-up, duplication catch_up, and mlog_dump all read the
+    same stream — the reference needed slog->plog "log split" complexity
+    precisely because recovery had two sources of truth.
+
+The one capability the slog bought — cross-replica batched fsync on one
+spindle — is irrelevant on flash and under group commit; nothing else in
+the recovery story needs it.
 """
 
 import os

@@ -152,7 +152,10 @@ class Replica:
                 if slot.done:
                     break
                 if self._batch_leader_active:
-                    self._batch_cv.wait(0.05)
+                    # handoff is notify-driven (the leader's finally block
+                    # notify_all's); the timeout is only a defensive bound,
+                    # not a polling cadence (ADVICE r2 weak: 50ms poll)
+                    self._batch_cv.wait(0.5)
                     continue
                 self._batch_leader_active = True
                 batch = self._batch_pending
@@ -345,16 +348,23 @@ class Replica:
         if flush:
             self.server.engine.flush()
         floor = self.server.engine.last_durable_decree()
-        for d in self.duplicators.values():
-            floor = min(floor, d.last_shipped_decree)
-        # SECONDARIES hold the log too: they run no shippers, but on
-        # promotion the new primary catches up from ITS plog at the
-        # meta-confirmed decree (beacon-folded into the dup env entries) —
-        # gc'ing past that floor would open a silent duplication gap
-        for e in self._dup_env_entries():
-            if e.get("status") in ("init", "start", "pause"):
-                floor = min(floor, int(
-                    e.get("confirmed", {}).get(str(self.pidx), 0)))
+        # Per dup entry the holdback decree is the freshest confirmed point
+        # we know: our own shipper's progress when we run one (primary),
+        # else the meta-confirmed decree the env carries (secondaries hold
+        # the log too — on promotion the new primary catches up from ITS
+        # plog, so gc'ing past that floor would open a duplication gap; the
+        # meta re-pushes refreshed entries periodically so this floor
+        # advances on stable clusters instead of pinning the log at 0).
+        entries = {e["dupid"]: e for e in self._dup_env_entries()
+                   if e.get("status") in ("init", "start", "pause")}
+        dups = dict(self.duplicators)
+        for dupid, e in entries.items():
+            conf = int(e.get("confirmed", {}).get(str(self.pidx), 0))
+            d = dups.get(dupid)
+            floor = min(floor, max(conf, d.last_shipped_decree) if d else conf)
+        for dupid, d in dups.items():
+            if dupid not in entries:  # shipper ahead of the env snapshot
+                floor = min(floor, d.last_shipped_decree)
         self.plog.gc(floor)
 
     def _dup_env_entries(self) -> list:

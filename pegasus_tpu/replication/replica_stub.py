@@ -152,8 +152,9 @@ class ReplicaStub:
         while not self._stop.wait(self._beacon_interval):
             try:
                 self.send_beacon()
-            except (RpcError, OSError):
-                pass
+            except Exception as e:  # ANY error: a dead beacon thread gets
+                # this healthy node declared dead after fd_grace
+                print(f"[beacon] {self.address}: {e!r}", flush=True)
 
     def send_beacon(self):
         with self._lock:
@@ -161,7 +162,9 @@ class ReplicaStub:
             progress = [
                 f"{a}.{p}.{dupid}:{d.last_shipped_decree}"
                 for (a, p), rep in self._replicas.items()
-                for dupid, d in rep.duplicators.items()]
+                # dict() snapshot: _sync_duplications swaps the mapping
+                # copy-on-write, so iteration here can never see a resize
+                for dupid, d in dict(rep.duplicators).items()]
         req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
                                dup_progress=progress)
         for meta in self.meta_addrs:
@@ -238,16 +241,19 @@ class ReplicaStub:
             for e in entries:
                 if e.get("status") in ("start", "pause"):
                     want[int(e["dupid"])] = e
-        for dupid in list(rep.duplicators):
+        # copy-on-write: concurrent readers (beacon thread, gc_log) snapshot
+        # the mapping, so reconcile into a copy and swap it in at the end
+        dups = dict(rep.duplicators)
+        for dupid in list(dups):
             if dupid not in want:
-                d = rep.duplicators.pop(dupid)
+                d = dups.pop(dupid)
                 try:
                     rep.commit_hooks.remove(d.on_commit)
                 except ValueError:
                     pass
                 d.stop()
         for dupid, e in want.items():
-            d = rep.duplicators.get(dupid)
+            d = dups.get(dupid)
             if d is None:
                 metas = self.remote_clusters.get(e["remote"])
                 if not metas:
@@ -270,11 +276,12 @@ class ReplicaStub:
                     fail_mode=e.get("fail_mode", "slow"), dupid=dupid,
                     progress_dir=os.path.join(rep.path, "dup"),
                     confirmed_floor=floor, paused=True)
-                rep.duplicators[dupid] = d
+                dups[dupid] = d
                 rep.commit_hooks.append(d.on_commit)
                 d.catch_up(rep.plog)
             d.fail_mode = e.get("fail_mode", "slow")
             d.set_paused(e.get("status") == "pause")
+        rep.duplicators = dups
 
     def _on_query_replica_info(self, header, body) -> bytes:
         """Everything this node holds — the disaster-recovery scan the meta

@@ -99,6 +99,7 @@ class MetaApp:
         return f"{self.rpc.address[0]}:{self.rpc.address[1]}"
 
     def start(self):
+        self._stopped = False
         self.rpc.start()
         self._schedule_fd()
         return self
@@ -114,13 +115,17 @@ class MetaApp:
         self._fd_timer.daemon = True
         self._fd_timer.start()
 
-        # backup policies run on their OWN timer: a long synchronous backup
-        # inside the FD tick would stall lease checks for its whole duration
+        # backup policies + dup-progress env refresh run on their OWN timer:
+        # a long synchronous backup inside the FD tick would stall lease
+        # checks for its whole duration
         def policy_tick():
             try:
                 self.meta.run_backup_policies()
+                self.meta.push_dup_envs()
             except Exception as e:  # policy failure must not kill the timer
-                print(f"[meta] backup policy run failed: {e!r}", flush=True)
+                print(f"[meta] maintenance tick failed: {e!r}", flush=True)
+            if self._stopped:
+                return  # stop() raced an in-flight tick: do not re-arm
             self._policy_timer = threading.Timer(
                 max(self._fd_interval, 5.0), policy_tick)
             self._policy_timer.daemon = True
@@ -132,6 +137,7 @@ class MetaApp:
         self._policy_timer.start()
 
     def stop(self):
+        self._stopped = True
         if self._fd_timer:
             self._fd_timer.cancel()
         if getattr(self, "_policy_timer", None):
@@ -147,11 +153,13 @@ class ReplicaApp:
         metas = config.get_list("pegasus.server", "meta_servers",
                                 ["127.0.0.1:34601"])
         backend = config.get_string("pegasus.server", "compaction_backend", "cpu")
+        compression = config.get_string("pegasus.server", "sst_compression",
+                                        "none")
         data_dir = config.get_string(section, "data_dir",
                                      os.path.join("pegasus-data", name))
 
         def options_factory():
-            return EngineOptions(backend=backend)
+            return EngineOptions(backend=backend, compression=compression)
 
         # [pegasus.clusters]: name = comma-separated meta list; the
         # duplication target directory (reference config.ini cluster section)
@@ -196,21 +204,95 @@ class ReplicaApp:
 
 
 class CollectorApp:
-    def __init__(self, name, config: Config, section: str):
-        from ..collector.info_collector import InfoCollector
+    """The third server role (reference pegasus_service_app.h:31-102
+    `pegasus::server::info_collector_app`): cluster stat scraping + hotspot
+    analysis + the availability canary, with its own RPC port so the shell
+    and tests can query what it publishes."""
 
-        metas = config.get_list("pegasus.server", "meta_servers",
-                                ["127.0.0.1:34601"])
+    def __init__(self, name, config: Config, section: str):
+        import json
+
+        from ..collector.available_detector import AvailableDetector
+        from ..collector.info_collector import InfoCollector
+        from ..rpc.transport import RpcServer
+        from .remote_command import RemoteCommandService
+
+        self.metas = config.get_list("pegasus.server", "meta_servers",
+                                     ["127.0.0.1:34601"])
+        self.detect_table = config.get_string(section, "available_detect_app",
+                                              "test")
         self.collector = InfoCollector(
-            list(metas),
+            list(self.metas),
             interval_seconds=config.get_float(section, "interval_seconds", 10.0))
+        self.detector = AvailableDetector(
+            list(self.metas), table_name=self.detect_table,
+            interval_seconds=config.get_float(section,
+                                              "detect_interval_seconds", 1.0))
+        self.rpc = RpcServer(config.get_string(section, "host", "127.0.0.1"),
+                             config.get_int(section, "port", 0))
+        self.commands = RemoteCommandService()
+        self.commands.register_defaults(node_kind="collector",
+                                        describe=lambda: "collector")
+
+        def info(args):
+            return json.dumps({
+                "availability": self.detector.report(),
+                "hotspots": self.collector.hotspots,
+                "app_stats": self.collector.app_stats,
+            })
+
+        self.commands.register("collector-info", info)
+        self.rpc.register("RPC_CLI_CLI_CALL", self.commands.rpc_handler)
+        http_port = config.get_int(section, "http_port", -1)
+        self.reporter = None
+        if http_port >= 0:
+            from ..collector.reporter import CounterReporter
+
+            self.reporter = CounterReporter(port=http_port).start()
+
+    @property
+    def address(self):
+        return f"{self.rpc.address[0]}:{self.rpc.address[1]}"
+
+    def _ensure_probe_table(self):
+        """Auto-create the canary table (the reference's onebox ships a
+        'test' table; a collector must not require manual DDL)."""
+        from ..meta import messages as mm
+        from ..meta.meta_server import RPC_CM_CREATE_APP
+        from ..rpc import codec
+        from ..rpc.transport import RpcConnection
+
+        for m in self.metas:
+            host, _, port = m.rpartition(":")
+            try:
+                conn = RpcConnection((host, int(port)))
+                try:
+                    conn.call(RPC_CM_CREATE_APP, codec.encode(
+                        mm.CreateAppRequest(self.detect_table, 8, 3)),
+                        timeout=10.0)
+                    return
+                finally:
+                    conn.close()
+            except OSError:
+                continue
 
     def start(self):
+        self.rpc.start()
+        try:
+            self._ensure_probe_table()
+        except Exception as e:  # meta may come up later; probes will retry
+            print(f"[collector] probe table create deferred: {e!r}", flush=True)
         self.collector.start()
+        self.detector.start()
+        print(f"[pegasus-tpu] collector rpc on {self.address}", flush=True)
         return self
 
     def stop(self):
+        if self.reporter:
+            self.reporter.stop()
+        self.detector.stop()
         self.collector.stop()
+        self.rpc.stop()
 
 
 register_app_factory("meta", MetaApp)

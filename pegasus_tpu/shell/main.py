@@ -606,17 +606,36 @@ class Shell:
             self.p(f"... {sst.n - limit} more")
 
     def cmd_mlog_dump(self, args):
+        import glob
+        import os
+
         from ..replication.mutation_log import MutationLog
 
-        log = MutationLog(args[0])
         frm = int(args[1]) if len(args) > 1 else 0
-        n = 0
-        for m in log.replay(frm):
-            self.p(f"decree={m.decree} ballot={m.ballot} ts={m.timestamp_us} "
-                   f"ops={[c.rsplit('_', 1)[-1] for c in m.codes]}")
-            n += 1
-        self.p(f"{n} mutations")
-        log.close()
+        root = args[0]
+        # accept a single plog dir OR a replica-node root holding many
+        # replicas (<app_id>.<pidx>/plog) — dump each in turn
+        if glob.glob(os.path.join(root, "log.*")):
+            targets = [("", root)]
+        else:
+            targets = sorted(
+                (os.path.basename(d), os.path.join(d, "plog"))
+                for d in glob.glob(os.path.join(root, "*"))
+                if os.path.isdir(os.path.join(d, "plog")))
+            if not targets:
+                self.p(f"no plog under {root}")
+                return
+        for label, plog_dir in targets:
+            if label:
+                self.p(f"[replica {label}]")
+            log = MutationLog(plog_dir)
+            n = 0
+            for m in log.replay(frm):
+                self.p(f"decree={m.decree} ballot={m.ballot} ts={m.timestamp_us} "
+                       f"ops={[c.rsplit('_', 1)[-1] for c in m.codes]}")
+                n += 1
+            self.p(f"{n} mutations")
+            log.close()
 
     def cmd_local_get(self, args):
         from ..base.key_schema import generate_key
