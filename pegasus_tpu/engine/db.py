@@ -72,6 +72,7 @@ class EngineOptions:
     target_file_size_bytes: int = 64 << 20   # split compaction output files
     level_base_bytes: int = 256 << 20        # L1 budget; Ln = base * ratio^(n-1)
     level_size_ratio: int = 10
+    device_cache_bytes: int = 8 << 30  # HBM budget for resident run columns
     checkpoint_reserve_min_count: int = 2
     checkpoint_reserve_time_seconds: int = 0  # 0 = no time-based retention
     user_ops: tuple = ()            # parsed user-specified compaction rules
@@ -146,6 +147,7 @@ class LsmEngine:
         # would write the same records into two output sets and double-
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
         self._compaction_lock = threading.RLock()
+        self._device_cache_used = 0  # bytes of HBM pinned by resident runs
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -385,8 +387,14 @@ class LsmEngine:
         write_sst(path, sorted_block, {"level": 0,
                                        "last_flushed_decree": imm.last_decree},
                   compression=self.opts.compression)
+        sst = SSTable(path)
+        sst._block = sorted_block  # already in memory: skip the disk re-read
+        # flush-time residency prime: upload the newborn run's packed
+        # columns NOW, off the compaction critical path, so its first
+        # compaction already reads HBM
+        self._device_run_budgeted(sst)
         with self._lock:
-            self._l0.insert(0, SSTable(path))
+            self._l0.insert(0, sst)
             self._imm.remove(imm)
             # durability advances exactly to this memtable's decree: every
             # older memtable has already flushed (oldest-first), younger ones
@@ -395,6 +403,36 @@ class LsmEngine:
             self._write_manifest_locked()
         if len(self._l0) >= self.opts.l0_compaction_trigger:
             self.compact()
+
+    def _device_run_budgeted(self, sst):
+        """Prime/fetch an SST's device-resident run under the HBM budget:
+        past the budget (or on a device allocation failure) the file simply
+        stays host-packed — compaction falls back gracefully instead of
+        OOMing the write path."""
+        if self.opts.backend != "tpu":
+            return None
+        if sst._device_run is not None:
+            return sst._device_run
+        with self._lock:
+            if self._device_cache_used >= self.opts.device_cache_bytes:
+                return None
+        try:
+            dr = sst.device_run(self.opts.prefix_u32)
+        except Exception as e:  # device OOM / backend failure: degrade
+            print(f"[engine] device-run prime failed for {sst.path}: {e!r}",
+                  flush=True)
+            sst._device_uncacheable = True
+            return None
+        if dr is not None:
+            with self._lock:
+                self._device_cache_used += dr.nbytes()
+        return dr
+
+    def _release_device_run(self, sst):
+        if sst._device_run is not None:
+            with self._lock:
+                self._device_cache_used -= sst._device_run.nbytes()
+            sst._device_run = None
 
     def _bottommost(self, target_level: int) -> bool:
         """Tombstones may only drop when no lower level could hold the key."""
@@ -462,8 +500,13 @@ class LsmEngine:
                         bottommost: bool, now=None) -> dict:
         """Merge newer_files (recency order) over older_files into
         target_level, splitting output at target_file_size_bytes."""
-        input_blocks = ([s.block() for s in newer_files]
-                        + [s.block() for s in older_files])
+        inputs = list(newer_files) + list(older_files)
+        input_blocks = [s.block() for s in inputs]
+        device_runs = None
+        if self.opts.backend == "tpu":
+            # device-resident run cache: each SST packs+uploads once in its
+            # lifetime; this and every later compaction reads HBM directly
+            device_runs = [self._device_run_budgeted(s) for s in inputs]
         opts = CompactOptions(
             now=now,
             pidx=self.opts.pidx,
@@ -478,7 +521,7 @@ class LsmEngine:
         from ..runtime.perf_counters import counters
 
         t0 = time.perf_counter()
-        result = compact_blocks(input_blocks, opts)
+        result = compact_blocks(input_blocks, opts, device_runs=device_runs)
         counters.rate("engine.compaction_completed_count").increment()
         counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
         out_blocks = _split_block(result.block, self.opts.target_file_size_bytes)
@@ -489,7 +532,11 @@ class LsmEngine:
             write_sst(path, ob, {"level": target_level,
                                  "last_flushed_decree": self._durable_decree},
                       compression=self.opts.compression)
-            new_ssts.append(SSTable(path))
+            sst = SSTable(path)
+            sst._block = ob  # already in memory: skip the disk re-read
+            # compaction output stays device-resident for its NEXT merge
+            self._device_run_budgeted(sst)
+            new_ssts.append(sst)
         with self._lock:
             # swap the new files in and every input file out atomically —
             # inputs may come from L0 and any level (manual compact); readers
@@ -510,6 +557,9 @@ class LsmEngine:
             # keep the loaded block cached: a reader that snapshotted this
             # SSTable before we unlink must not re-read the dead path
             # (ADVICE r1 medium); the object drops with its last reference.
+            # Its device columns are released NOW: the budget must see the
+            # HBM back before the object's last reference dies.
+            self._release_device_run(s)
             try:
                 os.unlink(s.path)
             except OSError:

@@ -149,6 +149,8 @@ class SSTable:
         self.path = path
         self.header = read_header(path)
         self._block = None
+        self._device_run = None
+        self._device_uncacheable = False
         self._bloom = None
         if self.header.get("bloom"):
             self._bloom = np.frombuffer(
@@ -233,5 +235,22 @@ class SSTable:
                 hi = mid
         return lo
 
+    def device_run(self, prefix_u32: int):
+        """Lazily pack + upload this file's sort columns to the device and
+        PIN them for its lifetime (the engine's HBM-resident run cache,
+        SURVEY §5.7c): compactions this file joins read HBM instead of
+        re-packing and re-crossing PCIe every time. Returns None when the
+        run is uncacheable (keys beyond the prefix window need per-merge
+        suffix ranks)."""
+        if self._device_run is None and not self._device_uncacheable:
+            from ..ops.compact import pack_run_device
+
+            self._device_run = pack_run_device(self.block(), prefix_u32)
+            if self._device_run is None:
+                self._device_uncacheable = True
+        return self._device_run
+
     def release(self):
         self._block = None
+        self._device_run = None
+        self._device_uncacheable = False

@@ -1,0 +1,135 @@
+"""Engine-level compaction benchmark: LsmEngine.manual_compact, cpu vs tpu.
+
+The SYSTEM number (VERDICT-r2 item 4), distinct from bench.py's kernel
+number: wall-clock of a full manual compaction through the real engine —
+SST loads, the device-resident run cache (backend=tpu packs+uploads each
+file once, then merges read HBM), merge/dedup/filter, output-file split,
+manifest swap. Mirrors the reference's pegasus_manual_compact timing over
+a filled table (scripts/pegasus_manual_compact.sh flow).
+
+Usage:
+    python tools/engine_bench.py            # both lanes, default sizes
+    PEGASUS_EBENCH_N=2000000 PEGASUS_EBENCH_BACKENDS=tpu python tools/...
+
+Prints one JSON line per lane + a final comparison line.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_table(path: str, backend: str, n: int, value_size: int,
+                n_files: int):
+    """Fill a table: n records across n_files L0 SSTs with overlapping
+    hashkeys (dedup work exists), no auto-compaction."""
+    from bench import make_run, presort_run
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+    from pegasus_tpu.engine.sstable import SSTable, write_sst
+
+    opts = EngineOptions(backend=backend, l0_compaction_trigger=1 << 30,
+                         level_base_bytes=1 << 62)
+    eng = LsmEngine(path, opts)
+    per = n // n_files
+    for s in range(n_files):
+        blk = presort_run(make_run(per, value_size, seed=s,
+                                   key_space=max(1, n // 2)))
+        with eng._lock:
+            name = eng._alloc_file_locked()
+        write_sst(os.path.join(path, name), blk,
+                  {"level": 0, "last_flushed_decree": s + 1})
+        sst = SSTable(os.path.join(path, name))
+        sst._block = blk
+        if backend == "tpu":
+            sst.device_run(opts.prefix_u32)  # flush-time residency prime
+        with eng._lock:
+            eng._l0.insert(0, sst)
+            eng._write_manifest_locked()
+    return eng
+
+
+def run_lane(backend: str, root: str, n: int, value_size: int,
+             n_files: int, reps: int) -> dict:
+    path = os.path.join(root, backend)
+    shutil.rmtree(path, ignore_errors=True)
+    t0 = time.perf_counter()
+    eng = build_table(path, backend, n, value_size, n_files)
+    fill_s = time.perf_counter() - t0
+    best = float("inf")
+    stats = {}
+    for rep in range(reps):
+        if rep > 0:
+            # rebuild the L0 state so every rep compacts the same input
+            eng.close()
+            shutil.rmtree(path, ignore_errors=True)
+            eng = build_table(path, backend, n, value_size, n_files)
+        t0 = time.perf_counter()
+        stats = eng.manual_compact(now=100)
+        best = min(best, time.perf_counter() - t0)
+    digest = table_digest(eng)
+    eng.close()
+    return {"backend": backend, "fill_s": round(fill_s, 3),
+            "manual_compact_s": round(best, 3),
+            "records_per_s": int(stats.get("input_records", n) / best),
+            "stats": stats, "digest": digest}
+
+
+def table_digest(eng) -> str:
+    """Order-sensitive digest over every output record (byte-equality
+    check between lanes)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with eng._lock:
+        files = list(eng._l0) + [f for lv in sorted(eng._levels)
+                                 for f in eng._levels[lv]]
+    for sst in files:
+        b = sst.block()
+        h.update(b.key_arena.tobytes())
+        h.update(b.val_arena.tobytes())
+    return h.hexdigest()[:16]
+
+
+def main():
+    n = int(os.environ.get("PEGASUS_EBENCH_N", 2_000_000))
+    value_size = int(os.environ.get("PEGASUS_EBENCH_VALUE", 100))
+    n_files = int(os.environ.get("PEGASUS_EBENCH_FILES", 4))
+    reps = int(os.environ.get("PEGASUS_EBENCH_REPS", 2))
+    backends = os.environ.get("PEGASUS_EBENCH_BACKENDS", "cpu,tpu").split(",")
+    root = os.environ.get("PEGASUS_EBENCH_DIR", "/tmp/pegasus_engine_bench")
+    if "tpu" in backends:
+        import jax
+
+        from pegasus_tpu.base.utils import enable_compile_cache
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # the image re-asserts the axon platform over the env var; the
+            # config API wins over both (matches bench.py / tests/conftest)
+            jax.config.update("jax_platforms", "cpu")
+        enable_compile_cache(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    results = {}
+    for backend in backends:
+        results[backend] = run_lane(backend, root, n, value_size, n_files,
+                                    reps)
+        print(json.dumps(results[backend]), flush=True)
+    if "cpu" in results and "tpu" in results:
+        cmp = {
+            "metric": f"engine manual_compact speedup tpu vs cpu ({n} records)",
+            "value": round(results["cpu"]["manual_compact_s"]
+                           / results["tpu"]["manual_compact_s"], 3),
+            "unit": "x",
+            "byte_equal": results["cpu"]["digest"] == results["tpu"]["digest"],
+        }
+        print(json.dumps(cmp), flush=True)
+    shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
