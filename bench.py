@@ -116,8 +116,13 @@ def _enable_compile_cache():
 
 def make_run(n: int, value_size: int, seed: int, key_space: int) -> "KVBlock":
     """Vectorized fillrandom: n records, 16B hashkey + 8B sortkey, v2 values,
-    ~10% with TTL already expired, ~5% tombstones."""
+    ~10% with TTL already expired, ~5% tombstones (fractions overridable:
+    PEGASUS_BENCH_TTL_FRAC / PEGASUS_BENCH_DEL_FRAC — the TTL-expiring
+    compaction scenario of BASELINE.json is TTL_FRAC=0.5+)."""
     from pegasus_tpu.engine.block import KVBlock
+
+    ttl_frac = float(os.environ.get("PEGASUS_BENCH_TTL_FRAC", 0.10))
+    del_frac = float(os.environ.get("PEGASUS_BENCH_DEL_FRAC", 0.05))
 
     rng = np.random.default_rng(seed)
     klen = 2 + 16 + 8
@@ -138,14 +143,14 @@ def make_run(n: int, value_size: int, seed: int, key_space: int) -> "KVBlock":
     vals = rng.integers(0, 256, size=(n, vlen), dtype=np.uint8)
     vals[:, 0] = 0x82
     expire = np.zeros(n, np.uint32)
-    with_ttl = rng.random(n) < 0.10
+    with_ttl = rng.random(n) < ttl_frac
     expire[with_ttl] = rng.integers(1, 50, size=int(with_ttl.sum()), dtype=np.uint32)
     vals[:, 1] = (expire >> 24).astype(np.uint8)
     vals[:, 2] = (expire >> 16).astype(np.uint8)
     vals[:, 3] = (expire >> 8).astype(np.uint8)
     vals[:, 4] = expire.astype(np.uint8)
     vals[:, 5:13] = 0
-    deleted = rng.random(n) < 0.05
+    deleted = rng.random(n) < del_frac
 
     from pegasus_tpu.base.crc64 import crc64_batch
 

@@ -68,10 +68,14 @@ class PegasusClient:
 
     def __init__(self, resolver, pool: ConnectionPool = None,
                  timeout: float = 10.0, backup_request: bool = False):
+        import threading
+
         self.resolver = resolver
         self.pool = pool or ConnectionPool()
         self.timeout = timeout
         self.backup_request = backup_request
+        self._async_pool = None
+        self._async_lock = threading.Lock()
 
     # ------------------------------------------------------------ internals
 
@@ -294,7 +298,94 @@ class PegasusClient:
         n = self.resolver.partition_count
         return [Scanner(self, [p], b"", b"", 1000) for p in range(n)]
 
+    # -------------------------------------------------------------- async
+    # The reference API is half async_* callbacks over its rDSN task pool
+    # (client.h:283-320 + async_get/async_set/... declarations). The
+    # tpu-native redesign returns concurrent.futures.Future from a shared
+    # executor — awaitable/composable — and still accepts the reference's
+    # callback idiom: callback(error_code, result), error_code 0 on
+    # success, the PegasusError status otherwise. The RPC transport is
+    # pipelined + thread-safe, so concurrent futures share connections.
+
+    _MAX_ASYNC_WORKERS = 8
+
+    def _executor(self):
+        import concurrent.futures
+
+        if self._async_pool is None:
+            with self._async_lock:
+                if self._async_pool is None:
+                    self._async_pool = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=self._MAX_ASYNC_WORKERS,
+                        thread_name_prefix="pegasus-async")
+        return self._async_pool
+
+    def _submit(self, fn, callback, *args, **kwargs):
+        future = self._executor().submit(fn, *args, **kwargs)
+        if callback is not None:
+            def _done(f):
+                err = f.exception()
+                if err is None:
+                    callback(0, f.result())
+                elif isinstance(err, PegasusError):
+                    callback(err.status, None)
+                else:
+                    callback(-1, None)
+
+            future.add_done_callback(_done)
+        return future
+
+    def async_set(self, hash_key, sort_key, value, ttl_seconds=0,
+                  callback=None):
+        return self._submit(self.set, callback, hash_key, sort_key, value,
+                            ttl_seconds)
+
+    def async_get(self, hash_key, sort_key, callback=None):
+        return self._submit(self.get, callback, hash_key, sort_key)
+
+    def async_del(self, hash_key, sort_key, callback=None):
+        return self._submit(self.delete, callback, hash_key, sort_key)
+
+    def async_multi_set(self, hash_key, kvs, ttl_seconds=0, callback=None):
+        return self._submit(self.multi_set, callback, hash_key, kvs,
+                            ttl_seconds)
+
+    def async_multi_get(self, hash_key, sort_keys=None, max_kv_count=0,
+                        max_kv_size=0, callback=None):
+        return self._submit(self.multi_get, callback, hash_key, sort_keys,
+                            max_kv_count, max_kv_size)
+
+    def async_multi_del(self, hash_key, sort_keys, callback=None):
+        return self._submit(self.multi_del, callback, hash_key, sort_keys)
+
+    def async_incr(self, hash_key, sort_key, increment, ttl_seconds=0,
+                   callback=None):
+        return self._submit(self.incr, callback, hash_key, sort_key,
+                            increment, ttl_seconds)
+
+    def async_check_and_set(self, hash_key, check_sort_key, check_type,
+                            check_operand, set_sort_key, set_value,
+                            ttl_seconds=0, return_check_value=False,
+                            callback=None):
+        return self._submit(self.check_and_set, callback, hash_key,
+                            check_sort_key, check_type, check_operand,
+                            set_sort_key, set_value, ttl_seconds,
+                            return_check_value)
+
+    def async_check_and_mutate(self, hash_key, check_sort_key, check_type,
+                               check_operand, mutations,
+                               return_check_value=False, callback=None):
+        return self._submit(self.check_and_mutate, callback, hash_key,
+                            check_sort_key, check_type, check_operand,
+                            mutations, return_check_value)
+
+    def async_sortkey_count(self, hash_key, callback=None):
+        return self._submit(self.sortkey_count, callback, hash_key)
+
     def close(self):
+        if self._async_pool is not None:
+            self._async_pool.shutdown(wait=True)
+            self._async_pool = None
         self.pool.close()
 
 

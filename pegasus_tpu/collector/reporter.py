@@ -38,9 +38,16 @@ def falcon_payload(endpoint: str, snapshot: dict = None) -> str:
 
 
 class CounterReporter:
-    """HTTP exposer on (host, port); port 0 picks an ephemeral port."""
+    """HTTP exposer on (host, port); port 0 picks an ephemeral port.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Beyond /metrics and /counters, server roles mount extra routes
+    (version/info endpoints — the reference's rDSN http_service surface,
+    e.g. /version, /meta/cluster_info): `routes` maps an EXACT path to
+    `fn(full_path_with_query) -> JSON-serializable` (or raw bytes)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, routes=None):
+        routes = dict(routes or {})
+
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
                 if self.path.startswith("/metrics"):
@@ -50,9 +57,23 @@ class CounterReporter:
                     body = json.dumps(counters.snapshot(), indent=1).encode()
                     ctype = "application/json"
                 else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
+                    fn = routes.get(self.path.split("?")[0])
+                    if fn is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    try:
+                        out = fn(self.path)
+                    except Exception as e:  # surface, don't kill the server
+                        self.send_response(500)
+                        self.end_headers()
+                        self.wfile.write(repr(e).encode())
+                        return
+                    if isinstance(out, bytes):
+                        body, ctype = out, "application/octet-stream"
+                    else:
+                        body = json.dumps(out, indent=1).encode()
+                        ctype = "application/json"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

@@ -130,6 +130,18 @@ class KVBlock:
         return KVBlock(ka, ko, kl, va, vo, vl, expire,
                        (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32), deleted)
 
+    def lower_bound(self, key: bytes) -> int:
+        """First index with self.key(i) >= key (n if none); rows must be
+        key-sorted (SSTs and merge outputs are)."""
+        lo, hi = 0, self.n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.key(mid) < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
     def gather(self, idx) -> "KVBlock":
         """New block with rows idx (in that order); arenas compacted."""
         idx = np.asarray(idx, dtype=np.int64)

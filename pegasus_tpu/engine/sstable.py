@@ -225,15 +225,7 @@ class SSTable:
 
     def lower_bound(self, key: bytes) -> int:
         """First index with block.key(i) >= key (n if none)."""
-        b = self.block()
-        lo, hi = 0, b.n
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if b.key(mid) < key:
-                lo = mid + 1
-            else:
-                hi = mid
-        return lo
+        return self.block().lower_bound(key)
 
     def device_run(self, prefix_u32: int):
         """Lazily pack + upload this file's sort columns to the device and

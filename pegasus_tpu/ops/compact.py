@@ -58,6 +58,12 @@ class CompactOptions:
     runs_sorted: bool = None       # None = detect; True skips the host check
     user_ops: tuple = ()           # parsed engine.compaction_rules Operations
 
+    # device merges bigger than this split into disjoint key ranges that
+    # compact independently (the bigger-than-HBM blockwise path, SURVEY
+    # §5.7 long-context analogue). Sized so sort columns + aux + merge
+    # temporaries of one range fit comfortably in 16 GB HBM.
+    max_device_records: int = 128 << 20
+
     def resolved_now(self) -> int:
         return epoch_now() if self.now is None else self.now
 
@@ -575,6 +581,20 @@ def compact_blocks(blocks, opts: CompactOptions,
     runs = [b for b in blocks if b.n]
     if not runs:
         return CompactResult(KVBlock.empty(), _stats(0, 0))
+    # bigger-than-device merges: split the key space into disjoint ranges
+    # and compact each independently — dedup and every filter are per-key,
+    # so range outputs concatenate into exactly the whole-merge result
+    # (byte-equal; test-enforced). The reference handles the analogous
+    # "input exceeds memory" case by iterating RocksDB's merge cursor;
+    # a device kernel needs resident inputs, so capacity comes from
+    # range decomposition instead.
+    # (sorted runs only: the range cuts binary-search each run, so an
+    # unsorted input — bulk-load ingest sets — must take the normal path,
+    # whose pack step sorts runs locally before any device work)
+    total_in = sum(b.n for b in runs)
+    if (opts.backend != "cpu" and opts.runs_sorted
+            and total_in > opts.max_device_records):
+        return _compact_blockwise(runs, opts, total_in)
     # run priority travels in 8 bits of the packed (klen<<8 | prio) sort
     # column; wider merges pre-combine the newest runs (no filtering — only
     # the final merge may drop tombstones/expired) to stay within it
@@ -618,6 +638,55 @@ def compact_blocks(blocks, opts: CompactOptions,
     if opts.filter and opts.default_ttl > 0:
         _apply_default_ttl(out, now + opts.default_ttl)
     return CompactResult(out, _stats(n, out.n))
+
+
+def _slice_block(b: KVBlock, lo: int, hi: int) -> KVBlock:
+    """Zero-copy row slice: arenas shared, columns sliced (offsets remain
+    valid into the full arena; gather compacts later)."""
+    return KVBlock(b.key_arena, b.key_off[lo:hi], b.key_len[lo:hi],
+                   b.val_arena, b.val_off[lo:hi], b.val_len[lo:hi],
+                   b.expire_ts[lo:hi], b.hash32[lo:hi], b.deleted[lo:hi])
+
+
+def _compact_blockwise(runs, opts: CompactOptions,
+                       total_in: int) -> CompactResult:
+    """Range-decomposed compaction for merges too big for device memory:
+    boundary keys from the largest run's quantiles cut EVERY run into
+    aligned disjoint key ranges; each range merges/dedups/filters
+    independently on the device and outputs concatenate in key order."""
+    n_ranges = max(2, -(-total_in // opts.max_device_records))
+    pivot = max(runs, key=lambda b: b.n)
+    boundaries = []
+    for j in range(1, n_ranges):
+        k = pivot.key(min(pivot.n - 1, j * pivot.n // n_ranges))
+        if not boundaries or k > boundaries[-1]:
+            boundaries.append(k)
+    cuts = [[0] * len(runs)]
+    for k in boundaries:
+        cuts.append([b.lower_bound(k) for b in runs])
+    cuts.append([b.n for b in runs])
+    out_blocks = []
+    n_out = 0
+    for lo_cut, hi_cut in zip(cuts, cuts[1:]):
+        range_runs = [_slice_block(b, lo, hi)
+                      for b, lo, hi in zip(runs, lo_cut, hi_cut)]
+        range_total = sum(rb.n for rb in range_runs)
+        if range_total == 0:
+            continue
+        sub_opts = opts
+        if range_total >= total_in:
+            # degenerate key distribution (e.g. one repeated key): ranges
+            # cannot shrink — merge directly rather than recurse forever
+            from dataclasses import replace
+
+            sub_opts = replace(opts, max_device_records=range_total + 1)
+        res = compact_blocks(range_runs, sub_opts)
+        if res.block.n:
+            out_blocks.append(res.block)
+            n_out += res.block.n
+    out = (KVBlock.concat(out_blocks) if len(out_blocks) != 1
+           else out_blocks[0])
+    return CompactResult(out, _stats(total_in, n_out))
 
 
 def sort_block(block: KVBlock, opts: CompactOptions = None) -> KVBlock:

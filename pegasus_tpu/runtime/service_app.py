@@ -69,6 +69,75 @@ class ServiceAppContainer:
         threading.Event().wait()
 
 
+# ------------------------------------------------------ http info routes
+
+
+def _version_info(kind: str) -> dict:
+    import time as _time
+
+    from .remote_command import VERSION, _START_TIME
+
+    return {"version": VERSION, "server_type": kind,
+            "uptime_seconds": int(_time.time() - _START_TIME)}
+
+
+def _meta_http_routes(meta) -> dict:
+    """The meta's rDSN-http_service analogues: /version, /meta/cluster_info,
+    /meta/apps, /meta/app?name=<app>."""
+    from urllib.parse import parse_qs, urlparse
+
+    def cluster_info(path):
+        with meta._lock:
+            alive = meta._alive_nodes_locked()
+            return {"meta_server": "self", "app_count": len(meta._apps),
+                    "node_count": len(meta._nodes), "alive_nodes": alive}
+
+    def apps(path):
+        with meta._lock:
+            return [{"app_name": a.app_name, "app_id": a.app_id,
+                     "partition_count": a.partition_count,
+                     "replica_count": a.replica_count, "status": a.status}
+                    for a in meta._apps.values()]
+
+    def app(path):
+        q = parse_qs(urlparse(path).query)
+        name = (q.get("name") or [""])[0]
+        with meta._lock:
+            a = meta._apps.get(name)
+            if a is None:
+                return {"error": f"no app {name!r}"}
+            return {"app_name": a.app_name, "app_id": a.app_id,
+                    "partition_count": a.partition_count,
+                    "envs": a.envs_json,
+                    "partitions": [{
+                        "pidx": pc.pidx, "ballot": pc.ballot,
+                        "primary": pc.primary,
+                        "secondaries": list(pc.secondaries)}
+                        for pc in meta._parts[a.app_id]]}
+
+    return {"/version": lambda p: _version_info("meta"),
+            "/meta/cluster_info": cluster_info,
+            "/meta/apps": apps,
+            "/meta/app": app}
+
+
+def _replica_http_routes(stub) -> dict:
+    """/version + /replica/info on replica nodes."""
+
+    def info(path):
+        with stub._lock:
+            reps = list(stub._replicas.values())
+        return [{"app_name": r.app_name, "app_id": r.app_id, "pidx": r.pidx,
+                 "status": r.status, "ballot": r.ballot,
+                 "last_committed": r.last_committed,
+                 "last_prepared": r.last_prepared,
+                 "last_durable": r.server.engine.last_durable_decree()}
+                for r in reps]
+
+    return {"/version": lambda p: _version_info("replica"),
+            "/replica/info": info}
+
+
 # ---------------------------------------------------------- built-in apps
 
 
@@ -93,6 +162,18 @@ class MetaApp:
         self._fd_timer = None
         self._fd_interval = config.get_float("failure_detector",
                                              "check_interval_seconds", 5.0)
+        # version/info HTTP endpoints (reference rDSN http_service on meta:
+        # /version, /meta/cluster_info, /meta/app?name=...)
+        http_port = config.get_int(section, "http_port", -1)
+        self.reporter = None
+        if http_port >= 0:
+            from ..collector.reporter import CounterReporter
+
+            # started here, not in start(): BaseServer.shutdown() hangs
+            # forever unless serve_forever ran, so a start() that dies
+            # before reaching the reporter would make stop() deadlock
+            self.reporter = CounterReporter(
+                port=http_port, routes=_meta_http_routes(self.meta)).start()
 
     @property
     def address(self):
@@ -142,6 +223,8 @@ class MetaApp:
             self._fd_timer.cancel()
         if getattr(self, "_policy_timer", None):
             self._policy_timer.cancel()
+        if self.reporter:
+            self.reporter.stop()
         self.rpc.stop()
 
 
@@ -187,7 +270,9 @@ class ReplicaApp:
         if http_port >= 0:
             from ..collector.reporter import CounterReporter
 
-            self.reporter = CounterReporter(port=http_port).start()
+            self.reporter = CounterReporter(
+                port=http_port,
+                routes=_replica_http_routes(self.stub)).start()
 
     @property
     def address(self):

@@ -5,6 +5,7 @@ that survives a replica-node kill with automatic re-route — all over real
 sockets in one process (the reference's onebox, run.sh:480).
 """
 
+import threading
 import time
 
 import pytest
@@ -266,3 +267,120 @@ def test_backup_request_reads_from_secondary(tmp_path):
         cli.close()
     finally:
         c.stop()
+
+
+def test_async_client_api(cluster):
+    """The reference client API is half async_* (client.h:283-320); here
+    async_* returns a Future and also honors callback(error, result)."""
+    import concurrent.futures
+
+    c = make_client(cluster, app="async_t", partitions=4)
+    # futures fan-out
+    futs = [c.async_set(b"ak%d" % i, b"s", b"av%d" % i) for i in range(24)]
+    concurrent.futures.wait(futs, timeout=30)
+    assert all(f.exception() is None for f in futs)
+    gets = [c.async_get(b"ak%d" % i, b"s") for i in range(24)]
+    assert [g.result(timeout=10) for g in gets] == \
+        [b"av%d" % i for i in range(24)]
+    # callback idiom
+    done = threading.Event()
+    seen = {}
+
+    def cb(err, value):
+        seen["err"], seen["value"] = err, value
+        done.set()
+
+    c.async_get(b"ak3", b"s", callback=cb)
+    assert done.wait(10) and seen == {"err": 0, "value": b"av3"}
+    # multi ops + incr through the async surface
+    assert c.async_multi_set(b"arow", {b"a": b"1", b"b": b"2"}).result(10) is None
+    ok, kvs = c.async_multi_get(b"arow").result(10)
+    assert ok and kvs == {b"a": b"1", b"b": b"2"}
+    assert c.async_incr(b"acnt", b"c", 5).result(10) == 5
+    assert c.async_sortkey_count(b"arow").result(10) == 2
+    assert c.async_multi_del(b"arow", [b"a", b"b"]).result(10) == 2
+    # failure surfaces through the callback error code, not an exception
+    bad = {}
+    done2 = threading.Event()
+    c2 = PegasusClient(MetaResolver([cluster.meta_addr], "async_t"),
+                       timeout=1.0)
+    c2.async_incr(b"ak1", b"s", 1,
+                  callback=lambda e, v: (bad.update(err=e), done2.set()))
+    assert done2.wait(10) and bad["err"] != 0  # non-integer value
+    c2.close()
+    c.close()
+
+
+def test_http_info_endpoints(tmp_path):
+    """rDSN http_service analogues: /version + cluster/app/replica info
+    over the meta's and a replica's HTTP ports (SURVEY §2.4 'HTTP
+    service')."""
+    import json as _json
+    import urllib.request
+
+    from pegasus_tpu.runtime.config import Config
+    from pegasus_tpu.runtime.service_app import MetaApp, ReplicaApp
+
+    ini = tmp_path / "app.ini"
+    ini.write_text(f"""
+[apps.meta]
+type = meta
+port = 0
+state_dir = {tmp_path}/meta
+http_port = 0
+
+[apps.replica1]
+type = replica
+port = 0
+data_dir = {tmp_path}/replica1
+http_port = 0
+
+[pegasus.server]
+meta_servers = 127.0.0.1:0
+
+[failure_detector]
+beacon_interval_seconds = 0.2
+""")
+    cfg = Config(str(ini))
+    meta_app = MetaApp("meta", cfg, "apps.meta")
+    meta_app.start()
+    try:
+        # point the replica at the real (ephemeral) meta port
+        cfg._parser.set("pegasus.server", "meta_servers", meta_app.address)
+        rep_app = ReplicaApp("replica1", cfg, "apps.replica1").start()
+        try:
+            def fetch(reporter, path):
+                host, port = reporter.address
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=5) as r:
+                    return _json.loads(r.read())
+
+            v = fetch(meta_app.reporter, "/version")
+            assert v["server_type"] == "meta" and "pegasus-tpu" in v["version"]
+            # create a table so info endpoints have content
+            from pegasus_tpu.meta import messages as mm
+            from pegasus_tpu.meta.meta_server import RPC_CM_CREATE_APP
+            from pegasus_tpu.rpc import codec
+            from pegasus_tpu.rpc.transport import RpcConnection
+
+            host, _, port = meta_app.address.rpartition(":")
+            conn = RpcConnection((host, int(port)))
+            conn.call(RPC_CM_CREATE_APP,
+                      codec.encode(mm.CreateAppRequest("ht", 2, 1)),
+                      timeout=10)
+            conn.close()
+            info = fetch(meta_app.reporter, "/meta/cluster_info")
+            assert info["app_count"] == 1 and info["node_count"] == 1
+            apps = fetch(meta_app.reporter, "/meta/apps")
+            assert apps[0]["app_name"] == "ht"
+            app = fetch(meta_app.reporter, "/meta/app?name=ht")
+            assert len(app["partitions"]) == 2
+            assert all(pc["primary"] for pc in app["partitions"])
+            rv = fetch(rep_app.reporter, "/version")
+            assert rv["server_type"] == "replica"
+            rinfo = fetch(rep_app.reporter, "/replica/info")
+            assert len(rinfo) == 2 and rinfo[0]["app_name"] == "ht"
+        finally:
+            rep_app.stop()
+    finally:
+        meta_app.stop()

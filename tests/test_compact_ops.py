@@ -367,3 +367,44 @@ def test_device_cache_pipeline_shares_programs_across_sizes():
     info = _compiled_pipeline_cached.cache_info()
     assert info.misses == 1, f"recompiled per size: {info}"
     assert info.hits == 3, f"no reuse: {info}"
+
+
+def test_blockwise_merge_matches_whole_merge():
+    """SURVEY §5.7 long-context analogue: a merge bigger than the device
+    budget decomposes into disjoint key ranges whose outputs concatenate
+    byte-equal to the whole-merge result — the bigger-than-HBM path."""
+    from dataclasses import replace
+
+    from pegasus_tpu.ops.compact import (CompactOptions, compact_blocks,
+                                         sort_block)
+
+    rng = np.random.default_rng(41)
+    recs = []
+    for i in range(4000):
+        hk = b"u%06d" % rng.integers(0, 1500)
+        deleted = bool(rng.random() < 0.08)
+        expire = int(rng.integers(0, 3)) * 50
+        recs.append((hk, b"s%d" % (i % 5), b"" if deleted else b"w%d" % i,
+                     expire, deleted))
+    runs = [sort_block(make_block(part), CompactOptions(backend="cpu"))
+            for part in (recs[:1500], recs[1500:2600], recs[2600:])]
+    base = CompactOptions(backend="tpu", now=60, runs_sorted=True)
+    whole = compact_blocks(runs, base)
+    for budget in (500, 1000, 2500):
+        split = compact_blocks(runs, replace(base,
+                                             max_device_records=budget))
+        assert split.block.n == whole.block.n
+        np.testing.assert_array_equal(whole.block.key_arena,
+                                      split.block.key_arena)
+        np.testing.assert_array_equal(whole.block.val_arena,
+                                      split.block.val_arena)
+        np.testing.assert_array_equal(whole.block.expire_ts,
+                                      split.block.expire_ts)
+    # degenerate distribution: every record shares one key — must not
+    # recurse forever, and still dedups to a single survivor
+    one = sort_block(make_block([(b"k", b"s", b"v%d" % i, 0, False)
+                                 for i in range(50)]),
+                     CompactOptions(backend="cpu"))
+    same = [one, one]
+    res = compact_blocks(same, replace(base, max_device_records=10))
+    assert res.block.n == 1
