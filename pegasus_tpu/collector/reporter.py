@@ -64,16 +64,18 @@ class CounterReporter:
                         return
                     try:
                         out = fn(self.path)
+                        if isinstance(out, bytes):
+                            body, ctype = out, "application/octet-stream"
+                        else:
+                            # dumps inside the try: an unserializable route
+                            # result must 500, not drop the connection
+                            body = json.dumps(out, indent=1).encode()
+                            ctype = "application/json"
                     except Exception as e:  # surface, don't kill the server
                         self.send_response(500)
                         self.end_headers()
                         self.wfile.write(repr(e).encode())
                         return
-                    if isinstance(out, bytes):
-                        body, ctype = out, "application/octet-stream"
-                    else:
-                        body = json.dumps(out, indent=1).encode()
-                        ctype = "application/json"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

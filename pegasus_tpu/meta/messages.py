@@ -46,12 +46,26 @@ class CreateAppResponse:
 @dataclass
 class DropAppRequest:
     app_name: str = ""
+    reserve_seconds: int = 0          # >0: soft-drop, recallable this long
 
 
 @dataclass
 class DropAppResponse:
     error: int = 0
     error_text: str = ""
+
+
+@dataclass
+class RecallAppRequest:
+    app_id: int = 0
+    new_app_name: str = ""            # "" = original name
+
+
+@dataclass
+class RecallAppResponse:
+    error: int = 0
+    error_text: str = ""
+    app_name: str = ""
 
 
 @dataclass

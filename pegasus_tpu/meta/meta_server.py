@@ -41,6 +41,7 @@ RPC_CM_ADD_BACKUP_POLICY = "RPC_CM_ADD_BACKUP_POLICY"
 RPC_CM_LS_BACKUP_POLICY = "RPC_CM_QUERY_BACKUP_POLICY"
 RPC_CM_MODIFY_BACKUP_POLICY = "RPC_CM_MODIFY_BACKUP_POLICY"
 RPC_CM_RECOVER = "RPC_CM_START_RECOVERY"
+RPC_CM_RECALL_APP = "RPC_CM_RECALL_APP"
 RPC_CM_DDD_DIAGNOSE = "RPC_CM_DDD_DIAGNOSE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
@@ -66,6 +67,7 @@ class MetaServer:
         self._node_replicas = {} # addr -> ["app_id.pidx"] from the last beacon
         self._dups = {}          # app_id -> list[dict] duplication entries
         self._policies = {}      # name -> dict (BackupPolicyInfo fields)
+        self._dropped = {}       # app_id -> {"app","parts","expire_ts"}
         self._next_app_id = 1
         self._next_dupid = 1
         self.pool = ConnectionPool()
@@ -94,6 +96,7 @@ class MetaServer:
             RPC_CM_LS_BACKUP_POLICY: self._on_ls_backup_policy,
             RPC_CM_MODIFY_BACKUP_POLICY: self._on_modify_backup_policy,
             RPC_CM_RECOVER: self._on_recover,
+            RPC_CM_RECALL_APP: self._on_recall_app,
             RPC_CM_DDD_DIAGNOSE: self._on_ddd_diagnose,
             RPC_FD_BEACON: self._on_beacon,
         }
@@ -136,6 +139,10 @@ class MetaServer:
         return codec.encode(mm.CreateAppResponse(app_id=app.app_id))
 
     def _on_drop_app(self, header, body) -> bytes:
+        """drop [-r reserve_seconds]: reserve_seconds > 0 soft-drops — the
+        app disappears from routing/DDL but its replicas' data stays on
+        disk and recall_app can restore it until the hold expires
+        (reference drop/recall with hold_seconds_for_dropped_app)."""
         req = codec.decode(mm.DropAppRequest, body)
         with self._lock:
             app = self._apps.pop(req.app_name, None)
@@ -143,6 +150,11 @@ class MetaServer:
                 return codec.encode(mm.DropAppResponse(
                     error=1, error_text="no such app"))
             parts = self._parts.pop(app.app_id, [])
+            if req.reserve_seconds > 0:
+                app.status = "AS_DROPPED"
+                self._dropped[app.app_id] = {
+                    "app": vars(app), "parts": [vars(pc) for pc in parts],
+                    "expire_ts": int(time.time()) + req.reserve_seconds}
             self._persist_locked()
         for pc in parts:
             for node in [pc.primary] + pc.secondaries:
@@ -150,6 +162,47 @@ class MetaServer:
                                    mm.CloseReplicaRequest(app.app_id, pc.pidx),
                                    ignore_errors=True)
         return codec.encode(mm.DropAppResponse())
+
+    def _on_recall_app(self, header, body) -> bytes:
+        """recall <app_id> [new_name]: restore a soft-dropped app; replicas
+        reopen from their preserved on-disk state."""
+        req = codec.decode(mm.RecallAppRequest, body)
+        with self._lock:
+            ent = self._dropped.get(req.app_id)
+            if ent is None:
+                return codec.encode(mm.RecallAppResponse(
+                    error=1, error_text=f"no dropped app with id "
+                                        f"{req.app_id} [or hold expired]"))
+            name = req.new_app_name or ent["app"]["app_name"]
+            if name in self._apps:
+                return codec.encode(mm.RecallAppResponse(
+                    error=1, error_text=f"app {name} already exists"))
+            del self._dropped[req.app_id]
+            app = mm.AppInfo(**ent["app"])
+            app.app_name = name
+            app.status = "AS_AVAILABLE"
+            parts = [mm.PartitionConfig(**pc) for pc in ent["parts"]]
+            for pc in parts:
+                pc.ballot += 1
+            self._apps[name] = app
+            self._parts[app.app_id] = parts
+            self._persist_locked()
+        for pc in parts:
+            self._install_partition(app, pc)
+        return codec.encode(mm.RecallAppResponse(app_name=name))
+
+    def purge_expired_dropped(self, now: int = None) -> list:
+        """Forget soft-dropped apps past their hold (timer tick); their
+        data dirs on replica nodes become garbage for operator GC."""
+        now = int(time.time()) if now is None else now
+        with self._lock:
+            gone = [aid for aid, e in self._dropped.items()
+                    if e["expire_ts"] <= now]
+            for aid in gone:
+                del self._dropped[aid]
+            if gone:
+                self._persist_locked()
+        return gone
 
     def _on_list_apps(self, header, body) -> bytes:
         with self._lock:
@@ -1007,6 +1060,7 @@ class MetaServer:
             "nodes": list(self._nodes),
             "dups": {str(aid): entries for aid, entries in self._dups.items()},
             "policies": self._policies,
+            "dropped": {str(aid): e for aid, e in self._dropped.items()},
         }
         tmp = self.state_path + ".tmp"
         os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
@@ -1027,5 +1081,7 @@ class MetaServer:
         self._dups = {int(aid): entries
                       for aid, entries in state.get("dups", {}).items()}
         self._policies = state.get("policies", {})
+        self._dropped = {int(aid): e
+                         for aid, e in state.get("dropped", {}).items()}
         # nodes must re-beacon after a meta restart
         self._nodes = {}

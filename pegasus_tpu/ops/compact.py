@@ -665,11 +665,19 @@ def _compact_blockwise(runs, opts: CompactOptions,
     for k in boundaries:
         cuts.append([b.lower_bound(k) for b in runs])
     cuts.append([b.n for b in runs])
+    # long keys trigger pack_runs' suffix-rank path, which CONCATENATES its
+    # inputs — zero-copy slices would drag the full shared arenas into
+    # every range (n_ranges x total memory, on exactly the bounded-memory
+    # path). Compact such slices down to their own rows first.
+    long_keys = max(int(b.key_len.max()) for b in runs) > 4 * opts.prefix_u32
     out_blocks = []
     n_out = 0
     for lo_cut, hi_cut in zip(cuts, cuts[1:]):
         range_runs = [_slice_block(b, lo, hi)
                       for b, lo, hi in zip(runs, lo_cut, hi_cut)]
+        if long_keys:
+            range_runs = [rb.gather(np.arange(rb.n, dtype=np.int64))
+                          for rb in range_runs]
         range_total = sum(rb.n for rb in range_runs)
         if range_total == 0:
             continue

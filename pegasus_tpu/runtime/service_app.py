@@ -203,6 +203,7 @@ class MetaApp:
             try:
                 self.meta.run_backup_policies()
                 self.meta.push_dup_envs()
+                self.meta.purge_expired_dropped()
             except Exception as e:  # policy failure must not kill the timer
                 print(f"[meta] maintenance tick failed: {e!r}", flush=True)
             if self._stopped:

@@ -39,7 +39,10 @@ class Shell:
             "ls": (self.cmd_ls, "list tables"),
             "app": (self.cmd_app, "app <name> — show partition table"),
             "create": (self.cmd_create, "create <name> [-p N] [-r N]"),
-            "drop": (self.cmd_drop, "drop <name>"),
+            "drop": (self.cmd_drop,
+                     "drop <name> [-r seconds] — -r keeps it recallable"),
+            "recall": (self.cmd_recall,
+                       "recall <app_id> [new_name] — restore a soft-dropped app"),
             "use": (self.cmd_use, "use <name> — select table for data ops"),
             "nodes": (self.cmd_nodes, "list replica nodes"),
             "set": (self.cmd_set, "set <hk> <sk> <value> [ttl]"),
@@ -206,10 +209,29 @@ class Shell:
                else f"create app {ns.name} succeed, id={r.app_id}")
 
     def cmd_drop(self, args):
-        r = self._meta_call(RPC_CM_DROP_APP, mm.DropAppRequest(args[0]),
+        ap = argparse.ArgumentParser(prog="drop", add_help=False)
+        ap.add_argument("name")
+        ap.add_argument("-r", "--reserve_seconds", type=int, default=0)
+        try:
+            ns = ap.parse_args(args)
+        except SystemExit:
+            raise ValueError(args)
+        r = self._meta_call(RPC_CM_DROP_APP,
+                            mm.DropAppRequest(ns.name, ns.reserve_seconds),
                             mm.DropAppResponse)
-        self._clients.pop(args[0], None)
-        self.p(f"ERROR: {r.error_text}" if r.error else f"drop app {args[0]} succeed")
+        self._clients.pop(ns.name, None)
+        self.p(f"ERROR: {r.error_text}" if r.error
+               else f"drop app {ns.name} succeed")
+
+    def cmd_recall(self, args):
+        from ..meta.meta_server import RPC_CM_RECALL_APP
+
+        new_name = args[1] if len(args) > 1 else ""
+        r = self._meta_call(RPC_CM_RECALL_APP,
+                            mm.RecallAppRequest(int(args[0]), new_name),
+                            mm.RecallAppResponse)
+        self.p(f"recall app {args[0]} failed, error={r.error_text}" if r.error
+               else f"recall app {args[0]} succeed, name={r.app_name}")
 
     def cmd_use(self, args):
         self.current_app = args[0]

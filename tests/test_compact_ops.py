@@ -408,3 +408,28 @@ def test_blockwise_merge_matches_whole_merge():
     same = [one, one]
     res = compact_blocks(same, replace(base, max_device_records=10))
     assert res.block.n == 1
+
+
+def test_blockwise_merge_long_keys_rank_path():
+    """Blockwise decomposition with keys beyond the prefix window (the
+    suffix-rank pack path) must stay byte-equal — and compacts its range
+    slices so the rank concat doesn't drag whole arenas per range."""
+    from dataclasses import replace
+
+    from pegasus_tpu.ops.compact import (CompactOptions, compact_blocks,
+                                         sort_block)
+
+    rng = np.random.default_rng(43)
+    recs = []
+    for i in range(1200):
+        # 60+B hashkeys: longer than 4*prefix_u32(8)=32 bytes
+        hk = b"verylonghashkeyprefix-%038d" % rng.integers(0, 400)
+        recs.append((hk, b"s%d" % (i % 3), b"v%d" % i, 0, False))
+    runs = [sort_block(make_block(part), CompactOptions(backend="cpu"))
+            for part in (recs[:600], recs[600:])]
+    base = CompactOptions(backend="tpu", now=60, runs_sorted=True)
+    whole = compact_blocks(runs, base)
+    split = compact_blocks(runs, replace(base, max_device_records=400))
+    assert split.block.n == whole.block.n
+    np.testing.assert_array_equal(whole.block.key_arena, split.block.key_arena)
+    np.testing.assert_array_equal(whole.block.val_arena, split.block.val_arena)

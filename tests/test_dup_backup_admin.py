@@ -266,3 +266,48 @@ def test_ddd_diagnose_finds_and_fixes(tmp_path):
         cl.close()
     finally:
         c.stop()
+
+
+def test_drop_with_reserve_and_recall(tmp_path):
+    """Reference drop -r + recall_app (table_management.cpp:680-736): a
+    soft-dropped table disappears from routing but its data survives on
+    disk; recall restores it (optionally renamed) until the hold expires."""
+    c = Cluster(tmp_path / "c")
+    try:
+        cl = make_client(c, app="dr", partitions=2)
+        app_id = cl.resolver.app_id
+        for i in range(15):
+            cl.set(b"drk%d" % i, b"s", b"v%d" % i)
+        cl.close()
+        assert "succeed" in shell_run(c, "drop dr -r 3600")
+        # invisible to routing/DDL
+        from pegasus_tpu.rpc.transport import RpcError
+
+        with pytest.raises(RpcError):
+            MetaResolver([c.meta_addr], "dr").app_id  # noqa: B018
+        assert "dr" not in c.meta._apps and app_id in c.meta._dropped
+        # name free for reuse while dropped; recall under a NEW name then
+        out = shell_run(c, f"recall {app_id} dr2")
+        assert "succeed" in out
+        cr = PegasusClient(MetaResolver([c.meta_addr], "dr2"))
+        for i in range(15):
+            assert cr.get(b"drk%d" % i, b"s") == b"v%d" % i
+        cr.close()
+        # recall again fails (already recalled)
+        assert "failed" in shell_run(c, f"recall {app_id}")
+        # hold expiry purges recallability
+        cl2 = make_client(c, app="dr3", partitions=1)
+        cl2.set(b"x", b"s", b"y")
+        cl2.close()
+        aid3 = c.meta._apps["dr3"].app_id
+        shell_run(c, "drop dr3 -r 5")
+        assert c.meta.purge_expired_dropped(now=2**31) == [aid3]
+        assert "failed" in shell_run(c, f"recall {aid3}")
+        # plain drop stays immediate (no recall possible)
+        cl3 = make_client(c, app="dr4", partitions=1)
+        cl3.close()
+        aid4 = c.meta._apps["dr4"].app_id
+        shell_run(c, "drop dr4")
+        assert aid4 not in c.meta._dropped
+    finally:
+        c.stop()
