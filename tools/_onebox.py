@@ -1,0 +1,36 @@
+"""Shared self-booting onebox for the tools/ benchmark harnesses."""
+
+import os
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class Onebox:
+    """In-process 1-meta/3-replica cluster with one table, cleaned up on
+    stop(); `meta_addr` is the routing entry point."""
+
+    def __init__(self, table: str, partitions: int = 8, n_nodes: int = 3):
+        from tests.test_satellites import MiniCluster
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="pegasus_tool_")
+        self.cluster = MiniCluster(pathlib.Path(self._tmp.name),
+                                   n_nodes=n_nodes)
+        self.cluster.create(table, partitions=partitions).close()
+        self.meta_addr = self.cluster.meta_addr
+
+    def stop(self):
+        self.cluster.stop()
+        self._tmp.cleanup()
+
+
+def resolve_cluster(meta: str, table: str, partitions: int = 8):
+    """-> (meta_addr, onebox_or_None): boot an onebox when no --meta given."""
+    if meta:
+        return meta, None
+    box = Onebox(table, partitions=partitions)
+    return box.meta_addr, box

@@ -44,18 +44,9 @@ def main():
 
     from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
 
-    cluster = None
-    meta_addr = args.meta
-    if not meta_addr:
-        import pathlib
-        import tempfile
+    from tools._onebox import resolve_cluster
 
-        from tests.test_satellites import MiniCluster
-
-        tmp = tempfile.TemporaryDirectory()
-        cluster = MiniCluster(pathlib.Path(tmp.name), n_nodes=3)
-        cluster.create(args.table, partitions=8).close()
-        meta_addr = cluster.meta_addr
+    meta_addr, cluster = resolve_cluster(args.meta, args.table, 8)
 
     per_thread_qps = args.qps / args.threads
     stop_at = time.time() + args.seconds

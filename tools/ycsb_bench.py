@@ -38,27 +38,9 @@ def main():
 
     from pegasus_tpu.client import MetaResolver, PegasusClient
 
-    cluster = None
-    if ns.meta:
-        meta_addr = ns.meta
-    else:
-        import tempfile
+    from tools._onebox import resolve_cluster
 
-        from tests.test_satellites import MiniCluster
-
-        class _P:  # tmp_path-like
-            def __init__(self, d):
-                self.d = d
-
-            def __truediv__(self, other):
-                return _P(os.path.join(self.d, str(other)))
-
-            def __str__(self):
-                return self.d
-
-        cluster = MiniCluster(_P(tempfile.mkdtemp(prefix="ycsb_")), n_nodes=3)
-        meta_addr = cluster.meta_addr
-        cluster.create("ycsb", partitions=ns.partitions).close()
+    meta_addr, cluster = resolve_cluster(ns.meta, "ycsb", ns.partitions)
 
     value = os.urandom(ns.value_size)
     load_cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
