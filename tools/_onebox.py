@@ -12,7 +12,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 class Onebox:
     """In-process 1-meta/3-replica cluster with one table, cleaned up on
-    stop(); `meta_addr` is the routing entry point."""
+    stop() (or `with Onebox(...) as box:`); `meta_addr` is the routing
+    entry point."""
 
     def __init__(self, table: str, partitions: int = 8, n_nodes: int = 3):
         from tests.test_satellites import MiniCluster
@@ -22,6 +23,13 @@ class Onebox:
                                    n_nodes=n_nodes)
         self.cluster.create(table, partitions=partitions).close()
         self.meta_addr = self.cluster.meta_addr
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
 
     def stop(self):
         self.cluster.stop()

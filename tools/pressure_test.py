@@ -47,87 +47,90 @@ def main():
     from tools._onebox import resolve_cluster
 
     meta_addr, cluster = resolve_cluster(args.meta, args.table, 8)
+    try:
 
-    per_thread_qps = args.qps / args.threads
-    stop_at = time.time() + args.seconds
-    stats_lock = threading.Lock()
-    stats = {"reads": 0, "writes": 0, "errors": 0, "verify_failures": 0,
-             "not_found": 0}
-    lat_ms = []
-    written = set()
-    written_lock = threading.Lock()
+        per_thread_qps = args.qps / args.threads
+        stop_at = time.time() + args.seconds
+        stats_lock = threading.Lock()
+        stats = {"reads": 0, "writes": 0, "errors": 0, "verify_failures": 0,
+                 "not_found": 0}
+        lat_ms = []
+        written = set()
+        written_lock = threading.Lock()
 
-    def worker(tid):
-        rng = random.Random(tid)
-        cli = PegasusClient(MetaResolver([meta_addr], args.table), timeout=10)
-        interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
-        next_fire = time.time()
-        local = {k: 0 for k in stats}
-        local_lat = []
-        while time.time() < stop_at:
-            now = time.time()
-            if interval and now < next_fire:
-                time.sleep(min(interval, next_fire - now))
-                continue
-            next_fire += interval
-            i = rng.randrange(args.key_space)
-            hk = b"pres%07d" % i
-            t0 = time.perf_counter()
-            try:
-                if rng.randrange(100) < args.read_pct:
-                    # snapshot BEFORE the read: a write completing between
-                    # the get and a later check would fake a lost write
-                    with written_lock:
-                        was_written = i in written
-                    v = cli.get(hk, b"s")
-                    local["reads"] += 1
-                    if v is None:
-                        if was_written:
+        def worker(tid):
+            rng = random.Random(tid)
+            cli = PegasusClient(MetaResolver([meta_addr], args.table), timeout=10)
+            interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
+            next_fire = time.time()
+            local = {k: 0 for k in stats}
+            local_lat = []
+            while time.time() < stop_at:
+                now = time.time()
+                if interval and now < next_fire:
+                    time.sleep(min(interval, next_fire - now))
+                    continue
+                next_fire += interval
+                i = rng.randrange(args.key_space)
+                hk = b"pres%07d" % i
+                t0 = time.perf_counter()
+                try:
+                    if rng.randrange(100) < args.read_pct:
+                        # snapshot BEFORE the read: a write completing between
+                        # the get and a later check would fake a lost write
+                        with written_lock:
+                            was_written = i in written
+                        v = cli.get(hk, b"s")
+                        local["reads"] += 1
+                        if v is None:
+                            if was_written:
+                                local["verify_failures"] += 1
+                            else:
+                                local["not_found"] += 1
+                        elif v != expected_value(hk):
                             local["verify_failures"] += 1
-                        else:
-                            local["not_found"] += 1
-                    elif v != expected_value(hk):
-                        local["verify_failures"] += 1
-                else:
-                    cli.set(hk, b"s", expected_value(hk))
-                    with written_lock:
-                        written.add(i)
-                    local["writes"] += 1
-            except PegasusError:
-                local["errors"] += 1
-            local_lat.append((time.perf_counter() - t0) * 1000)
-        cli.close()
-        with stats_lock:
-            for k, v in local.items():
-                stats[k] += v
-            lat_ms.extend(local_lat)
+                    else:
+                        cli.set(hk, b"s", expected_value(hk))
+                        with written_lock:
+                            written.add(i)
+                        local["writes"] += 1
+                except PegasusError:
+                    local["errors"] += 1
+                local_lat.append((time.perf_counter() - t0) * 1000)
+            cli.close()
+            with stats_lock:
+                for k, v in local.items():
+                    stats[k] += v
+                lat_ms.extend(local_lat)
 
-    t_start = time.time()
-    threads = [threading.Thread(target=worker, args=(t,))
-               for t in range(args.threads)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    elapsed = time.time() - t_start
-    lat_ms.sort()
-    total_ops = stats["reads"] + stats["writes"]
+        t_start = time.time()
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(args.threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - t_start
+        lat_ms.sort()
+        total_ops = stats["reads"] + stats["writes"]
 
-    def pct(p):
-        return round(lat_ms[min(len(lat_ms) - 1,
-                                int(len(lat_ms) * p))], 2) if lat_ms else 0
+        def pct(p):
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(len(lat_ms) * p))], 2) if lat_ms else 0
 
-    print(json.dumps({
-        "metric": f"pressure test achieved qps (target {args.qps}, "
-                  f"{args.read_pct}% reads, {args.threads} threads)",
-        "value": round(total_ops / elapsed, 1),
-        "unit": "ops/s",
-        "detail": {**stats, "elapsed_s": round(elapsed, 1),
-                   "avg_ms": round(sum(lat_ms) / max(1, len(lat_ms)), 2),
-                   "p95_ms": pct(0.95), "p99_ms": pct(0.99)},
-    }), flush=True)
-    if cluster is not None:
-        cluster.stop()
+        print(json.dumps({
+            "metric": f"pressure test achieved qps (target {args.qps}, "
+                      f"{args.read_pct}% reads, {args.threads} threads)",
+            "value": round(total_ops / elapsed, 1),
+            "unit": "ops/s",
+            "detail": {**stats, "elapsed_s": round(elapsed, 1),
+                       "avg_ms": round(sum(lat_ms) / max(1, len(lat_ms)), 2),
+                       "p95_ms": pct(0.95), "p99_ms": pct(0.99)},
+        }), flush=True)
+
+    finally:
+        if cluster is not None:
+            cluster.stop()
     sys.exit(1 if stats["verify_failures"] or stats["errors"] else 0)
 
 

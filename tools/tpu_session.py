@@ -1,0 +1,157 @@
+"""One-shot real-TPU validation + benchmark session.
+
+Run when the axon tunnel is alive (it wedges for hours when poked while
+dead, so this probes first, in a bounded subprocess). Stages, each gated
+on the previous and individually time-bounded:
+
+  1. probe     — backend init + tiny matmul in a subprocess
+  2. kernels   — small-N byte-equality: cpu vs tpu (network path), cached
+                 device-run path, and PEGASUS_PALLAS=1 merge-path kernel
+  3. bench     — bench.py at PEGASUS_BENCH_N (default 10M), both with and
+                 without pallas, recording both JSON lines
+  4. engine    — tools/engine_bench.py at PEGASUS_EBENCH_N (default 2M)
+
+Every stage's JSON/result lines append to TPU_SESSION.log next to this
+repo so a dropped tunnel mid-way still leaves the completed stages
+recorded. Nothing here SIGKILLs a TPU-attached process: stage timeouts
+use SIGTERM and generous budgets.
+
+Usage: python tools/tpu_session.py [--stages probe,kernels,bench,engine]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_SESSION.log")
+
+
+def log(line: str):
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(f"[{stamp}] {line}\n")
+    print(f"[{stamp}] {line}", flush=True)
+
+
+def run(cmd, timeout_s, env_extra=None, label=""):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    log(f"RUN {label or cmd}: timeout {timeout_s}s env {env_extra}")
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # NEVER SIGKILL a TPU-attached process (it wedges the tunnel's
+        # device lease for hours): SIGTERM, grace-wait, and if it still
+        # won't die, ABANDON it and move on
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+            log(f"TIMEOUT {label} (terminated cleanly)")
+        except subprocess.TimeoutExpired:
+            log(f"TIMEOUT {label} — child ignored SIGTERM; ABANDONED "
+                f"(pid {proc.pid}), not killing a TPU-attached process")
+        return None
+    for line in (stdout or "").strip().splitlines()[-6:]:
+        log(f"  out: {line}")
+    if proc.returncode != 0:
+        for line in (stderr or "").strip().splitlines()[-4:]:
+            log(f"  err: {line}")
+        log(f"FAIL {label} rc={proc.returncode}")
+        return None
+    return stdout
+
+
+def stage_probe() -> bool:
+    out = run([sys.executable, "-c",
+               "import jax, jax.numpy as jnp;"
+               "print('PLATFORM:', jax.devices()[0]);"
+               "print('SUM:', int(jnp.arange(64).sum()))"],
+              timeout_s=180, label="probe")
+    ok = out is not None and "SUM: 2016" in out
+    log(f"probe: {'ALIVE' if ok else 'DEAD'}")
+    return ok
+
+
+def stage_kernels() -> tuple:
+    code = (
+        "import numpy as np\n"
+        "from pegasus_tpu.base.utils import enable_compile_cache\n"
+        "enable_compile_cache(%r)\n"
+        "import tests.test_compact_ops as t\n"
+        "from pegasus_tpu.ops.compact import (CompactOptions, compact_blocks,"
+        " pack_run_device, sort_block)\n"
+        "rng = np.random.default_rng(5)\n"
+        "recs = [(b'u%%05d' %% rng.integers(0, 300), b's%%d' %% (i %% 5),"
+        " b'v%%d' %% i, 0, bool(rng.random() < .1)) for i in range(3000)]\n"
+        "runs = [sort_block(t.make_block(p), CompactOptions(backend='cpu'))"
+        " for p in (recs[:1500], recs[1500:])]\n"
+        "o = dict(now=100, bottommost=True, runs_sorted=True)\n"
+        "cpu = compact_blocks(runs, CompactOptions(backend='cpu', **o))\n"
+        "tpu = compact_blocks(runs, CompactOptions(backend='tpu', **o))\n"
+        "drs = [pack_run_device(b) for b in runs]\n"
+        "cch = compact_blocks(runs, CompactOptions(backend='tpu', **o),"
+        " device_runs=drs)\n"
+        "for x in (tpu, cch):\n"
+        "    assert np.array_equal(cpu.block.key_arena, x.block.key_arena)\n"
+        "    assert np.array_equal(cpu.block.val_arena, x.block.val_arena)\n"
+        "print('KERNELS_BYTE_EQUAL')\n" % REPO)
+    ok1 = run([sys.executable, "-c", code], timeout_s=900,
+              label="kernels:xla+cached") is not None
+    ok2 = run([sys.executable, "-c", code], timeout_s=900,
+              env_extra={"PEGASUS_PALLAS": "1"},
+              label="kernels:pallas") is not None
+    log(f"kernels: xla/cached {'OK' if ok1 else 'FAIL'}, "
+        f"pallas {'OK' if ok2 else 'FAIL'}")
+    if ok1 and not ok2:
+        log("pallas FAILED on hardware — keep PEGASUS_PALLAS default off")
+    return ok1, ok2
+
+
+def stage_bench(pallas_ok: bool):
+    n = os.environ.get("PEGASUS_BENCH_N", "10000000")
+    run([sys.executable, "bench.py"], timeout_s=3000,
+        env_extra={"PEGASUS_BENCH_N": n}, label=f"bench N={n}")
+    if pallas_ok:
+        run([sys.executable, "bench.py"], timeout_s=3000,
+            env_extra={"PEGASUS_BENCH_N": n, "PEGASUS_PALLAS": "1"},
+            label=f"bench N={n} pallas")
+
+
+def stage_engine():
+    n = os.environ.get("PEGASUS_EBENCH_N", "2000000")
+    run([sys.executable, "tools/engine_bench.py"], timeout_s=3000,
+        env_extra={"PEGASUS_EBENCH_N": n}, label=f"engine_bench N={n}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default="probe,kernels,bench,engine")
+    args = ap.parse_args()
+    stages = args.stages.split(",")
+    log(f"=== tpu_session start (stages: {stages}) ===")
+    if "probe" in stages and not stage_probe():
+        log("=== aborted: tunnel dead ===")
+        sys.exit(3)
+    # pallas only ever benches AFTER the kernels stage validated it on this
+    # hardware — skipping the kernels stage keeps it off
+    pallas_ok = False
+    if "kernels" in stages:
+        code_ok, pallas_ok = stage_kernels()
+        if not code_ok:
+            log("=== aborted: kernel validation failed ===")
+            sys.exit(4)
+    if "bench" in stages:
+        stage_bench(pallas_ok)
+    if "engine" in stages:
+        stage_engine()
+    log("=== tpu_session done ===")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,66 +41,69 @@ def main():
     from tools._onebox import resolve_cluster
 
     meta_addr, cluster = resolve_cluster(ns.meta, "ycsb", ns.partitions)
+    try:
 
-    value = os.urandom(ns.value_size)
-    load_cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
-    t0 = time.perf_counter()
-    for i in range(ns.records):
-        load_cli.set(b"user%012d" % i, b"f0", value)
-    load_s = time.perf_counter() - t0
-    load_cli.close()
+        value = os.urandom(ns.value_size)
+        load_cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
+        t0 = time.perf_counter()
+        for i in range(ns.records):
+            load_cli.set(b"user%012d" % i, b"f0", value)
+        load_s = time.perf_counter() - t0
+        load_cli.close()
 
-    lat_us = []
-    lat_lock = threading.Lock()
-    errors = [0]
+        lat_us = []
+        lat_lock = threading.Lock()
+        errors = [0]
 
-    def worker(tid):
-        rng = random.Random(tid)
-        cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
-        local = []
-        for _ in range(ns.ops // ns.threads):
-            k = b"user%012d" % (zipf_key(rng, ns.records) % ns.records)
-            s = time.perf_counter()
-            try:
-                if rng.random() < 0.5:
-                    cli.get(k, b"f0")
-                else:
-                    cli.set(k, b"f0", value)
-            except Exception:
-                errors[0] += 1
-            local.append((time.perf_counter() - s) * 1e6)
-        with lat_lock:
-            lat_us.extend(local)
-        cli.close()
+        def worker(tid):
+            rng = random.Random(tid)
+            cli = PegasusClient(MetaResolver([meta_addr], "ycsb"))
+            local = []
+            for _ in range(ns.ops // ns.threads):
+                k = b"user%012d" % (zipf_key(rng, ns.records) % ns.records)
+                s = time.perf_counter()
+                try:
+                    if rng.random() < 0.5:
+                        cli.get(k, b"f0")
+                    else:
+                        cli.set(k, b"f0", value)
+                except Exception:
+                    errors[0] += 1
+                local.append((time.perf_counter() - s) * 1e6)
+            with lat_lock:
+                lat_us.extend(local)
+            cli.close()
 
-    threads = [threading.Thread(target=worker, args=(t,))
-               for t in range(ns.threads)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    run_s = time.perf_counter() - t0
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(ns.threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        run_s = time.perf_counter() - t0
 
-    lat_us.sort()
-    n = len(lat_us)
-    result = {
-        "metric": f"YCSB-A 50/50 read-update, {ns.partitions} partitions, "
-                  f"{ns.threads} threads, {ns.records} records",
-        "value": round(n / run_s, 1),
-        "unit": "ops/s",
-        "detail": {
-            "load_s": round(load_s, 2),
-            "load_ops_s": round(ns.records / load_s, 1),
-            "run_s": round(run_s, 2),
-            "avg_us": round(sum(lat_us) / n, 1),
-            "p99_us": round(lat_us[int(n * 0.99)], 1),
-            "errors": errors[0],
-        },
-    }
-    print(json.dumps(result))
-    if cluster is not None:
-        cluster.stop()
+        lat_us.sort()
+        n = len(lat_us)
+        result = {
+            "metric": f"YCSB-A 50/50 read-update, {ns.partitions} partitions, "
+                      f"{ns.threads} threads, {ns.records} records",
+            "value": round(n / run_s, 1),
+            "unit": "ops/s",
+            "detail": {
+                "load_s": round(load_s, 2),
+                "load_ops_s": round(ns.records / load_s, 1),
+                "run_s": round(run_s, 2),
+                "avg_us": round(sum(lat_us) / max(1, n), 1),
+                "p99_us": round(lat_us[min(n - 1, int(n * 0.99))] if lat_us else 0, 1),
+                "errors": errors[0],
+            },
+        }
+        print(json.dumps(result))
+
+    finally:
+        if cluster is not None:
+            cluster.stop()
 
 
 if __name__ == "__main__":
