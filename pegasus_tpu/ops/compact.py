@@ -493,31 +493,33 @@ def _compiled_pipeline(padded_lens: tuple, w: int, has_rank: bool):
     return jax.jit(fn)
 
 
-@functools.lru_cache(maxsize=256)
-def _compiled_pipeline_cached(padded_lens: tuple, run_ws: tuple, w: int):
-    """Jitted pipeline over CACHED device runs (engine hot path).
+def _make_cached_fn(padded_lens: tuple, run_ws: tuple, w: int,
+                    allow_pallas: bool = True):
+    """Build the (unjitted) traceable pipeline over CACHED device runs.
 
     Each input run arrives as its cached fully-padded device columns —
     packed+uploaded ONCE when the SST was born or first joined a device
     compaction. Everything a specific merge needs beyond that is derived
-    INSIDE the jit (fused, no extra dispatches): missing prefix lanes for
-    runs with shorter keys (all-zero by construction, 0xFFFFFFFF in the
-    pad tail), the concat index, and the aux concatenation.
+    INSIDE the trace (fused, no extra dispatches): missing prefix lanes
+    for runs with shorter keys (all-zero by construction, 0xFFFFFFFF in
+    the pad tail), the concat index, and the aux concatenation.
 
-    Real run lengths are TRACED scalars, so the compile cache is keyed on
+    Real run lengths are TRACED scalars, so compile caches key on
     (padded bucket lengths, run widths) only — a live engine's endlessly
     varying run sizes share programs per bucket instead of recompiling
     per compaction. Internally the merge works in PADDED-concat index
     space (aligned with the padded aux concat); the last step maps
-    survivor indices back to real-concat space for the host gather."""
-    import jax
+    survivor indices back to real-concat space for the host gather.
+
+    Used directly by _compiled_pipeline_cached (one merge) and under vmap
+    by the batched multi-partition pipeline (ops.batched_compact)."""
     import jax.numpy as jnp
     from jax import lax
 
     from .pallas_merge import pallas_enabled
 
     nk = w + 1  # cached runs never carry a suffix-rank column
-    use_pallas = pallas_enabled()
+    use_pallas = pallas_enabled() and allow_pallas
     padded_offsets = np.cumsum([0] + list(padded_lens))
 
     def fn(cached_runs, aux_runs, real_lens, now, pidx, pmask, bottommost,
@@ -553,7 +555,16 @@ def _compiled_pipeline_cached(padded_lens: tuple, run_ws: tuple, w: int):
         mapped = jnp.where(out_idx >= 0, mapped, -1)
         return mapped, count
 
-    return jax.jit(fn)
+    return fn
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_pipeline_cached(padded_lens: tuple, run_ws: tuple, w: int):
+    """Jitted single-merge pipeline over cached device runs (see
+    _make_cached_fn for the full contract)."""
+    import jax
+
+    return jax.jit(_make_cached_fn(padded_lens, run_ws, w))
 
 
 _BACKENDS = {"cpu": CpuBackend(), "tpu": TpuBackend(), "jax": TpuBackend()}
@@ -627,9 +638,17 @@ def compact_blocks(blocks, opts: CompactOptions,
         n = sum(packed.lens)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
         out = concat.gather(survivors)
+    out = apply_post_filters(out, opts, now)
+    return CompactResult(out, _stats(n, out.n))
+
+
+def apply_post_filters(out: KVBlock, opts: CompactOptions,
+                       now: int) -> KVBlock:
+    """Host-side post passes shared by every merge entry point (single,
+    blockwise, batched): user-specified compaction rules run before the
+    TTL rewrite, like KeyWithTTLCompactionFilter runs user ops first
+    (:36-105), then the table default_ttl rewrite."""
     if opts.filter and opts.user_ops:
-        # user-specified compaction rules run before the TTL rewrite, like
-        # KeyWithTTLCompactionFilter runs user ops first (:36-105)
         from ..engine.compaction_rules import apply_operations
 
         drop, _ = apply_operations(out, opts.user_ops, now)
@@ -637,7 +656,7 @@ def compact_blocks(blocks, opts: CompactOptions,
             out = out.gather(np.nonzero(~drop)[0])
     if opts.filter and opts.default_ttl > 0:
         _apply_default_ttl(out, now + opts.default_ttl)
-    return CompactResult(out, _stats(n, out.n))
+    return out
 
 
 def _slice_block(b: KVBlock, lo: int, hi: int) -> KVBlock:

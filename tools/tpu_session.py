@@ -37,33 +37,47 @@ def log(line: str):
     print(f"[{stamp}] {line}", flush=True)
 
 
+_RUN_SEQ = [0]
+
+
 def run(cmd, timeout_s, env_extra=None, label=""):
     env = dict(os.environ)
     env.update(env_extra or {})
     log(f"RUN {label or cmd}: timeout {timeout_s}s env {env_extra}")
-    proc = subprocess.Popen(cmd, cwd=REPO, env=env, text=True,
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        # NEVER SIGKILL a TPU-attached process (it wedges the tunnel's
-        # device lease for hours): SIGTERM, grace-wait, and if it still
-        # won't die, ABANDON it and move on
-        proc.terminate()
+    # child output goes to FILES, never pipes: an abandoned child blocked
+    # on a full unread pipe could never exit and would hold the device
+    # lease forever
+    _RUN_SEQ[0] += 1
+    base = os.path.join(REPO, f".tpu_session_{_RUN_SEQ[0]:02d}")
+    with open(base + ".out", "wb") as fo, open(base + ".err", "wb") as fe:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=fo, stderr=fe)
         try:
-            proc.wait(timeout=60)
-            log(f"TIMEOUT {label} (terminated cleanly)")
+            proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            log(f"TIMEOUT {label} — child ignored SIGTERM; ABANDONED "
-                f"(pid {proc.pid}), not killing a TPU-attached process")
-        return None
-    for line in (stdout or "").strip().splitlines()[-6:]:
+            # NEVER SIGKILL a TPU-attached process (it wedges the tunnel's
+            # device lease for hours): SIGTERM, grace-wait, and if it still
+            # won't die, ABANDON it and move on
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+                log(f"TIMEOUT {label} (terminated cleanly)")
+            except subprocess.TimeoutExpired:
+                log(f"TIMEOUT {label} — child ignored SIGTERM; ABANDONED "
+                    f"(pid {proc.pid}), not killing a TPU-attached process")
+            return None
+    with open(base + ".out", "r", errors="replace") as f:
+        stdout = f.read()
+    with open(base + ".err", "r", errors="replace") as f:
+        stderr = f.read()
+    for line in stdout.strip().splitlines()[-6:]:
         log(f"  out: {line}")
     if proc.returncode != 0:
-        for line in (stderr or "").strip().splitlines()[-4:]:
+        for line in stderr.strip().splitlines()[-4:]:
             log(f"  err: {line}")
         log(f"FAIL {label} rc={proc.returncode}")
         return None
+    os.unlink(base + ".out")
+    os.unlink(base + ".err")
     return stdout
 
 
