@@ -11,6 +11,7 @@ import threading
 import time
 
 from ..client import MetaResolver, PegasusClient, PegasusError
+from ..rpc.transport import RpcError
 from ..runtime.perf_counters import counters
 
 
@@ -49,7 +50,10 @@ class AvailableDetector:
             cli = self._ensure_client()
             cli.set(hk, sk, val)
             ok = cli.get(hk, sk) == val
-        except (PegasusError, OSError):
+        except (PegasusError, RpcError, OSError):
+            # RpcError covers "table does not exist (yet)" from the meta
+            # resolver — a canary whose table lags its own boot must count
+            # failures, not die (its loop thread has no other guard)
             ok = False
             self.client = None  # rebuild routing next round
         with self._lock:
@@ -71,7 +75,10 @@ class AvailableDetector:
 
     def _loop(self):
         while not self._stop.wait(self.interval):
-            self.probe_once()
+            try:
+                self.probe_once()
+            except Exception as e:  # the canary must outlive ANY error
+                print(f"[detector] probe error: {e!r}", flush=True)
 
     def availability(self, seconds: float) -> float:
         """Success ratio over the trailing window (minute/hour/day views)."""
@@ -83,8 +90,13 @@ class AvailableDetector:
         return sum(rows) / len(rows)
 
     def report(self) -> dict:
+        with self._lock:
+            samples = len(self._window)
         return {
             "minute": self.availability(60),
             "hour": self.availability(3600),
             "day": self.availability(86400),
+            # no-data reads as 1.0 (benefit of the doubt, reference
+            # behavior); consumers needing proof of life check samples
+            "samples": samples,
         }

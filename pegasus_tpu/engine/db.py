@@ -524,7 +524,17 @@ class LsmEngine:
         result = compact_blocks(input_blocks, opts, device_runs=device_runs)
         counters.rate("engine.compaction_completed_count").increment()
         counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
-        out_blocks = _split_block(result.block, self.opts.target_file_size_bytes)
+        self._install_merge_output(newer_files, older_files, result.block,
+                                   target_level)
+        return result.stats
+
+    def _install_merge_output(self, newer_files, older_files, out_block,
+                              target_level: int) -> None:
+        """Write + atomically swap a merge's output over its inputs —
+        shared by _merge_to_level and the node-level batched compaction
+        (replica_stub.batched_manual_compact). Caller holds the engine's
+        compaction lock."""
+        out_blocks = _split_block(out_block, self.opts.target_file_size_bytes)
         new_ssts = []
         for ob in out_blocks:
             with self._lock:
@@ -564,7 +574,6 @@ class LsmEngine:
                 os.unlink(s.path)
             except OSError:
                 pass
-        return result.stats
 
     def manual_compact(self, bottommost: bool = True, now: int = None,
                        target_level: int = None) -> dict:

@@ -68,7 +68,8 @@ def _stack_group(jobs):
     return cached, aux, real_lens, pidx
 
 
-def compact_partition_batch(jobs, opts: CompactOptions, mesh=None):
+def compact_partition_batch(jobs, opts: CompactOptions, mesh=None,
+                            post_opts=None):
     """jobs: list of (runs: [KVBlock], device_runs: [DeviceRun], pidx).
     Every job's runs must be sorted and fully device-cached; all jobs in
     one call may have ANY shapes — they are grouped by signature here,
@@ -79,17 +80,34 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None):
     dp: each chip compacts its partitions with zero collectives); other
     groups run single-device.
 
+    post_opts: optional per-job CompactOptions for the HOST post passes
+    (user rules, default_ttl) when jobs carry different app envs; the
+    in-dispatch knobs (partition_mask, bottommost, filter) still come
+    from `opts` and broadcast — callers must group jobs accordingly.
+
     Semantically identical to per-job compact_blocks(runs, opts,
     device_runs) with opts.pidx = job pidx — including the user-rule and
     default-TTL post passes (byte-equal; test-enforced). Groups chunk so
-    one dispatch never stacks more than opts.max_device_records rows.
+    one dispatch never stacks more than opts.max_device_records rows; a
+    SINGLE job beyond that budget routes through compact_blocks, whose
+    blockwise path range-decomposes it instead of OOMing one dispatch.
     """
+    from .compact import compact_blocks
+
     now = opts.resolved_now()
     outs = [None] * len(jobs)
     groups = {}
     for j, (runs, device_runs, pidx) in enumerate(jobs):
         if not runs or any(d is None for d in device_runs):
             raise ValueError(f"job {j}: all runs must be device-cached")
+        if sum(d.padded_len for d in device_runs) > opts.max_device_records:
+            from dataclasses import replace
+
+            job_opts = replace(post_opts[j] if post_opts else opts,
+                               pidx=pidx, backend="tpu", runs_sorted=True)
+            outs[j] = compact_blocks(runs, job_opts,
+                                     device_runs=device_runs).block
+            continue
         groups.setdefault(_signature(device_runs), []).append(j)
     for sig, all_idxs in groups.items():
         padded_lens, run_ws, w = sig
@@ -98,13 +116,17 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None):
         # guard, adapted to the batch axis)
         per_job = sum(padded_lens)
         max_b = max(1, int(opts.max_device_records // max(1, per_job)))
+        if mesh is not None and max_b >= mesh.size:
+            # keep chunks mesh-divisible, or the dp sharding silently
+            # disengages for every chunk
+            max_b -= max_b % mesh.size
         for chunk_at in range(0, len(all_idxs), max_b):
             idxs = all_idxs[chunk_at:chunk_at + max_b]
-            _run_group(jobs, idxs, sig, opts, now, mesh, outs)
+            _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts)
     return outs
 
 
-def _run_group(jobs, idxs, sig, opts, now, mesh, outs):
+def _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts=None):
     """One dispatch: stack the group's cached runs, run jit(vmap), gather
     + post-filter each row's survivors into outs[job]."""
     import jax
@@ -139,4 +161,5 @@ def _run_group(jobs, idxs, sig, opts, now, mesh, outs):
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
         out = gather_device_survivors(concat, out_idx[row],
                                       int(counts[row]))
-        outs[j] = apply_post_filters(out, opts, now)
+        outs[j] = apply_post_filters(
+            out, post_opts[j] if post_opts else opts, now)

@@ -109,6 +109,8 @@ class ReplicaStub:
         self.commands.register_defaults(node_kind="replica",
                                         describe=self._describe)
         self.commands.register("manual-compact", self._cmd_manual_compact)
+        self.commands.register("batched-manual-compact",
+                               self._cmd_batched_manual_compact)
         self.commands.register("query-compact-state", self._cmd_compact_state)
         self.commands.register("detect_hotkey", self._cmd_detect_hotkey)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
@@ -282,6 +284,132 @@ class ReplicaStub:
             d.fail_mode = e.get("fail_mode", "slow")
             d.set_paused(e.get("status") == "pause")
         rep.duplicators = dups
+
+    def batched_manual_compact(self, app_id: int = None, now: int = None,
+                               mesh=None) -> dict:
+        """Node-level manual compaction: ALL this node's (optionally one
+        app's) tpu-backend replicas compact in batched device dispatches —
+        ops.batched_compact's dp-over-partitions as a SYSTEM operation,
+        replacing N sequential per-replica CompactRange jobs with
+        ceil(N/chunk) vmapped kernel launches. Replicas whose runs cannot
+        be device-cached fall back to their own manual_compact.
+
+        Every participating engine's compaction lock is held from file-set
+        snapshot through output install (acquired in stable key order), so
+        concurrent flush-triggered compactions cannot double-merge."""
+        from ..ops.batched_compact import compact_partition_batch
+        from ..ops.compact import CompactOptions
+
+        from ..engine.db import META_LAST_MANUAL_COMPACT_FINISH_TIME
+
+        def mark_done(eng):
+            with eng._lock:
+                eng._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = \
+                    int(time.time())
+                eng._write_manifest_locked()  # finish time must persist
+
+        with self._lock:
+            reps = [(aid, rep)
+                    for (aid, p), rep in sorted(self._replicas.items())
+                    if app_id is None or aid == app_id]
+        groups, fallback = {}, []
+        held = set()  # engines whose compaction lock we currently hold
+
+        def release(eng):
+            if eng in held:
+                held.discard(eng)
+                eng._compaction_lock.release()
+
+        stats = {"input_records": 0, "output_records": 0,
+                 "partitions": 0, "batched": 0, "fallback": 0}
+        try:
+            for aid, rep in reps:
+                eng = rep.server.engine
+                if eng.opts.backend != "tpu":
+                    fallback.append(rep)
+                    continue
+                eng.flush()
+                eng._compaction_lock.acquire()
+                held.add(eng)
+                with eng._lock:
+                    all_inputs = list(eng._l0)
+                    for lv in sorted(eng._levels):
+                        all_inputs.extend(eng._levels[lv])
+                inputs = [s for s in all_inputs if s.n]
+                if not inputs:
+                    # nothing to merge — but zero-record SSTs (possible
+                    # when a merge drops everything) must still be swept,
+                    # as manual_compact's full-input merge would do
+                    if all_inputs:
+                        from ..engine.block import KVBlock
+
+                        eng._install_merge_output(all_inputs, [],
+                                                  KVBlock.empty(),
+                                                  eng.opts.max_levels)
+                    mark_done(eng)
+                    release(eng)
+                    stats["partitions"] += 1
+                    stats["batched"] += 1
+                    continue
+                device_runs = [eng._device_run_budgeted(s) for s in inputs]
+                if any(d is None for d in device_runs):
+                    release(eng)  # its own manual_compact re-locks later
+                    fallback.append(rep)
+                    continue
+                # dispatches group by (app, partition_mask): the mask
+                # broadcasts in-kernel, and a mask change mid-env-spread
+                # must not leak one replica's mask onto another. The HOST
+                # post passes (user rules, default_ttl) use each engine's
+                # OWN options via post_opts.
+                groups.setdefault((aid, eng.opts.partition_mask),
+                                  []).append((eng, all_inputs, inputs,
+                                              device_runs))
+            for (aid, pmask), group in groups.items():
+                opts = CompactOptions(
+                    now=now, bottommost=True, runs_sorted=True,
+                    backend="tpu", partition_mask=pmask,
+                    prefix_u32=group[0][0].opts.prefix_u32)
+                jobs, post_opts = [], []
+                for eng, all_inputs, inputs, drs in group:
+                    jobs.append(([s.block() for s in inputs], drs,
+                                 eng.opts.pidx))
+                    post_opts.append(CompactOptions(
+                        now=now, bottommost=True, runs_sorted=True,
+                        backend="tpu", pidx=eng.opts.pidx,
+                        partition_mask=pmask,
+                        prefix_u32=eng.opts.prefix_u32,
+                        default_ttl=eng.opts.default_ttl,
+                        user_ops=tuple(eng.opts.user_ops)))
+                outs = compact_partition_batch(jobs, opts, mesh=mesh,
+                                               post_opts=post_opts)
+                for (eng, all_inputs, inputs, _), out in zip(group, outs):
+                    n_in = sum(s.n for s in inputs)
+                    # remove EVERY input file incl. zero-record ones
+                    eng._install_merge_output(all_inputs, [], out,
+                                              eng.opts.max_levels)
+                    mark_done(eng)
+                    # this engine is done: let flush-triggered compactions
+                    # proceed instead of stalling on other groups' work
+                    release(eng)
+                    stats["input_records"] += n_in
+                    stats["output_records"] += out.n
+                    stats["partitions"] += 1
+                    stats["batched"] += 1
+        finally:
+            for eng in list(held):
+                release(eng)
+        for rep in fallback:
+            fs = rep.server.engine.manual_compact(now=now)
+            stats["input_records"] += fs.get("input_records", 0)
+            stats["output_records"] += fs.get("output_records", 0)
+            stats["partitions"] += 1
+            stats["fallback"] += 1
+        return stats
+
+    def _cmd_batched_manual_compact(self, args) -> str:
+        app_id = int(args[0]) if args else None
+        stats = self.batched_manual_compact(app_id=app_id)
+        return json.dumps(stats)
 
     def _on_query_replica_info(self, header, body) -> bytes:
         """Everything this node holds — the disaster-recovery scan the meta

@@ -24,7 +24,11 @@ class Config:
 
     def __init__(self, path: str = None, text: str = None, variables: dict = None):
         self._parser = configparser.ConfigParser(
-            interpolation=None, strict=False, delimiters=("=",)
+            interpolation=None, strict=False, delimiters=("=",),
+            # rDSN-style inis comment inline ("key = value  # why"); without
+            # this the comment travels INTO the value and e.g.
+            # compaction_backend = "tpu   # ..." KeyErrors at first merge
+            inline_comment_prefixes=("#", ";"),
         )
         self._parser.optionxform = str  # case-sensitive keys like rDSN
         self._variables = dict(variables or {})

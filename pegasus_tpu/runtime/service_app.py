@@ -305,6 +305,7 @@ class CollectorApp:
 
         self.metas = config.get_list("pegasus.server", "meta_servers",
                                      ["127.0.0.1:34601"])
+        self._stopping = False
         self.detect_table = config.get_string(section, "available_detect_app",
                                               "test")
         self.collector = InfoCollector(
@@ -340,9 +341,10 @@ class CollectorApp:
     def address(self):
         return f"{self.rpc.address[0]}:{self.rpc.address[1]}"
 
-    def _ensure_probe_table(self):
+    def _ensure_probe_table(self) -> bool:
         """Auto-create the canary table (the reference's onebox ships a
-        'test' table; a collector must not require manual DDL)."""
+        'test' table; a collector must not require manual DDL). -> True
+        once a meta acknowledged the create."""
         from ..meta import messages as mm
         from ..meta.meta_server import RPC_CM_CREATE_APP
         from ..rpc import codec
@@ -356,24 +358,40 @@ class CollectorApp:
                     conn.call(RPC_CM_CREATE_APP, codec.encode(
                         mm.CreateAppRequest(self.detect_table, 8, 3)),
                         timeout=10.0)
-                    return
+                    return True
                 finally:
                     conn.close()
             except OSError:
                 continue
+        return False
+
+    def _ensure_probe_table_loop(self):
+        """The collector routinely boots BEFORE (or restarts independently
+        of) the meta; keep trying until a create lands — no deadline, a
+        meta that appears an hour later must still get its canary table
+        (daemon thread; exits with the process or on stop())."""
+        import time as _time
+
+        while not self._stopping:
+            try:
+                if self._ensure_probe_table():
+                    return
+            except Exception:
+                pass
+            _time.sleep(1.0)
 
     def start(self):
+        self._stopping = False
         self.rpc.start()
-        try:
-            self._ensure_probe_table()
-        except Exception as e:  # meta may come up later; probes will retry
-            print(f"[collector] probe table create deferred: {e!r}", flush=True)
+        threading.Thread(target=self._ensure_probe_table_loop,
+                         daemon=True).start()
         self.collector.start()
         self.detector.start()
         print(f"[pegasus-tpu] collector rpc on {self.address}", flush=True)
         return self
 
     def stop(self):
+        self._stopping = True
         if self.reporter:
             self.reporter.stop()
         self.detector.stop()

@@ -1,4 +1,5 @@
 import argparse
+import os
 
 from ..runtime.config import Config
 from ..runtime.service_app import ServiceAppContainer
@@ -10,7 +11,19 @@ def main(argv=None):
     ap.add_argument("--app", default="", help="comma-separated app names "
                     "(default: every [apps.*] with run=true)")
     ns = ap.parse_args(argv)
-    container = ServiceAppContainer(Config(ns.config))
+    cfg = Config(ns.config)
+    if (os.environ.get("JAX_PLATFORMS")
+            and cfg.get_string("pegasus.server", "compaction_backend",
+                               "cpu") == "tpu"):
+        # honor an explicit platform request BEFORE the engine touches jax:
+        # some images re-assert their own platform over the env var, and a
+        # tpu-backend engine would otherwise wedge on a dead device tunnel.
+        # Gated on the tpu backend — a cpu-backend server never imports
+        # jax, and this import costs seconds of boot on small hosts.
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    container = ServiceAppContainer(cfg)
     only = [a for a in ns.app.split(",") if a] or None
     apps = container.start(only)
     for name, app in apps.items():

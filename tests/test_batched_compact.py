@@ -161,3 +161,67 @@ def test_batched_chunks_oversized_groups():
                               device_runs=drs)
         assert got.n == want.block.n
         np.testing.assert_array_equal(want.block.key_arena, got.key_arena)
+
+
+def test_stub_batched_manual_compact(tmp_path):
+    """Node-level batched manual compaction: a stub's tpu replicas compact
+    in batched dispatches with the same results as per-replica
+    manual_compact (digest-equal), updating the finish-time meta."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    from pegasus_tpu.engine import EngineOptions
+    from pegasus_tpu.engine.db import META_LAST_MANUAL_COMPACT_FINISH_TIME
+    from pegasus_tpu.replication.replica import Replica
+
+    def fill(rep, pidx):
+        for i in range(300):
+            rep.server.engine.put(
+                generate_key(b"bm%d" % (i % 41), b"s%05d" % i),
+                SCHEMAS[2].generate_value(0, 0, b"v%d.%d" % (pidx, i)))
+            if i % 80 == 79:
+                rep.server.engine.flush()
+
+    import hashlib
+
+    def digest(eng):
+        h = hashlib.sha256()
+        with eng._lock:
+            files = list(eng._l0) + [f for lv in sorted(eng._levels)
+                                     for f in eng._levels[lv]]
+        for sst in files:
+            b = sst.block()
+            h.update(b.key_arena.tobytes())
+            h.update(b.val_arena.tobytes())
+        return h.hexdigest()
+
+    # lane A: batched through a stub-shaped object
+    class FakeStub:
+        _lock = __import__("threading").RLock()
+
+    from pegasus_tpu.replication.replica_stub import ReplicaStub
+
+    stub = FakeStub()
+    stub._replicas = {}
+    reps = {}
+    for pidx in range(4):
+        rep = Replica(f"n0", str(tmp_path / f"b{pidx}"), app_id=1,
+                      pidx=pidx, options=EngineOptions(backend="tpu"))
+        fill(rep, pidx)
+        stub._replicas[(1, pidx)] = rep
+        reps[pidx] = rep
+    stats = ReplicaStub.batched_manual_compact(stub, now=100)
+    assert stats["batched"] == 4 and stats["fallback"] == 0
+    assert stats["output_records"] > 0
+    digests_batched = {p: digest(reps[p].server.engine) for p in reps}
+    for rep in reps.values():
+        assert META_LAST_MANUAL_COMPACT_FINISH_TIME in \
+            rep.server.engine.meta_store
+        rep.close()
+    # lane B: plain per-replica manual_compact on identical data
+    for pidx in range(4):
+        rep = Replica(f"n1", str(tmp_path / f"s{pidx}"), app_id=1,
+                      pidx=pidx, options=EngineOptions(backend="tpu"))
+        fill(rep, pidx)
+        rep.server.engine.manual_compact(now=100)
+        assert digest(rep.server.engine) == digests_batched[pidx], pidx
+        rep.close()

@@ -168,3 +168,16 @@ def test_task_exception_does_not_kill_worker():
         assert done.wait(2)
     finally:
         pool.stop()
+
+
+def test_config_strips_inline_comments(tmp_path):
+    from pegasus_tpu.runtime.config import Config
+
+    p = tmp_path / "c.ini"
+    p.write_text("[pegasus.server]\n"
+                 "compaction_backend = tpu   # offload merges to the chip\n"
+                 "meta_servers = 127.0.0.1:34601 ; primary meta\n")
+    cfg = Config(str(p))
+    assert cfg.get_string("pegasus.server", "compaction_backend", "") == "tpu"
+    assert cfg.get_list("pegasus.server", "meta_servers", []) == \
+        ["127.0.0.1:34601"]
