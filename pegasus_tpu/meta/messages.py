@@ -56,6 +56,18 @@ class DropAppResponse:
 
 
 @dataclass
+class ControlMetaRequest:
+    set_level: str = ""               # "" = just read; freezed|steady|lively
+
+
+@dataclass
+class ControlMetaResponse:
+    error: int = 0
+    error_text: str = ""
+    level: str = ""
+
+
+@dataclass
 class RecallAppRequest:
     app_id: int = 0
     new_app_name: str = ""            # "" = original name
@@ -143,6 +155,7 @@ class BalanceRequest:
 @dataclass
 class BalanceResponse:
     error: int = 0
+    error_text: str = ""
     moved: int = 0
 
 

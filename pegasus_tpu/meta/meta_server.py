@@ -42,6 +42,11 @@ RPC_CM_LS_BACKUP_POLICY = "RPC_CM_QUERY_BACKUP_POLICY"
 RPC_CM_MODIFY_BACKUP_POLICY = "RPC_CM_MODIFY_BACKUP_POLICY"
 RPC_CM_RECOVER = "RPC_CM_START_RECOVERY"
 RPC_CM_RECALL_APP = "RPC_CM_RECALL_APP"
+RPC_CM_CONTROL_META = "RPC_CM_CONTROL_META"
+
+# meta function levels (reference meta_function_level: how much the meta
+# may move data around on its own; shell get/set_meta_level)
+META_LEVELS = ("freezed", "steady", "lively")
 RPC_CM_DDD_DIAGNOSE = "RPC_CM_DDD_DIAGNOSE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
@@ -68,6 +73,7 @@ class MetaServer:
         self._dups = {}          # app_id -> list[dict] duplication entries
         self._policies = {}      # name -> dict (BackupPolicyInfo fields)
         self._dropped = {}       # app_id -> {"app","parts","expire_ts"}
+        self.level = "lively"    # freezed | steady | lively (see META_LEVELS)
         self._next_app_id = 1
         self._next_dupid = 1
         self.pool = ConnectionPool()
@@ -97,6 +103,7 @@ class MetaServer:
             RPC_CM_MODIFY_BACKUP_POLICY: self._on_modify_backup_policy,
             RPC_CM_RECOVER: self._on_recover,
             RPC_CM_RECALL_APP: self._on_recall_app,
+            RPC_CM_CONTROL_META: self._on_control_meta,
             RPC_CM_DDD_DIAGNOSE: self._on_ddd_diagnose,
             RPC_FD_BEACON: self._on_beacon,
         }
@@ -190,6 +197,24 @@ class MetaServer:
         for pc in parts:
             self._install_partition(app, pc)
         return codec.encode(mm.RecallAppResponse(app_name=name))
+
+    def _on_control_meta(self, header, body) -> bytes:
+        """get/set the meta function level (reference meta_function_level
+        + shell get_meta_level/set_meta_level): `freezed` stops every
+        meta-initiated data movement (balancing AND redundancy rebuild —
+        primaries still promote so writes survive), `steady` allows
+        failover rebuild but no balancing, `lively` enables auto-balance."""
+        req = codec.decode(mm.ControlMetaRequest, body)
+        with self._lock:
+            if req.set_level:
+                if req.set_level not in META_LEVELS:
+                    return codec.encode(mm.ControlMetaResponse(
+                        error=1,
+                        error_text=f"bad level {req.set_level} "
+                                   f"(choose from {'/'.join(META_LEVELS)})"))
+                self.level = req.set_level
+                self._persist_locked()
+            return codec.encode(mm.ControlMetaResponse(level=self.level))
 
     def purge_expired_dropped(self, now: int = None) -> list:
         """Forget soft-dropped apps past their hold (timer tick); their
@@ -485,6 +510,12 @@ class MetaServer:
         more primaries than the least-loaded, demote one whose partition
         has a secondary on the lighter node (the greedy_load_balancer's
         primary-count equalization)."""
+        with self._lock:
+            if self.level != "lively":
+                return codec.encode(mm.BalanceResponse(
+                    error=1, moved=0,
+                    error_text=f"meta level is {self.level}; balancing "
+                               "needs lively (set_meta_level lively)"))
         moved = 0
         for _ in range(64):  # bounded passes
             with self._lock:
@@ -960,11 +991,13 @@ class MetaServer:
                         best, best_state = m, (st.ballot, st.last_prepared)
                 pc.primary = best or members[0]
             pc.secondaries = [m for m in members if m != pc.primary]
-            # rebuild replica count on a fresh node
+            # rebuild replica count on a fresh node — unless the operator
+            # froze meta-initiated data movement (get/set_meta_level)
             learners = []
             alive = self._alive_nodes_locked()
             candidates = [n for n in alive if n not in members]
-            if len(members) < app.replica_count and candidates:
+            if (self.level != "freezed"
+                    and len(members) < app.replica_count and candidates):
                 new_node = min(candidates, key=self._node_load_locked)
                 learners = [new_node]
             self._persist_locked()
@@ -1061,6 +1094,7 @@ class MetaServer:
             "dups": {str(aid): entries for aid, entries in self._dups.items()},
             "policies": self._policies,
             "dropped": {str(aid): e for aid, e in self._dropped.items()},
+            "level": self.level,
         }
         tmp = self.state_path + ".tmp"
         os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
@@ -1083,5 +1117,6 @@ class MetaServer:
         self._policies = state.get("policies", {})
         self._dropped = {int(aid): e
                          for aid, e in state.get("dropped", {}).items()}
+        self.level = state.get("level", "lively")
         # nodes must re-beacon after a meta restart
         self._nodes = {}

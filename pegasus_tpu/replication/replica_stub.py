@@ -111,6 +111,7 @@ class ReplicaStub:
         self.commands.register("manual-compact", self._cmd_manual_compact)
         self.commands.register("batched-manual-compact",
                                self._cmd_batched_manual_compact)
+        self.commands.register("replica-disk", self._cmd_replica_disk)
         self.commands.register("query-compact-state", self._cmd_compact_state)
         self.commands.register("detect_hotkey", self._cmd_detect_hotkey)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
@@ -405,6 +406,24 @@ class ReplicaStub:
             stats["partitions"] += 1
             stats["fallback"] += 1
         return stats
+
+    def _cmd_replica_disk(self, args) -> str:
+        """Per-replica on-disk footprint (the shell app_disk scrape)."""
+        with self._lock:
+            reps = list(self._replicas.items())
+        out = {}
+        for (aid, pidx), rep in reps:
+            eng = rep.server.engine
+            with eng._lock:
+                files = list(eng._l0) + [f for fs in eng._levels.values()
+                                         for f in fs]
+            out[f"{aid}.{pidx}"] = {
+                "sst_bytes": sum(f.data_bytes for f in files),
+                "sst_files": len(files),
+                "records": sum(f.n for f in files),
+                "primary": rep.status == "PRIMARY",
+            }
+        return json.dumps(out)
 
     def _cmd_batched_manual_compact(self, args) -> str:
         app_id = int(args[0]) if args else None

@@ -108,6 +108,34 @@ class Shell:
                         "recover <node> [node...] — rebuild meta state from nodes"),
             "ddd_diagnose": (self.cmd_ddd_diagnose,
                              "ddd_diagnose [app] [-f] — find/fix double-dead partitions"),
+            "version": (self.cmd_version, "server + shell version"),
+            "timeout": (self.cmd_timeout,
+                        "timeout [ms] — get/set the data-op client timeout"),
+            "hash": (self.cmd_hash,
+                     "hash <hk> <sk> — partition hash + routed pidx"),
+            "app_stat": (self.cmd_app_stat,
+                         "per-app qps/cu aggregates scraped from primaries"),
+            "app_disk": (self.cmd_app_disk,
+                         "app_disk [app] — per-replica disk usage by node"),
+            "multi_get_sortkeys": (self.cmd_multi_get_sortkeys,
+                                   "multi_get_sortkeys <hk> — sortkeys only"),
+            "multi_get_range": (self.cmd_multi_get_range,
+                                "multi_get_range <hk> <start_sk> <stop_sk>"),
+            "multi_del_range": (self.cmd_multi_del_range,
+                                "multi_del_range <hk> <start_sk> <stop_sk>"),
+            "clear_app_envs": (self.cmd_clear_app_envs,
+                               "reset every app env of the current table"),
+            "clear_data": (self.cmd_clear_data,
+                           "clear_data <table> yes — delete EVERY row"),
+            "get_meta_level": (self.cmd_get_meta_level,
+                               "meta function level (freezed/steady/lively)"),
+            "set_meta_level": (self.cmd_set_meta_level,
+                               "set_meta_level <freezed|steady|lively>"),
+            "query_backup_policy": (self.cmd_ls_backup_policy,
+                                    "alias of ls_backup_policy"),
+            "batched_manual_compact": (self.cmd_batched_manual_compact,
+                                       "batched_manual_compact <node|all> — "
+                                       "node-level batched device compaction"),
             "sst_dump": (self.cmd_sst_dump,
                          "sst_dump <file.sst> [max_rows] — offline SST reader"),
             "mlog_dump": (self.cmd_mlog_dump,
@@ -146,7 +174,8 @@ class Shell:
             raise PegasusError(4, "no table selected (use <name>)")
         if app not in self._clients:
             self._clients[app] = PegasusClient(
-                MetaResolver(self.meta_addrs, app, self.pool))
+                MetaResolver(self.meta_addrs, app, self.pool),
+                timeout=getattr(self, "_default_timeout", 10.0))
         return self._clients[app]
 
     def _nodes(self):
@@ -408,7 +437,10 @@ class Shell:
 
         r = self._meta_call(RPC_CM_BALANCE, mm.BalanceRequest(),
                             mm.BalanceResponse)
-        self.p(f"moved {r.moved} primaries")
+        if r.error:
+            self.p(f"ERROR: {r.error_text or 'balance refused'}")
+        else:
+            self.p(f"moved {r.moved} primaries")
 
     # duplication ---------------------------------------------------------
     # (reference src/shell/commands/duplication.cpp:32-260)
@@ -604,6 +636,175 @@ class Shell:
             for c in d.candidates:
                 self.p(f"  candidate: {c}")
             self.p(f"  action: {d.action or '(none; rerun with -f to fix)'}")
+
+    # misc admin / data utilities -----------------------------------------
+
+    def cmd_version(self, args):
+        from ..runtime.remote_command import VERSION
+
+        self.p(VERSION)
+        for n in self._nodes():
+            try:
+                self.p(f"{n.address}: {self._node_command(n.address, 'server-info', [])}")
+            except (RpcError, OSError) as e:
+                self.p(f"{n.address}: unreachable ({e})")
+
+    def cmd_timeout(self, args):
+        if args:
+            ms = int(args[0])
+            for cli in self._clients.values():
+                cli.timeout = ms / 1000.0
+            self._default_timeout = ms / 1000.0
+        cur = getattr(self, "_default_timeout", 10.0)
+        self.p(f"timeout: {int(cur * 1000)} ms")
+
+    def cmd_hash(self, args):
+        from ..base.key_schema import generate_key, key_hash
+
+        key = generate_key(args[0].encode(), args[1].encode())
+        h = key_hash(key)
+        line = f"hash: {h}"
+        if self.current_app:
+            n = self._client().resolver.partition_count
+            line += f"  partition: {h % n} (of {n})"
+        self.p(line)
+
+    def cmd_app_stat(self, args):
+        from ..collector.info_collector import InfoCollector
+
+        coll = InfoCollector(self.meta_addrs)
+        try:
+            summary = coll.collect_once()
+        finally:
+            coll.stop()
+        hdr = ["get_qps", "put_qps", "multi_get_qps", "scan_qps",
+               "recent_read_cu", "recent_write_cu"]
+        self.p(f"{'app':<16} " + " ".join(f"{h:>15}" for h in hdr))
+        for app, agg in sorted(summary.items()):
+            self.p(f"{app:<16} " + " ".join(f"{agg.get(h, 0):>15.1f}"
+                                            for h in hdr))
+
+    def cmd_app_disk(self, args):
+        want_app = args[0] if args else None
+        app_ids = {}
+        r = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
+                            mm.ListAppsResponse)
+        for a in r.apps:
+            app_ids[str(a.app_id)] = a.app_name
+        totals = {}
+        for n in self._nodes():
+            if not n.alive:
+                continue
+            try:
+                snap = json.loads(self._node_command(n.address,
+                                                     "replica-disk", []))
+            except (RpcError, OSError, ValueError):
+                self.p(f"{n.address} UNREACHABLE — totals below are "
+                       f"incomplete")
+                continue
+            for key, info in snap.items():
+                app = app_ids.get(key.split(".")[0], key.split(".")[0])
+                if want_app and app != want_app:
+                    continue
+                t = totals.setdefault(app, {"sst_bytes": 0, "replicas": 0})
+                t["sst_bytes"] += info["sst_bytes"]
+                t["replicas"] += 1
+                self.p(f"{n.address} {app}.{key.split('.')[1]} "
+                       f"{info['sst_bytes']}B {info['records']} records "
+                       f"{'P' if info['primary'] else 'S'}")
+        for app, t in sorted(totals.items()):
+            self.p(f"total {app}: {t['sst_bytes']}B across "
+                   f"{t['replicas']} replicas")
+
+    def cmd_multi_get_sortkeys(self, args):
+        complete, kvs = self._client().multi_get(args[0].encode(),
+                                                 no_value=True)
+        for sk in sorted(kvs):
+            self.p(f'"{c_escape_string(sk)}"')
+        self.p(f"{len(kvs)} sortkeys"
+               + ("" if complete else " (INCOMPLETE: server limit hit)"))
+
+    def cmd_multi_get_range(self, args):
+        complete, kvs = self._client().multi_get(
+            args[0].encode(), start_sortkey=args[1].encode(),
+            stop_sortkey=args[2].encode())
+        for sk in sorted(kvs):
+            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(kvs[sk])}"')
+        self.p(f"{len(kvs)} rows"
+               + ("" if complete else " (INCOMPLETE: server limit hit)"))
+
+    def cmd_multi_del_range(self, args):
+        cli = self._client()
+        hk = args[0].encode()
+        start, stop = args[1].encode(), args[2].encode()
+        deleted = 0
+        inclusive = True
+        while True:
+            # the server's RangeReadLimiter truncates big ranges: page from
+            # the last deleted sortkey until the read completes, or a
+            # 5000-row range would silently lose its tail
+            complete, kvs = cli.multi_get(hk, start_sortkey=start,
+                                          stop_sortkey=stop, no_value=True,
+                                          start_inclusive=inclusive)
+            if kvs:
+                deleted += cli.multi_del(hk, list(kvs))
+            if complete or not kvs:
+                break
+            start, inclusive = max(kvs), False
+        self.p(f"deleted {deleted} rows")
+
+    def cmd_clear_app_envs(self, args):
+        if not self.current_app:
+            raise PegasusError(4, "no table selected (use <name>)")
+        cfg = self._meta_call(RPC_CM_QUERY_CONFIG,
+                              mm.QueryConfigRequest(self.current_app),
+                              mm.QueryConfigResponse)
+        if cfg.error:
+            self.p(f"ERROR: {cfg.error_text}")
+            return
+        envs = [k for k, v in json.loads(cfg.app.envs_json).items() if v]
+        if not envs:
+            self.p("no envs set")
+            return
+        self.cmd_del_app_envs(envs)
+
+    def cmd_clear_data(self, args):
+        """Destructive: requires `clear_data <table> yes`."""
+        if len(args) < 2 or args[1] != "yes":
+            self.p("refusing: run `clear_data <table> yes` to confirm")
+            return
+        cli = PegasusClient(MetaResolver(self.meta_addrs, args[0], self.pool))
+        removed = 0
+        for scanner in cli.get_unordered_scanners():
+            batch = {}
+            for hk, sk, _ in scanner:
+                batch.setdefault(hk, []).append(sk)
+            for hk, sks in batch.items():
+                removed += cli.multi_del(hk, sks)
+        self.p(f"cleared {removed} rows from {args[0]}")
+
+    def cmd_get_meta_level(self, args):
+        from ..meta.meta_server import RPC_CM_CONTROL_META
+
+        r = self._meta_call(RPC_CM_CONTROL_META, mm.ControlMetaRequest(),
+                            mm.ControlMetaResponse)
+        self.p(f"meta level: {r.level}")
+
+    def cmd_set_meta_level(self, args):
+        from ..meta.meta_server import RPC_CM_CONTROL_META
+
+        r = self._meta_call(RPC_CM_CONTROL_META,
+                            mm.ControlMetaRequest(set_level=args[0]),
+                            mm.ControlMetaResponse)
+        self.p(f"ERROR: {r.error_text}" if r.error
+               else f"meta level: {r.level}")
+
+    def cmd_batched_manual_compact(self, args):
+        targets = ([n.address for n in self._nodes() if n.alive]
+                   if not args or args[0] == "all" else [args[0]])
+        for node in targets:
+            self.p(f"[{node}] "
+                   + self._node_command(node, "batched-manual-compact", []))
 
     # offline debuggers ---------------------------------------------------
     # (reference src/shell/commands/debugger.cpp: sst_dump / mlog_dump /

@@ -311,3 +311,89 @@ def test_drop_with_reserve_and_recall(tmp_path):
         assert aid4 not in c.meta._dropped
     finally:
         c.stop()
+
+
+def test_admin_shell_utilities(tmp_path):
+    """The round-3 shell sweep: version/timeout/hash/app_stat/app_disk/
+    multi_get_sortkeys/range ops/clear_app_envs/clear_data/meta levels."""
+    c = Cluster(tmp_path / "c")
+    try:
+        cl = make_client(c, app="ut", partitions=2)
+        for i in range(12):
+            cl.set(b"uh", b"sk%02d" % i, b"v%d" % i)
+        cl.set(b"other", b"s", b"x")
+        assert "pegasus-tpu" in shell_run(c, "version")
+        out = shell_run(c, "use ut\nhash uh sk01")  # single-line runner:
+        # run_line handles one line; drive via Shell object instead
+        import io
+
+        from pegasus_tpu.shell.main import Shell
+
+        buf = io.StringIO()
+        sh = Shell([c.meta_addr], out=buf)
+        sh.run_line("use ut")
+        sh.run_line("hash uh sk01")
+        assert "partition:" in buf.getvalue()
+        sh.run_line("timeout 2500")
+        assert "2500 ms" in buf.getvalue()
+        sh.run_line("multi_get_sortkeys uh")
+        assert "12 sortkeys" in buf.getvalue()
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("multi_get_range uh sk03 sk06")
+        assert "3 rows" in buf.getvalue()
+        sh.run_line("multi_del_range uh sk03 sk06")
+        assert "deleted 3 rows" in buf.getvalue()
+        assert cl.get(b"uh", b"sk04") is None
+        assert cl.get(b"uh", b"sk07") == b"v7"
+        # env set + clear round-trip
+        sh.run_line("set_app_envs default_ttl 99")
+        sh.run_line("clear_app_envs")
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("get_app_envs")
+        import json as _json
+
+        envs = _json.loads(buf.getvalue())
+        assert envs.get("default_ttl", "") == ""
+        # app_disk sees the table's replicas; app_stat aggregates
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("app_disk ut")
+        assert "total ut:" in buf.getvalue()
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("app_stat")
+        assert "ut" in buf.getvalue()
+        # meta levels: freezed blocks balancing AND redundancy rebuild
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("get_meta_level")
+        assert "lively" in buf.getvalue()
+        sh.run_line("set_meta_level freezed")
+        pc = c.meta._parts[cl.resolver.app_id][0]
+        victim = pc.secondaries[0]
+        c.kill_node(victim)
+        assert len([m for m in [pc.primary] + pc.secondaries if m]) == 2
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("balance")
+        # freezed -> balance REFUSES loudly (regression: deleting the level
+        # gate in _on_balance must fail here)
+        assert "ERROR" in buf.getvalue() and "freezed" in buf.getvalue()
+        sh.run_line("set_meta_level lively")
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("get_meta_level")
+        assert "lively" in buf.getvalue()
+        # clear_data with confirmation wipes the table
+        buf.seek(0)
+        buf.truncate(0)
+        sh.run_line("clear_data ut")
+        assert "refusing" in buf.getvalue()
+        sh.run_line("clear_data ut yes")
+        assert cl.get(b"uh", b"sk07") is None
+        assert cl.get(b"other", b"s") is None
+        cl.close()
+    finally:
+        c.stop()
