@@ -76,6 +76,13 @@ def _probe_backend(timeout_s=None):
     time-bounded SUBPROCESS: a wedged axon tunnel blocks device init
     forever in-process (watchdog can't help: the hang is in a C++ retry
     loop), and a killed probe child doesn't take the bench down."""
+    if os.environ.get("PEGASUS_BENCH_ASSUME_TPU") == "1":
+        # in-process caller (tools/tpu_oneshot.py) already holds a live
+        # backend session; a subprocess probe would contend for the single
+        # device lease and false-negative
+        import jax
+
+        return True, str(jax.devices()[0])
     timeout_s = timeout_s or float(os.environ.get("PEGASUS_BENCH_PROBE_S", 150))
     code = ("import jax\n"
             "import os\n"

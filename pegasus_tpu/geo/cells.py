@@ -56,6 +56,34 @@ def haversine_m(lat1, lng1, lat2, lng2) -> float:
     return 2 * EARTH_RADIUS_M * math.asin(min(1.0, math.sqrt(a)))
 
 
+def cell_bounds(cid: int, level: int):
+    """-> (lat_lo, lat_hi, lng_lo, lng_hi) of a level-L cell.
+
+    A Morton cell is a rectangle in quantized (lat, lng) space: at level L
+    the cell id carries L lat bits (even positions) and L lng bits (odd)."""
+    lat_q = lng_q = 0
+    for i in range(level):
+        lat_q |= ((cid >> (2 * i)) & 1) << i
+        lng_q |= ((cid >> (2 * i + 1)) & 1) << i
+    span_lat = 180.0 / (1 << level)
+    span_lng = 360.0 / (1 << level)
+    lat_lo = -90.0 + lat_q * span_lat
+    lng_lo = -180.0 + lng_q * span_lng
+    return lat_lo, lat_lo + span_lat, lng_lo, lng_lo + span_lng
+
+
+def cell_intersects_circle(cid: int, level: int, lat: float, lng: float,
+                           radius_m: float) -> bool:
+    """True when the cell rectangle and the search circle overlap: the
+    haversine distance from the center to the nearest point of the
+    rectangle is within the radius (no longitude wraparound — callers
+    search city-scale radii)."""
+    lat_lo, lat_hi, lng_lo, lng_hi = cell_bounds(cid, level)
+    nlat = min(lat_hi, max(lat_lo, lat))
+    nlng = min(lng_hi, max(lng_lo, lng))
+    return haversine_m(lat, lng, nlat, nlng) <= radius_m
+
+
 MAX_COVERING_CELLS = 4096
 
 
@@ -85,3 +113,63 @@ def covering_cells(lat: float, lng: float, radius_m: float, level: int) -> list:
             if len(cells) >= MAX_COVERING_CELLS:
                 return sorted(cells)
     return sorted(cells)
+
+
+MAX_RANGES_PER_CELL = 4    # like S2RegionCoverer's max_cells budget
+FULL_SCAN_FRACTION = 0.5   # ranges covering most of a cell -> scan it all
+
+
+def covering_ranges(lat: float, lng: float, radius_m: float, level: int,
+                    max_level: int) -> dict:
+    """Two-level covering for range-narrowed scans (the reference's
+    gen_start_sort_key/gen_stop_sort_key, geo_client.cpp:433-454): cover
+    the circle with level-`max_level` cells, then group them under their
+    level-`level` ancestors.
+
+    -> {ancestor_cell_id: None | [(start_morton, stop_morton)]}: None means
+    the whole ancestor cell intersects (scan it all); otherwise the sorted,
+    merged list of full-60-bit Morton ranges (stop exclusive) covering the
+    circle inside that cell — everything outside the ranges is provably
+    outside the circle and is never read."""
+    if max_level <= level:
+        return {c: None for c in covering_cells(lat, lng, radius_m, level)}
+    shift_m = 2 * (_BITS - max_level)
+    rel = 2 * (max_level - level)
+    raw = covering_cells(lat, lng, radius_m, max_level)
+    # a capped covering at max_level has holes (early return mid-grid);
+    # fall back to whole-cell scans at the coarse level rather than
+    # silently missing results — the cap must be tested BEFORE the circle
+    # filter, which can shrink an incomplete covering back under the cap
+    if len(raw) >= MAX_COVERING_CELLS:
+        return {c: None for c in covering_cells(lat, lng, radius_m, level)}
+    deep = [c for c in raw
+            if cell_intersects_circle(c, max_level, lat, lng, radius_m)]
+    out = {}
+    full = 1 << rel  # descendants per ancestor
+    by_anc = {}
+    for c in deep:
+        by_anc.setdefault(c >> rel, []).append(c)
+    for anc, children in by_anc.items():
+        # a scan task costs a round trip: nearly-full cells scan whole, and
+        # the range count per cell is budgeted by merging the smallest gaps
+        # (the role of S2RegionCoverer's max_cells budget)
+        if len(children) >= full * FULL_SCAN_FRACTION:
+            out[anc] = None
+            continue
+        ranges = []
+        start = prev = children[0]
+        for c in children[1:]:
+            if c == prev + 1:
+                prev = c
+                continue
+            ranges.append([start, prev + 1])
+            start = prev = c
+        ranges.append([start, prev + 1])
+        while len(ranges) > MAX_RANGES_PER_CELL:
+            gaps = [(ranges[i + 1][0] - ranges[i][1], i)
+                    for i in range(len(ranges) - 1)]
+            _, i = min(gaps)
+            ranges[i][1] = ranges[i + 1][1]
+            del ranges[i + 1]
+        out[anc] = [(s << shift_m, e << shift_m) for s, e in ranges]
+    return out

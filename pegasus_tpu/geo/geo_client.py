@@ -18,6 +18,9 @@ filter by precise haversine distance, and sort/limit (the reference's
 cap-covering + parallel scans, geo_client.cpp:257-330).
 """
 
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
 from ..client import PegasusClient
 from . import cells
 from .latlng_codec import LatlngCodec
@@ -41,11 +44,40 @@ def _split_geo_sort_key(gsk: bytes):
 
 class GeoClient:
     def __init__(self, common_client: PegasusClient, geo_client: PegasusClient,
-                 min_level: int = 12, codec: LatlngCodec = None):
+                 min_level: int = 12, max_level: int = 16,
+                 codec: LatlngCodec = None, scan_threads: int = 8):
         self.common = common_client
         self.geo = geo_client
         self.min_level = min_level
+        # searches narrow each covered cell to level-`max_level` sub-ranges
+        # of the Morton sort key (the reference's min_level/max_level pair,
+        # geo_client.h:83; S2 16 ~= Morton 16 at city scale)
+        self.max_level = max_level
         self.codec = codec or LatlngCodec()
+        self.scan_threads = scan_threads
+        self._pool = None
+        self._pool_lock = threading.Lock()
+
+    def _executor(self):
+        if self._pool is None:
+            with self._pool_lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
+                        self.scan_threads, thread_name_prefix="geo-scan")
+        return self._pool
+
+    def close(self) -> None:
+        """Shut down the scan pool (the clients are closed by their owner)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     # ------------------------------------------------------------- indexing
 
@@ -90,23 +122,52 @@ class GeoClient:
 
     # -------------------------------------------------------------- search
 
+    def _scan_one(self, ghk: bytes, start_sk: bytes, stop_sk: bytes,
+                  lat: float, lng: float, radius_m: float) -> list:
+        out = []
+        for _, gsk, value in self.geo.get_scanner(
+                ghk, start_sort_key=start_sk, stop_sort_key=stop_sk,
+                batch_size=500):
+            latlng = self.codec.decode(value)
+            if latlng is None:
+                continue
+            d = cells.haversine_m(lat, lng, latlng[0], latlng[1])
+            if d > radius_m:
+                continue
+            keys = _split_geo_sort_key(gsk)
+            if keys is None:
+                continue
+            out.append((d, keys[0], keys[1], value))
+        return out
+
     def search_radial(self, lat: float, lng: float, radius_m: float,
                       count: int = -1, sort_by_distance: bool = True) -> list:
-        """-> [(distance_m, hash_key, sort_key, value)] within the circle."""
-        out = []
-        for cid in cells.covering_cells(lat, lng, radius_m, self.min_level):
+        """-> [(distance_m, hash_key, sort_key, value)] within the circle.
+
+        Each covered min_level cell is narrowed to the Morton sort-key
+        ranges that intersect the circle at max_level (reference
+        gen_start/stop_sort_key, geo_client.cpp:433-454), and the range
+        scans run concurrently (the reference's parallel cell scans,
+        geo_client.cpp:257-330)."""
+        tasks = []
+        ranges = cells.covering_ranges(lat, lng, radius_m,
+                                       self.min_level, self.max_level)
+        for cid, spans in sorted(ranges.items()):
             ghk = cells.cell_token(cid, self.min_level)
-            for _, gsk, value in self.geo.get_scanner(ghk, batch_size=500):
-                latlng = self.codec.decode(value)
-                if latlng is None:
-                    continue
-                d = cells.haversine_m(lat, lng, latlng[0], latlng[1])
-                if d > radius_m:
-                    continue
-                keys = _split_geo_sort_key(gsk)
-                if keys is None:
-                    continue
-                out.append((d, keys[0], keys[1], value))
+            if spans is None:
+                tasks.append((ghk, b"", b""))
+                continue
+            for start_m, stop_m in spans:
+                stop_sk = (b"" if stop_m >= (1 << 60)
+                           else b"%015x" % stop_m)
+                tasks.append((ghk, b"%015x" % start_m, stop_sk))
+        if len(tasks) > 1 and self.scan_threads > 1:
+            chunks = self._executor().map(
+                lambda t: self._scan_one(*t, lat, lng, radius_m), tasks)
+            out = [r for chunk in chunks for r in chunk]
+        else:
+            out = [r for t in tasks
+                   for r in self._scan_one(*t, lat, lng, radius_m)]
         if sort_by_distance:
             out.sort(key=lambda t: t[0])
         if count > 0:

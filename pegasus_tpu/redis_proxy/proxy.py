@@ -8,6 +8,7 @@ onto the geo client's dual-table index. Any redis client (redis-cli,
 libraries) can talk to a pegasus-tpu cluster through it.
 """
 
+import socket
 import socketserver
 import threading
 
@@ -89,6 +90,8 @@ class RedisProxy:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
                 while True:
                     try:
                         args = read_command(self.rfile)

@@ -98,6 +98,8 @@ class RpcServer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
                 wlock = threading.Lock()
                 try:
                     while True:
@@ -171,6 +173,9 @@ class RpcConnection:
         self.addr = tuple(addr)
         self._sock = socket.create_connection(self.addr, timeout=connect_timeout)
         self._sock.settimeout(None)
+        # rpc frames are small request/response pairs: Nagle + delayed ACK
+        # turns concurrent small calls into ~40ms stalls
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         self._pending = {}   # seq -> (event, slot)

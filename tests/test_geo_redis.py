@@ -77,6 +77,101 @@ def test_geo_set_get_search(geo):
     assert {sk for _, _, sk, _ in after} == {b"p1"}
 
 
+def test_covering_ranges_properties():
+    lat, lng, radius = 31.2304, 121.4737, 800.0
+    ranges = cells.covering_ranges(lat, lng, radius, 12, 16)
+    # every range lies inside its ancestor cell, sorted and non-overlapping
+    for anc, spans in ranges.items():
+        if spans is None:
+            continue
+        lo = anc << (2 * (30 - 12))
+        hi = (anc + 1) << (2 * (30 - 12))
+        prev = lo
+        for start, stop in spans:
+            assert lo <= start < stop <= hi
+            assert start >= prev
+            prev = stop
+    # the narrowed covering keeps every in-circle point reachable
+    import random
+
+    rnd = random.Random(7)
+    for _ in range(300):
+        # points across the circle incl. near-boundary
+        ang = rnd.random() * 6.283185
+        r = radius * rnd.random() ** 0.5
+        import math
+
+        pla = lat + math.degrees(r * math.cos(ang) / cells.EARTH_RADIUS_M)
+        pln = lng + math.degrees(
+            r * math.sin(ang) / (cells.EARTH_RADIUS_M
+                                 * math.cos(math.radians(lat))))
+        if cells.haversine_m(lat, lng, pla, pln) > radius:
+            continue
+        m = cells.morton(pla, pln)
+        anc = m >> (2 * (30 - 12))
+        spans = ranges.get(anc, "missing")
+        assert spans != "missing"
+        assert spans is None or any(s <= m < e for s, e in spans)
+    # narrowing reads strictly less than whole-cell scans would
+    spanned = sum(e - s for spans in ranges.values() if spans
+                  for s, e in spans)
+    whole = sum(1 << (2 * (30 - 12)) for spans in ranges.values()
+                if spans is not None)
+    assert spanned < whole or whole == 0
+
+
+def test_covering_ranges_large_radius_complete():
+    # radius big enough that the max_level covering hits MAX_COVERING_CELLS:
+    # the whole-cell fallback must fire (the cap check runs BEFORE the
+    # circle filter — checking after dropped ~32% of a 15km circle)
+    import math
+    import random
+
+    lat, lng, radius = 40.06, 116.4, 15000.0
+    ranges = cells.covering_ranges(lat, lng, radius, 12, 16)
+    rnd = random.Random(3)
+    for _ in range(400):
+        ang = rnd.random() * 6.283185
+        r = radius * rnd.random() ** 0.5
+        pla = lat + math.degrees(r * math.cos(ang) / cells.EARTH_RADIUS_M)
+        pln = lng + math.degrees(
+            r * math.sin(ang) / (cells.EARTH_RADIUS_M
+                                 * math.cos(math.radians(lat))))
+        if cells.haversine_m(lat, lng, pla, pln) > radius:
+            continue
+        m = cells.morton(pla, pln)
+        spans = ranges.get(m >> (2 * (30 - 12)))
+        assert spans is None or any(s <= m < e for s, e in spans), \
+            "in-circle point unreachable at 15km radius"
+
+
+def test_search_radial_narrowed_matches_bruteforce(geo):
+    import random
+
+    rnd = random.Random(11)
+    pts = {}
+    for i in range(60):
+        name = b"n%03d" % i
+        pla = 30.0 + rnd.random() * 0.02     # ~2.2km box
+        pln = 120.0 + rnd.random() * 0.02
+        pts[name] = (pla, pln)
+        geo.set(b"grid", name, val(pla, pln, name))
+    center, radius = (30.01, 120.01), 600.0
+    want = {n for n, (a, b) in pts.items()
+            if cells.haversine_m(center[0], center[1], a, b) <= radius}
+    hits = geo.search_radial(center[0], center[1], radius)
+    got = {sk for _, hk, sk, _ in hits if hk == b"grid"}
+    assert got == want
+    # serial path returns the same thing as the threaded one
+    geo.scan_threads, saved = 1, geo.scan_threads
+    try:
+        hits2 = geo.search_radial(center[0], center[1], radius)
+    finally:
+        geo.scan_threads = saved
+    assert [h[2] for h in hits2 if h[1] == b"grid"] == \
+           [h[2] for h in hits if h[1] == b"grid"]
+
+
 @pytest.fixture(scope="module")
 def redis_sock(cluster, geo):
     cli = cluster.create("redis_kv", partitions=2)

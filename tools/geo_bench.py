@@ -40,7 +40,9 @@ def main():
             c.create("geo_idx", partitions=4).close()
             geo = GeoClient(
                 PegasusClient(MetaResolver([c.meta_addr], "geo_main")),
-                PegasusClient(MetaResolver([c.meta_addr], "geo_idx")))
+                PegasusClient(MetaResolver([c.meta_addr], "geo_idx")),
+                max_level=int(os.environ.get("PEGASUS_GEO_MAX_LEVEL", 16)),
+                scan_threads=int(os.environ.get("PEGASUS_GEO_THREADS", 8)))
             # fill: a ~20km box around 40.06N 116.4E (the reference's
             # bench geography)
             t0 = time.perf_counter()

@@ -1,0 +1,202 @@
+"""Single-process, single-lease TPU validation + benchmark session.
+
+Why this exists: the axon tunnel hands out ONE device lease, and (as
+observed live on 2026-07-29) the lease is not always released when a
+client process exits — the FIRST client after a long-idle period gets
+in, every later backend init sleeps in the plugin's retry loop until
+some long server-side timeout. tools/tpu_session.py's design (a fresh
+subprocess per stage) is therefore exactly wrong on this tunnel: stage 1
+(probe) consumed the day's lease and stages 2+ starved.
+
+This script makes ONE connection and never lets it go until every stage
+is done, in-process:
+
+  1. init      — jax.devices() (blocks however long the lease takes;
+                 run under a parent timeout, never SIGKILL)
+  2. kernels   — small-N byte-equality cpu vs tpu (xla network path and
+                 cached-device-run path)
+  3. pallas    — toggle PEGASUS_PALLAS in-process (clearing the compiled
+                 pipeline caches), same equality check
+  4. bench     — bench.py main() in-process at PEGASUS_BENCH_N
+                 (PEGASUS_BENCH_ASSUME_TPU=1 skips its subprocess probe),
+                 with pallas off, then on if stage 3 passed
+  5. engine    — tools/engine_bench.py main() in-process
+
+Progress appends to TPU_SESSION.log after every stage so a mid-session
+tunnel death still leaves completed stages recorded.
+
+Usage: python tools/tpu_oneshot.py [--stages init,kernels,pallas,bench,engine]
+"""
+
+import argparse
+import io
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+LOG = os.path.join(REPO, "TPU_SESSION.log")
+
+
+def log(line: str):
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(f"[{stamp}] oneshot: {line}\n")
+    print(f"[{stamp}] {line}", flush=True)
+
+
+def _clear_pipeline_caches():
+    from pegasus_tpu.ops import compact
+
+    compact._compiled_pipeline.cache_clear()
+    compact._compiled_pipeline_cached.cache_clear()
+
+
+def _kernel_equality() -> bool:
+    """Small-N byte-equality: cpu vs tpu (host-packed) vs cached device
+    runs, under whatever PEGASUS_PALLAS currently says."""
+    import numpy as np
+
+    import tests.test_compact_ops as t
+    from pegasus_tpu.ops.compact import (CompactOptions, compact_blocks,
+                                         pack_run_device, sort_block)
+
+    rng = np.random.default_rng(5)
+    recs = [(b"u%05d" % rng.integers(0, 300), b"s%d" % (i % 5),
+             b"v%d" % i, 0, bool(rng.random() < .1)) for i in range(3000)]
+    runs = [sort_block(t.make_block(p), CompactOptions(backend="cpu"))
+            for p in (recs[:1500], recs[1500:])]
+    o = dict(now=100, bottommost=True, runs_sorted=True)
+    cpu = compact_blocks(runs, CompactOptions(backend="cpu", **o))
+    tpu = compact_blocks(runs, CompactOptions(backend="tpu", **o))
+    drs = [pack_run_device(b) for b in runs]
+    cch = compact_blocks(runs, CompactOptions(backend="tpu", **o),
+                         device_runs=drs)
+    for x in (tpu, cch):
+        assert np.array_equal(cpu.block.key_arena, x.block.key_arena)
+        assert np.array_equal(cpu.block.val_arena, x.block.val_arena)
+    return True
+
+
+def stage_init() -> bool:
+    import jax
+
+    from pegasus_tpu.base.utils import enable_compile_cache
+
+    t0 = time.time()
+    dev = jax.devices()[0]
+    import jax.numpy as jnp
+
+    assert int(jnp.arange(64).sum()) == 2016
+    enable_compile_cache(REPO)
+    log(f"init: lease acquired after {time.time() - t0:.1f}s — {dev}")
+    return True
+
+
+def stage_kernels() -> bool:
+    os.environ.pop("PEGASUS_PALLAS", None)
+    t0 = time.time()
+    ok = _kernel_equality()
+    log(f"kernels(xla+cached): BYTE_EQUAL in {time.time() - t0:.1f}s")
+    return ok
+
+
+def stage_pallas() -> bool:
+    os.environ["PEGASUS_PALLAS"] = "1"
+    _clear_pipeline_caches()
+    t0 = time.time()
+    try:
+        ok = _kernel_equality()
+        log(f"pallas: BYTE_EQUAL in {time.time() - t0:.1f}s")
+        return ok
+    except Exception as e:  # noqa: BLE001 - record, fall back, continue
+        log(f"pallas: FAILED on hardware after {time.time() - t0:.1f}s: "
+            f"{type(e).__name__}: {str(e)[:300]}")
+        for ln in traceback.format_exc().splitlines()[-8:]:
+            log(f"  pallas-tb: {ln}")
+        return False
+    finally:
+        os.environ.pop("PEGASUS_PALLAS", None)
+        _clear_pipeline_caches()
+
+
+def _run_bench(tag: str):
+    import bench
+
+    buf = io.StringIO()
+    real = sys.stdout
+    t0 = time.time()
+    try:
+        sys.stdout = buf
+        bench.main()
+    finally:
+        sys.stdout = real
+        bench._RESULT_PRINTED = False
+    for line in buf.getvalue().strip().splitlines():
+        log(f"bench[{tag}]: {line}")
+    log(f"bench[{tag}]: done in {time.time() - t0:.1f}s")
+
+
+def stage_bench(pallas_ok: bool):
+    os.environ.setdefault("PEGASUS_BENCH_N", "10000000")
+    os.environ["PEGASUS_BENCH_ASSUME_TPU"] = "1"
+    os.environ["PEGASUS_BENCH_TIMEOUT_S"] = "0"  # parent owns the watchdog
+    os.environ.pop("PEGASUS_PALLAS", None)
+    _run_bench("xla")
+    if pallas_ok:
+        os.environ["PEGASUS_PALLAS"] = "1"
+        _clear_pipeline_caches()
+        try:
+            _run_bench("pallas")
+        finally:
+            os.environ.pop("PEGASUS_PALLAS", None)
+            _clear_pipeline_caches()
+
+
+def stage_engine():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import engine_bench
+
+    buf = io.StringIO()
+    real = sys.stdout
+    t0 = time.time()
+    try:
+        sys.stdout = buf
+        engine_bench.main()
+    finally:
+        sys.stdout = real
+    for line in buf.getvalue().strip().splitlines():
+        log(f"engine: {line}")
+    log(f"engine: done in {time.time() - t0:.1f}s")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default="init,kernels,pallas,bench,engine")
+    args = ap.parse_args()
+    stages = args.stages.split(",")
+    log(f"=== oneshot start (pid {os.getpid()}, stages {stages}) ===")
+    try:
+        if "init" in stages and not stage_init():
+            sys.exit(3)
+        if "kernels" in stages and not stage_kernels():
+            log("=== aborted: xla kernel equality failed ===")
+            sys.exit(4)
+        pallas_ok = stage_pallas() if "pallas" in stages else False
+        if "bench" in stages:
+            stage_bench(pallas_ok)
+        if "engine" in stages:
+            stage_engine()
+    except Exception as e:  # noqa: BLE001 - log whatever stage died
+        log(f"FATAL {type(e).__name__}: {str(e)[:300]}")
+        for ln in traceback.format_exc().splitlines()[-10:]:
+            log(f"  tb: {ln}")
+        sys.exit(1)
+    log("=== oneshot done ===")
+
+
+if __name__ == "__main__":
+    main()
