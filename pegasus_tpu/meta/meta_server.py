@@ -549,7 +549,59 @@ class MetaServer:
                 self._persist_locked()
             self._install_partition(app, pc)
             moved += 1
+        moved += self._balance_copy_secondary()
         return codec.encode(mm.BalanceResponse(moved=moved))
+
+    def _balance_copy_secondary(self) -> int:
+        """Total-replica equalization (greedy_load_balancer's copy_secondary
+        stage): while the most-loaded node holds 2+ more REPLICAS than the
+        least-loaded, migrate one secondary heavy->light — seed the light
+        node as a learner (synchronous checkpoint+log-tail learn), admit it
+        as a secondary, then drop the heavy copy. Primary moves alone
+        equalize leadership but leave replica-count (disk/IO) skew."""
+        moved = 0
+        for _ in range(64):
+            with self._lock:
+                alive = self._alive_nodes_locked()
+                if len(alive) < 2:
+                    break
+                loads = {a: self._node_load_locked(a) for a in alive}
+                heavy = max(alive, key=lambda a: loads[a])
+                light = min(alive, key=lambda a: loads[a])
+                if loads[heavy] - loads[light] < 2:
+                    break
+                move = None
+                for app in self._apps.values():
+                    for pc in self._parts[app.app_id]:
+                        if (heavy in pc.secondaries and pc.primary != light
+                                and light not in pc.secondaries):
+                            move = (app, pc)
+                            break
+                    if move:
+                        break
+                if move is None:
+                    break
+                app, pc = move
+                pc.ballot += 1
+                self._persist_locked()
+            # seed the light node (learn is synchronous inside the RPC),
+            # then admit it and re-push so it starts receiving prepares
+            self._install_partition(app, pc, learners=[light])
+            with self._lock:
+                pc.secondaries.append(light)
+                self._persist_locked()
+            self._install_partition(app, pc)
+            # now drop the heavy copy
+            with self._lock:
+                pc.ballot += 1
+                pc.secondaries.remove(heavy)
+                self._persist_locked()
+            self._install_partition(app, pc)
+            self._send_to_node(heavy, RPC_CLOSE_REPLICA,
+                               mm.CloseReplicaRequest(app.app_id, pc.pidx),
+                               ignore_errors=True)
+            moved += 1
+        return moved
 
     # ---------------------------------------------------------- duplication
 

@@ -235,6 +235,49 @@ def test_propose_and_balance(cluster):
     c.close()
 
 
+def test_balance_copy_secondary_to_new_node(tmp_path):
+    """A node added to a loaded cluster starts empty; balance must migrate
+    replicas onto it (greedy_load_balancer's copy_secondary stage), not
+    just shuffle primaries among the old members."""
+    c = Cluster(tmp_path)
+    try:
+        cl = make_client(c, app="cpbal", partitions=8)
+        for i in range(32):
+            cl.set(b"cp%d" % i, b"s", b"v%d" % i)
+        new = ReplicaStub(str(tmp_path / "node_new"), [c.meta_addr],
+                          options_factory=lambda: EngineOptions(backend="cpu"))
+        new.start(beacon_interval=0.2)
+        c.nodes[new.address] = new
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if new.address in c.meta._alive_nodes_locked():
+                break
+            time.sleep(0.1)
+        assert new.address in c.meta._alive_nodes_locked()
+        r = c.ddl("RPC_CM_START_BALANCE", mm.BalanceRequest(),
+                  mm.BalanceResponse)
+        assert r.error == 0 and r.moved > 0
+        with c.meta._lock:
+            loads = {a: c.meta._node_load_locked(a)
+                     for a in c.meta._alive_nodes_locked()}
+        assert loads[new.address] > 0, "new node received no replicas"
+        assert max(loads.values()) - min(loads.values()) < 2
+        # membership stays 3-wide and disjoint per partition
+        app_id = cl.resolver.app_id
+        for pc in c.meta._parts[app_id]:
+            members = [pc.primary] + pc.secondaries
+            assert len(members) == 3 and len(set(members)) == 3
+        # every record still served after the migrations
+        for i in range(32):
+            assert cl.get(b"cp%d" % i, b"s") == b"v%d" % i
+        # writes still replicate (quorum intact through moved members)
+        cl.set(b"cp_post", b"s", b"after")
+        assert cl.get(b"cp_post", b"s") == b"after"
+        cl.close()
+    finally:
+        c.stop()
+
+
 def test_backup_request_reads_from_secondary(tmp_path):
     """backup_request serves reads from a secondary while the primary is
     down and the FD grace has NOT yet expired (no reconfiguration)."""
