@@ -11,9 +11,11 @@ shell/collector (reference on_detect_hotkey, pegasus_server_impl.cpp:2976).
 """
 
 import threading
+import time
 from collections import Counter as PyCounter
 
 BUCKETS = 37  # prime bucket count, like the reference's FIND_BUCKET macro
+MAX_DETECT_SECONDS = 150  # reference FLAGS_max_seconds_to_detect_hotkey
 
 STOPPED = "STOPPED"
 COARSE = "COARSE_DETECTING"
@@ -32,11 +34,14 @@ class HotkeyCollector:
     """One collector per (replica, READ|WRITE) kind."""
 
     def __init__(self, kind: str, coarse_threshold: int = 100,
-                 fine_threshold: int = 50):
+                 fine_threshold: int = 50,
+                 max_seconds: float = MAX_DETECT_SECONDS):
         self.kind = kind
         self.state = STOPPED
         self.coarse_threshold = coarse_threshold
         self.fine_threshold = fine_threshold
+        self.max_seconds = max_seconds
+        self._deadline = 0.0
         self._lock = threading.Lock()
         self._buckets = [0] * BUCKETS
         self._hot_bucket = -1
@@ -52,6 +57,9 @@ class HotkeyCollector:
             self._hot_bucket = -1
             self.result = None
             self.state = COARSE
+            # a detection that never converges self-terminates (reference
+            # terminate_if_timeout, FLAGS_max_seconds_to_detect_hotkey)
+            self._deadline = time.monotonic() + self.max_seconds
             return f"{self.kind} hotkey detection started (coarse)"
 
     def stop(self) -> str:
@@ -63,12 +71,22 @@ class HotkeyCollector:
         with self._lock:
             if self.state == FINISHED and self.result is not None:
                 return (f"{self.kind} hotkey: {self.result!r}")
+            if (self.state in (COARSE, FINE)
+                    and time.monotonic() >= self._deadline):
+                self.state = STOPPED
+                return (f"{self.kind} detection state: {STOPPED} "
+                        "(timed out without an outlier)")
             return f"{self.kind} detection state: {self.state}"
 
     # -------------------------------------------------------------- capture
 
     def capture(self, hash_key: bytes, weight: int = 1) -> None:
         if self.state == STOPPED or self.state == FINISHED:
+            return
+        if time.monotonic() >= self._deadline:
+            with self._lock:
+                if self.state in (COARSE, FINE):
+                    self.state = STOPPED
             return
         with self._lock:
             if self.state == COARSE:

@@ -179,6 +179,12 @@ def test_hotkey_state_machine():
 def test_hotkey_uniform_load_finds_nothing():
     hc = HotkeyCollector("write", coarse_threshold=50)
     hc.start()
+    hc.max_seconds = 0.0
+    hc._deadline = 0.0  # already past: next capture must self-terminate
+    hc.capture(b"k")
+    assert "STOPPED" in hc.query()
+    hc = HotkeyCollector("write", coarse_threshold=50)
+    hc.start()
     for i in range(300):
         hc.capture(b"k%d" % i)
     assert hc.state in (COARSE, FINE)  # never FINISHED on uniform load
