@@ -91,9 +91,22 @@ class RpcServer:
     A handler may raise RpcError to return an rpc-level error. Handlers run
     on the connection's thread (the engine has its own locking)."""
 
+    # requests run on a shared worker pool (a thread spawn per request cost
+    # ~60us x thousands/s on the serving path); when every worker is busy —
+    # e.g. blocked in group-commit waits or a long learn — overflow requests
+    # get a fresh thread so a saturated pool can never deadlock behind its
+    # own blocked work
+    POOL_WORKERS = 16
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers = {}
         self._middlewares = []   # fn(code, header, body, next) -> body
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(self.POOL_WORKERS,
+                                        thread_name_prefix="rpc-serve")
+        self._busy = 0
+        self._busy_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -104,11 +117,7 @@ class RpcServer:
                 try:
                     while True:
                         header, body = _recv_frame(self.request)
-                        t = threading.Thread(
-                            target=outer._serve_one,
-                            args=(self.request, wlock, header, body),
-                            daemon=True)
-                        t.start()
+                        outer._dispatch(self.request, wlock, header, body)
                 except (ConnectionError, OSError):
                     pass
 
@@ -141,6 +150,26 @@ class RpcServer:
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        self._pool.shutdown(wait=False)
+
+    def _dispatch(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
+        with self._busy_lock:
+            overflow = self._busy >= self.POOL_WORKERS
+            if not overflow:
+                self._busy += 1
+        if overflow:
+            threading.Thread(target=self._serve_one,
+                             args=(sock, wlock, header, body),
+                             daemon=True).start()
+        else:
+            self._pool.submit(self._serve_pooled, sock, wlock, header, body)
+
+    def _serve_pooled(self, sock, wlock, header, body) -> None:
+        try:
+            self._serve_one(sock, wlock, header, body)
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
 
     def _serve_one(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
         resp = RpcHeader(seq=header.seq, code=header.code, is_response=True)
