@@ -18,7 +18,7 @@ from ..base import key_schema
 from ..rpc import codec
 from ..rpc import messages as msg
 from ..rpc.messages import Status
-from ..rpc.transport import (ConnectionPool, ERR_INVALID_STATE,
+from ..rpc.transport import (ConnectionPool, ERR_BUSY, ERR_INVALID_STATE,
                              ERR_NETWORK_FAILURE, ERR_OBJECT_NOT_FOUND,
                              ERR_TIMEOUT, RpcError)
 from ..engine import replica_service as codes
@@ -119,6 +119,10 @@ class PegasusClient:
                         if backup is not None:
                             return backup[0]
                     continue  # re-resolve (reconfiguration / failover)
+                if e.err == ERR_BUSY:
+                    # throttled (reference PERR_APP_BUSY): the caller decides
+                    # whether to back off and retry — no transparent retry
+                    raise PegasusError(Status.TRY_AGAIN, str(e))
                 raise PegasusError(Status.IO_ERROR, str(e))
         raise PegasusError(Status.TRY_AGAIN, str(last))
 

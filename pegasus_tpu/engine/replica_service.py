@@ -18,7 +18,8 @@ import time
 
 from ..rpc import codec
 from ..rpc import messages as msg
-from ..rpc.transport import (ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError)
+from ..rpc.transport import (ERR_BUSY, ERR_INVALID_STATE,
+                             ERR_OBJECT_NOT_FOUND, RpcError)
 from . import server_impl
 from .server_impl import PegasusServer
 
@@ -134,6 +135,28 @@ class ReplicaService:
         req_cls, _ = WRITE_CODES[header.code]
         req = codec.decode(req_cls, body)
         srv = self._replica(header)
+        # per-table throttling gates the request BEFORE any decree work
+        # (reference: rDSN throttling_controller consulted on the primary,
+        # env replica.write_throttling[_by_size])
+        from .throttling import ThrottleReject
+
+        from ..runtime.perf_counters import counters
+
+        try:
+            d0 = (srv.write_qps_throttler.delayed_count
+                  + srv.write_size_throttler.delayed_count)
+            srv.write_qps_throttler.consume(1)
+            srv.write_size_throttler.consume(len(body))
+            if (srv.write_qps_throttler.delayed_count
+                    + srv.write_size_throttler.delayed_count) > d0:
+                counters.rate(
+                    f"app.{srv.app_id}.{srv.pidx}."
+                    "recent_write_throttling_delay_count").increment()
+        except ThrottleReject as e:
+            counters.rate(
+                f"app.{srv.app_id}.{srv.pidx}."
+                "recent_write_throttling_reject_count").increment()
+            raise RpcError(ERR_BUSY, str(e))
         router = self._write_router
         if router is not None:
             resp = router(srv, header.code, req)

@@ -77,6 +77,10 @@ class PegasusServer:
 
         self.read_hotkey = HotkeyCollector("read")
         self.write_hotkey = HotkeyCollector("write")
+        from .throttling import ThrottlingController
+
+        self.write_qps_throttler = ThrottlingController()
+        self.write_size_throttler = ThrottlingController()
         self.cu_calculator = CapacityUnitCalculator(
             app_id, pidx, read_hotkey=self.read_hotkey,
             write_hotkey=self.write_hotkey)
@@ -102,6 +106,17 @@ class PegasusServer:
             except (TypeError, ValueError):
                 print(f"[app-envs] bad {consts.ENV_SLOW_QUERY_THRESHOLD}="
                       f"{sq!r} ignored", flush=True)
+        # per-table write throttling (reference replica.write_throttling
+        # env -> rDSN throttling_controller; by-qps and by-request-size)
+        for env_key, ctl in ((consts.ENV_WRITE_THROTTLING,
+                              self.write_qps_throttler),
+                             (consts.ENV_WRITE_THROTTLING_BY_SIZE,
+                              self.write_size_throttler)):
+            v = envs.get(env_key)
+            if v is not None and v != ctl.env_value:
+                if not ctl.parse_from_env(v):
+                    print(f"[app-envs] bad {env_key}={v!r} ignored",
+                          flush=True)
         # abnormal request/response SIZE tracing (reference
         # pegasus_server_impl.h:317-343 _abnormal_*_threshold gflags;
         # 0 = disabled): oversized reads are logged + counted even when fast

@@ -1,0 +1,98 @@
+"""Per-table write throttling (reference: rDSN throttling_controller
+consumed through the `replica.write_throttling[_by_size]` app-envs; the
+pegasus surface is the env keys plus the delay/reject perf counters the
+collector aggregates, src/server/info_collector.h:73-81).
+
+Env value grammar (the reference's parse_from_env):
+
+    "20000*delay*100"                   delay 100ms once >20000 units/s
+    "20000*delay*100,30000*reject*10"   ...and reject (after a 10ms pause)
+                                        once >30000 units/s
+    "30000"                             bare number: reject above it
+
+Units are requests for `replica.write_throttling`, request-body bytes for
+`replica.write_throttling_by_size`. Accounting is a per-second tumbling
+window, like the reference's token-refresh-per-second controller.
+"""
+
+import threading
+import time
+
+
+class ThrottleReject(Exception):
+    """Raised when the reject threshold fires (mapped to ERR_BUSY)."""
+
+
+class ThrottlingController:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.delay_units = 0
+        self.delay_ms = 0
+        self.reject_units = 0
+        self.reject_delay_ms = 0
+        self.env_value = ""
+        self._window_start = 0
+        self._window_units = 0
+        # the counters the reference publishes per replica
+        self.delayed_count = 0
+        self.rejected_count = 0
+
+    def parse_from_env(self, value: str) -> bool:
+        """Apply an env string; empty disables. -> False on a malformed
+        value (the old setting stays, like the reference's validator)."""
+        value = (value or "").strip()
+        delay_units = delay_ms = reject_units = reject_delay_ms = 0
+        if value:
+            try:
+                for tok in value.split(","):
+                    parts = tok.strip().split("*")
+                    if len(parts) == 1:
+                        reject_units, reject_delay_ms = int(parts[0]), 0
+                    elif len(parts) == 3 and parts[1] == "delay":
+                        delay_units, delay_ms = int(parts[0]), int(parts[2])
+                    elif len(parts) == 3 and parts[1] == "reject":
+                        reject_units = int(parts[0])
+                        reject_delay_ms = int(parts[2])
+                    else:
+                        return False
+                    if min(delay_units, delay_ms,
+                           reject_units, reject_delay_ms) < 0:
+                        return False
+            except ValueError:
+                return False
+        with self._lock:
+            self.env_value = value
+            self.enabled = bool(value)
+            self.delay_units, self.delay_ms = delay_units, delay_ms
+            self.reject_units = reject_units
+            self.reject_delay_ms = reject_delay_ms
+        return True
+
+    def consume(self, units: int = 1) -> None:
+        """Charge one request. Sleeps for a delay-throttle; raises
+        ThrottleReject for a reject-throttle (after its pause)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            now = int(time.monotonic())
+            if now != self._window_start:
+                self._window_start = now
+                self._window_units = 0
+            self._window_units += units
+            total = self._window_units
+            reject = self.reject_units and total > self.reject_units
+            delay = self.delay_units and total > self.delay_units
+            if reject:
+                self.rejected_count += 1
+                pause = self.reject_delay_ms / 1000.0
+            elif delay:
+                self.delayed_count += 1
+                pause = self.delay_ms / 1000.0
+        if reject:
+            if pause:
+                time.sleep(pause)
+            raise ThrottleReject(
+                f"write throttled: {total} units/s > {self.reject_units}")
+        if delay and pause:
+            time.sleep(pause)

@@ -11,6 +11,7 @@ import time
 import pytest
 
 from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
+from pegasus_tpu.rpc.messages import Status
 from pegasus_tpu.engine import EngineOptions
 from pegasus_tpu.meta import MetaServer
 from pegasus_tpu.meta import messages as mm
@@ -178,6 +179,58 @@ def test_app_envs_propagate_to_replicas(cluster):
                 assert rep.server.app_envs.get("default_ttl") == "120"
                 found += 1
     assert found >= 2
+    c.close()
+
+
+def test_write_throttling_env(cluster):
+    """replica.write_throttling: delay throttling slows the writer; the
+    reject stage returns TRY_AGAIN (reference PERR_APP_BUSY) without the
+    client transparently retrying."""
+    c = make_client(cluster, app="thr", partitions=1)
+    r = cluster.ddl(RPC_CM_SET_APP_ENVS,
+                    mm.SetAppEnvsRequest(
+                        app_name="thr",
+                        envs_json='{"replica.write_throttling":'
+                                  ' "5*delay*40,10*reject*5"}'),
+                    mm.SetAppEnvsResponse)
+    assert r.error == 0
+    deadline = time.time() + 5
+    armed = False
+    while time.time() < deadline and not armed:
+        for stub in cluster.nodes.values():
+            for (aid, _), rep in stub._replicas.items():
+                if (aid == c.resolver.app_id
+                        and rep.server.write_qps_throttler.enabled):
+                    armed = True
+        time.sleep(0.1)
+    assert armed, "throttling env never reached a replica"
+    # burst past both thresholds within one second
+    rejected = 0
+    t0 = time.perf_counter()
+    for i in range(14):
+        try:
+            c.set(b"tk", b"s%d" % i, b"v")
+        except PegasusError as e:
+            assert e.status == Status.TRY_AGAIN
+            rejected += 1
+    elapsed = time.perf_counter() - t0
+    assert rejected > 0, "reject threshold never fired"
+    assert elapsed > 0.15, "delay throttling never slowed the burst"
+    # disabling the env restores full service
+    cluster.ddl(RPC_CM_SET_APP_ENVS,
+                mm.SetAppEnvsRequest(app_name="thr",
+                                     envs_json='{"replica.write_throttling":'
+                                               ' ""}'),
+                mm.SetAppEnvsResponse)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            time.sleep(0.2)
+            c.set(b"tk2", b"s", b"v")
+            break
+        except PegasusError:
+            continue
+    assert c.get(b"tk2", b"s") == b"v"
     c.close()
 
 

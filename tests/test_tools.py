@@ -368,3 +368,30 @@ def test_block_service_local_provider(tmp_path):
     import pytest as _p
     with _p.raises(ValueError):
         bs.upload(str(src), "../escape.txt")
+
+
+def test_throttling_controller_parse_and_consume():
+    from pegasus_tpu.engine.throttling import (ThrottleReject,
+                                               ThrottlingController)
+
+    t = ThrottlingController()
+    assert t.parse_from_env("5*delay*0,8*reject*0")
+    for _ in range(5):
+        t.consume(1)          # under both thresholds
+    t.consume(1)              # 6th: delayed (0ms — just counted)
+    assert t.delayed_count == 1
+    for _ in range(2):
+        t.consume(1)
+    try:
+        t.consume(1)          # 9th: past reject threshold
+        raise AssertionError("expected ThrottleReject")
+    except ThrottleReject:
+        pass
+    assert t.rejected_count == 1
+    # bare number = reject-only; malformed input keeps the old setting
+    assert t.parse_from_env("3")
+    assert t.reject_units == 3 and t.delay_units == 0
+    assert not t.parse_from_env("nonsense*x*1")
+    assert t.reject_units == 3
+    assert t.parse_from_env("")   # empty disables
+    assert not t.enabled
