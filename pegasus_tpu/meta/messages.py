@@ -222,6 +222,10 @@ class RestoreAppResponse:
 class StartBulkLoadRequest:
     app_name: str = ""
     provider_root: str = ""
+    # async session (reference semantics): the response reports the session
+    # started; progress comes from query_bulk_load_status. Default stays
+    # synchronous for in-process callers.
+    async_start: bool = False
 
 
 @dataclass
@@ -229,6 +233,50 @@ class StartBulkLoadResponse:
     error: int = 0
     error_text: str = ""
     ingested_records: int = 0
+
+
+@dataclass
+class QueryBulkLoadRequest:
+    app_name: str = ""
+
+
+@dataclass
+class QueryBulkLoadResponse:
+    error: int = 0
+    error_text: str = ""
+    # downloading | ingesting | paused | canceled | failed | succeed | none
+    status: str = "none"
+    done_partitions: int = 0
+    total_partitions: int = 0
+    ingested_records: int = 0
+
+
+@dataclass
+class QueryRestoreRequest:
+    app_name: str = ""
+
+
+@dataclass
+class QueryRestoreResponse:
+    error: int = 0
+    error_text: str = ""
+    status: str = "none"   # restoring | ok | none
+    backup_id: int = 0
+    old_app_name: str = ""
+    done_partitions: int = 0
+    total_partitions: int = 0
+
+
+@dataclass
+class ControlBulkLoadRequest:
+    app_name: str = ""
+    action: str = ""      # pause | restart | cancel
+
+
+@dataclass
+class ControlBulkLoadResponse:
+    error: int = 0
+    error_text: str = ""
 
 
 # --- meta -> replica node commands ---

@@ -32,6 +32,9 @@ RPC_CM_SPLIT_APP = "RPC_CM_START_PARTITION_SPLIT"
 RPC_CM_BACKUP_APP = "RPC_CM_START_BACKUP_APP"
 RPC_CM_RESTORE_APP = "RPC_CM_START_RESTORE"
 RPC_CM_START_BULK_LOAD = "RPC_CM_START_BULK_LOAD"
+RPC_CM_QUERY_BULK_LOAD = "RPC_CM_QUERY_BULK_LOAD_STATUS"
+RPC_CM_CONTROL_BULK_LOAD = "RPC_CM_CONTROL_BULK_LOAD"
+RPC_CM_QUERY_RESTORE = "RPC_CM_QUERY_RESTORE_STATUS"
 RPC_CM_PROPOSE = "RPC_CM_PROPOSE_BALANCER"
 RPC_CM_BALANCE = "RPC_CM_START_BALANCE"
 RPC_CM_ADD_DUPLICATION = "RPC_CM_ADD_DUPLICATION"
@@ -73,6 +76,8 @@ class MetaServer:
         self._dups = {}          # app_id -> list[dict] duplication entries
         self._policies = {}      # name -> dict (BackupPolicyInfo fields)
         self._dropped = {}       # app_id -> {"app","parts","expire_ts"}
+        self._bulk_loads = {}    # app_id -> bulk-load session dict
+        self._restores = {}      # new_app_name -> restore status dict
         self.level = "lively"    # freezed | steady | lively (see META_LEVELS)
         self._next_app_id = 1
         self._next_dupid = 1
@@ -93,6 +98,9 @@ class MetaServer:
             RPC_CM_BACKUP_APP: self._on_backup_app,
             RPC_CM_RESTORE_APP: self._on_restore_app,
             RPC_CM_START_BULK_LOAD: self._on_start_bulk_load,
+            RPC_CM_QUERY_BULK_LOAD: self._on_query_bulk_load,
+            RPC_CM_CONTROL_BULK_LOAD: self._on_control_bulk_load,
+            RPC_CM_QUERY_RESTORE: self._on_query_restore,
             RPC_CM_PROPOSE: self._on_propose,
             RPC_CM_BALANCE: self._on_balance,
             RPC_CM_ADD_DUPLICATION: self._on_add_dup,
@@ -418,6 +426,10 @@ class MetaServer:
                                                 secondaries=members[1:]))
             self._parts[app.app_id] = parts
             self._persist_locked()
+        self._restores[app.app_name] = {
+            "status": "restoring", "backup_id": req.backup_id,
+            "old_app": req.old_app_name, "done": 0,
+            "total": app.partition_count}
         for pc in parts:
             src = os.path.join(backup_root, str(req.backup_id),
                                req.old_app_name, str(pc.pidx))
@@ -429,12 +441,17 @@ class MetaServer:
             for node in [pc.primary] + pc.secondaries:
                 self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
                                    ignore_errors=True)
+            self._restores[app.app_name]["done"] = pc.pidx + 1
+        self._restores[app.app_name]["status"] = "ok"
         return codec.encode(mm.RestoreAppResponse(app_id=app.app_id))
 
     def _on_start_bulk_load(self, header, body) -> bytes:
         """Meta-driven bulk load: validate provider metadata, then each
         partition primary ingests its set (reference bulk-load DDL,
-        SURVEY §2.4 'Bulk load framework')."""
+        SURVEY §2.4 'Bulk load framework'). async_start runs the partition
+        walk as a controllable session (pause/restart/cancel/query, the
+        reference's bulk-load state machine surface, shell bulk_load.cpp);
+        the default stays synchronous."""
         from ..engine import bulk_load as bl
 
         req = codec.decode(mm.StartBulkLoadRequest, body)
@@ -443,7 +460,11 @@ class MetaServer:
             if app is None:
                 return codec.encode(mm.StartBulkLoadResponse(
                     error=1, error_text="no such app"))
-            parts = list(self._parts[app.app_id])
+            sess = self._bulk_loads.get(app.app_id)
+            if sess and sess["status"] in ("downloading", "ingesting",
+                                           "paused"):
+                return codec.encode(mm.StartBulkLoadResponse(
+                    error=1, error_text="bulk load already in progress"))
         provider_root = os.path.abspath(req.provider_root)
         try:
             with open(bl.metadata_path(provider_root, req.app_name)) as f:
@@ -454,13 +475,47 @@ class MetaServer:
         if bmeta["partition_count"] != app.partition_count:
             return codec.encode(mm.StartBulkLoadResponse(
                 error=1, error_text="partition count mismatch"))
+        sess = {"status": "ingesting", "done": 0,
+                "total": app.partition_count, "ingested": 0,
+                "error_text": "", "provider_root": provider_root,
+                "app_name": req.app_name}
+        with self._lock:
+            self._bulk_loads[app.app_id] = sess
+        if req.async_start:
+            threading.Thread(target=self._bulk_load_worker,
+                             args=(app, sess), daemon=True).start()
+            return codec.encode(mm.StartBulkLoadResponse())
+        self._bulk_load_worker(app, sess)
+        if sess["status"] != "succeed":
+            return codec.encode(mm.StartBulkLoadResponse(
+                error=1, error_text=sess["error_text"] or sess["status"]))
+        return codec.encode(mm.StartBulkLoadResponse(
+            ingested_records=sess["ingested"]))
+
+    def _bulk_load_worker(self, app, sess) -> None:
+        """Walk the partitions, honoring pause/cancel between them."""
         from ..rpc import messages as rpc_msg
         from ..rpc.task_codes import RPC_BULK_LOAD_INGEST
 
-        total = 0
-        for pc in parts:
+        while True:
+            with self._lock:
+                if sess["status"] == "canceled":
+                    return
+                if sess["status"] == "paused":
+                    pass  # poll below, outside the lock
+                elif sess["done"] >= sess["total"]:
+                    sess["status"] = "succeed"
+                    return
+                pidx = sess["done"]
+                parts = list(self._parts[app.app_id])
+                status = sess["status"]
+            if status == "paused":
+                time.sleep(0.05)
+                continue
+            pc = parts[pidx]
             ingest = rpc_msg.BulkLoadIngestRequest(
-                provider_root=provider_root, app_name=req.app_name,
+                provider_root=sess["provider_root"],
+                app_name=sess["app_name"],
                 partition_count=app.partition_count)
             # route through the primary's WRITE path: the ingestion command
             # replicates via PacificA so every replica loads the set at the
@@ -468,15 +523,80 @@ class MetaServer:
             out = self._send_to_node(pc.primary, RPC_BULK_LOAD_INGEST, ingest,
                                      app_id=app.app_id, pidx=pc.pidx,
                                      ignore_errors=True)
-            if out is None:
-                return codec.encode(mm.StartBulkLoadResponse(
-                    error=1, error_text=f"partition {pc.pidx} ingest failed"))
-            resp = codec.decode(rpc_msg.BulkLoadIngestResponse, out)
-            if resp.error:
-                return codec.encode(mm.StartBulkLoadResponse(
-                    error=1, error_text=f"partition {pc.pidx} ingest error"))
-            total += resp.ingested_records
-        return codec.encode(mm.StartBulkLoadResponse(ingested_records=total))
+            resp = (codec.decode(rpc_msg.BulkLoadIngestResponse, out)
+                    if out is not None else None)
+            with self._lock:
+                if resp is None or resp.error:
+                    sess["status"] = "failed"
+                    sess["error_text"] = (f"partition {pc.pidx} ingest "
+                                          + ("failed" if resp is None
+                                             else "error"))
+                    return
+                sess["ingested"] += resp.ingested_records
+                sess["done"] += 1
+
+    def _on_query_bulk_load(self, header, body) -> bytes:
+        req = codec.decode(mm.QueryBulkLoadRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.QueryBulkLoadResponse(
+                    error=1, error_text="no such app"))
+            sess = self._bulk_loads.get(app.app_id)
+            if sess is None:
+                return codec.encode(mm.QueryBulkLoadResponse(status="none"))
+            return codec.encode(mm.QueryBulkLoadResponse(
+                status=sess["status"], done_partitions=sess["done"],
+                total_partitions=sess["total"],
+                ingested_records=sess["ingested"],
+                error_text=sess["error_text"]))
+
+    def _on_query_restore(self, header, body) -> bytes:
+        """query_restore_status <new_app> (reference restore.cpp
+        query_restore_status)."""
+        req = codec.decode(mm.QueryRestoreRequest, body)
+        with self._lock:
+            info = self._restores.get(req.app_name)
+        if info is None:
+            return codec.encode(mm.QueryRestoreResponse(status="none"))
+        return codec.encode(mm.QueryRestoreResponse(
+            status=info["status"], backup_id=info["backup_id"],
+            old_app_name=info["old_app"], done_partitions=info["done"],
+            total_partitions=info["total"]))
+
+    def _on_control_bulk_load(self, header, body) -> bytes:
+        """pause_bulk_load / restart_bulk_load / cancel_bulk_load
+        (reference shell bulk_load.cpp control verbs)."""
+        req = codec.decode(mm.ControlBulkLoadRequest, body)
+        with self._lock:
+            app = self._apps.get(req.app_name)
+            if app is None:
+                return codec.encode(mm.ControlBulkLoadResponse(
+                    error=1, error_text="no such app"))
+            sess = self._bulk_loads.get(app.app_id)
+            if sess is None:
+                return codec.encode(mm.ControlBulkLoadResponse(
+                    error=1, error_text="no bulk load session"))
+            cur = sess["status"]
+            if req.action == "pause":
+                if cur != "ingesting":
+                    return codec.encode(mm.ControlBulkLoadResponse(
+                        error=1, error_text=f"cannot pause ({cur})"))
+                sess["status"] = "paused"
+            elif req.action == "restart":
+                if cur != "paused":
+                    return codec.encode(mm.ControlBulkLoadResponse(
+                        error=1, error_text=f"cannot restart ({cur})"))
+                sess["status"] = "ingesting"
+            elif req.action == "cancel":
+                if cur not in ("ingesting", "paused", "failed"):
+                    return codec.encode(mm.ControlBulkLoadResponse(
+                        error=1, error_text=f"cannot cancel ({cur})"))
+                sess["status"] = "canceled"
+            else:
+                return codec.encode(mm.ControlBulkLoadResponse(
+                    error=1, error_text=f"unknown action {req.action!r}"))
+        return codec.encode(mm.ControlBulkLoadResponse())
 
     # --------------------------------------------------------------- balance
 

@@ -147,6 +147,14 @@ class MutationLog:
 
     # -------------------------------------------------------------------- gc
 
+    def flush(self) -> None:
+        """Flush + fsync the open segment (shell flush_log; reference
+        flush_log remote command)."""
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+
     def gc(self, durable_decree: int) -> int:
         """Drop whole segments strictly older than the segment containing
         durable_decree+1 (reference: log GC after checkpoint)."""

@@ -114,6 +114,7 @@ class ReplicaStub:
         self.commands.register("replica-disk", self._cmd_replica_disk)
         self.commands.register("query-compact-state", self._cmd_compact_state)
         self.commands.register("detect_hotkey", self._cmd_detect_hotkey)
+        self.commands.register("flush-log", self._cmd_flush_log)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
@@ -594,6 +595,15 @@ class ReplicaStub:
         if rep is None:
             return f"no replica {gpid}"
         return rep.server.on_detect_hotkey(kind, action)
+
+    def _cmd_flush_log(self, args: list) -> str:
+        """flush-log: fsync every hosted replica's mutation log (reference
+        flush_log remote command)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.plog.flush()
+        return f"flushed {len(reps)} logs"
 
     # ------------------------------------------------------------ write path
 

@@ -55,6 +55,14 @@ class Shell:
             "multi_get": (self.cmd_multi_get, "multi_get <hk> [sk...]"),
             "multi_del": (self.cmd_multi_del, "multi_del <hk> <sk> [sk...]"),
             "sortkey_count": (self.cmd_sortkey_count, "sortkey_count <hk>"),
+            "count": (self.cmd_sortkey_count,
+                      "count <hk> — sort key count (alias of sortkey_count)"),
+            "check_and_set": (self.cmd_check_and_set,
+                              "check_and_set <hk> <check_sk> <check_type> "
+                              "<operand> <set_sk> <set_value> [ttl]"),
+            "check_and_mutate": (self.cmd_check_and_mutate,
+                                 "check_and_mutate <hk> <check_sk> <check_type> "
+                                 "<operand> set <sk> <v> | del <sk> [...]"),
             "hash_scan": (self.cmd_hash_scan, "hash_scan <hk> [start] [stop]"),
             "full_scan": (self.cmd_full_scan, "full_scan [max_rows]"),
             "count_data": (self.cmd_count_data, "count rows in current table"),
@@ -103,7 +111,16 @@ class Shell:
             "disable_backup_policy": (self.cmd_disable_backup_policy,
                                       "disable_backup_policy <name>"),
             "start_bulk_load": (self.cmd_start_bulk_load,
-                                "start_bulk_load <app> <provider_root>"),
+                                "start_bulk_load <app> <provider_root> [-a] "
+                                "— -a = async session (query/pause/cancel)"),
+            "query_bulk_load_status": (self.cmd_query_bulk_load,
+                                       "query_bulk_load_status <app>"),
+            "pause_bulk_load": (self.cmd_pause_bulk_load,
+                                "pause_bulk_load <app>"),
+            "restart_bulk_load": (self.cmd_restart_bulk_load,
+                                  "restart_bulk_load <app> — resume a paused session"),
+            "cancel_bulk_load": (self.cmd_cancel_bulk_load,
+                                 "cancel_bulk_load <app>"),
             "recover": (self.cmd_recover,
                         "recover <node> [node...] — rebuild meta state from nodes"),
             "ddd_diagnose": (self.cmd_ddd_diagnose,
@@ -142,6 +159,21 @@ class Shell:
                           "mlog_dump <plog_dir> [from_decree] — offline log reader"),
             "local_get": (self.cmd_local_get,
                           "local_get <replica_data_dir> <hashkey> <sortkey>"),
+            "cc": (self.cmd_cc,
+                   "cc <meta1[,meta2...]> — change to another cluster"),
+            "escape_all": (self.cmd_escape_all,
+                           "escape_all [true|false] — escape all bytes, not "
+                           "just invisible ones"),
+            "flush_log": (self.cmd_flush_log,
+                          "flush_log <node|all> — fsync mutation logs"),
+            "rdb_key_str2hex": (self.cmd_rdb_key_str2hex,
+                                "rdb_key_str2hex <hashkey> <sortkey>"),
+            "rdb_key_hex2str": (self.cmd_rdb_key_hex2str,
+                                "rdb_key_hex2str <rdb_key_hex>"),
+            "rdb_value_hex2str": (self.cmd_rdb_value_hex2str,
+                                  "rdb_value_hex2str <value_hex>"),
+            "query_restore_status": (self.cmd_query_restore_status,
+                                     "query_restore_status <new_app>"),
             "exit": (None, "quit"),
             "quit": (None, "quit"),
         }
@@ -185,6 +217,9 @@ class Shell:
 
     def p(self, *args):
         print(*args, file=self.out)
+
+    def _esc(self, data: bytes) -> str:
+        return c_escape_string(data, getattr(self, "escape_all", False))
 
     # ----------------------------------------------------------- commands
 
@@ -282,7 +317,7 @@ class Shell:
 
     def cmd_get(self, args):
         v = self._client().get(args[0].encode(), args[1].encode())
-        self.p("not found" if v is None else f'"{c_escape_string(v)}"')
+        self.p("not found" if v is None else f'"{self._esc(v)}"')
 
     def cmd_del(self, args):
         self._client().delete(args[0].encode(), args[1].encode())
@@ -312,7 +347,7 @@ class Shell:
         sks = [a.encode() for a in args[1:]] or None
         complete, kvs = self._client().multi_get(hk, sort_keys=sks)
         for sk in sorted(kvs):
-            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(kvs[sk])}"')
+            self.p(f'"{self._esc(sk)}" : "{self._esc(kvs[sk])}"')
         self.p(f"{len(kvs)} rows{'' if complete else ' (incomplete)'}")
 
     def cmd_multi_del(self, args):
@@ -323,13 +358,65 @@ class Shell:
     def cmd_sortkey_count(self, args):
         self.p(str(self._client().sortkey_count(args[0].encode())))
 
+    @staticmethod
+    def _cas_check_type(token: str) -> int:
+        from ..rpc.messages import CasCheckType
+
+        try:
+            return int(token)
+        except ValueError:
+            return CasCheckType[token.upper()].value
+
+    def cmd_check_and_set(self, args):
+        """check_and_set <hk> <check_sk> <check_type> <operand> <set_sk>
+        <set_value> [ttl] (reference shell data_operations check_and_set)."""
+        ct = self._cas_check_type(args[2])
+        ttl = int(args[6]) if len(args) > 6 else 0
+        r = self._client().check_and_set(
+            args[0].encode(), args[1].encode(), ct, args[3].encode(),
+            args[4].encode(), args[5].encode(), set_ttl_seconds=ttl,
+            return_check_value=True)
+        from ..rpc.messages import Status
+
+        self.p(f"set_succeed: {str(r.error == Status.OK).lower()}")
+        if r.check_value_returned and r.check_value_exist:
+            self.p(f'check_value: "{self._esc(r.check_value)}"')
+
+    def cmd_check_and_mutate(self, args):
+        """check_and_mutate <hk> <check_sk> <check_type> <operand>
+        set <sk> <v> | del <sk> [...]."""
+        ct = self._cas_check_type(args[2])
+        muts, i = [], 4
+        while i < len(args):
+            if args[i] == "set":
+                muts.append(("set", args[i + 1].encode(),
+                             args[i + 2].encode(), 0))
+                i += 3
+            elif args[i] == "del":
+                muts.append(("del", args[i + 1].encode()))
+                i += 2
+            else:
+                self.p(f"bad mutation token {args[i]!r}")
+                return
+        if not muts:
+            self.p("no mutations given")
+            return
+        r = self._client().check_and_mutate(
+            args[0].encode(), args[1].encode(), ct, args[3].encode(), muts,
+            return_check_value=True)
+        from ..rpc.messages import Status
+
+        self.p(f"mutate_succeed: {str(r.error == Status.OK).lower()}")
+        if r.check_value_returned and r.check_value_exist:
+            self.p(f'check_value: "{self._esc(r.check_value)}"')
+
     def cmd_hash_scan(self, args):
         hk = args[0].encode()
         start = args[1].encode() if len(args) > 1 else b""
         stop = args[2].encode() if len(args) > 2 else b""
         n = 0
         for _, sk, v in self._client().get_scanner(hk, start, stop):
-            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(v)}"')
+            self.p(f'"{self._esc(sk)}" : "{self._esc(v)}"')
             n += 1
         self.p(f"{n} rows")
 
@@ -338,8 +425,8 @@ class Shell:
         n = 0
         for sc in self._client().get_unordered_scanners():
             for hk, sk, v in sc:
-                self.p(f'"{c_escape_string(hk)}" : "{c_escape_string(sk)}" => '
-                       f'"{c_escape_string(v)}"')
+                self.p(f'"{self._esc(hk)}" : "{self._esc(sk)}" => '
+                       f'"{self._esc(v)}"')
                 n += 1
                 if n >= limit:
                     self.p(f"{n} rows (limited)")
@@ -599,13 +686,64 @@ class Shell:
     def cmd_start_bulk_load(self, args):
         from ..meta.meta_server import RPC_CM_START_BULK_LOAD
 
+        async_start = "-a" in args
+        args = [a for a in args if a != "-a"]
         r = self._meta_call(RPC_CM_START_BULK_LOAD,
-                            mm.StartBulkLoadRequest(args[0], args[1]),
+                            mm.StartBulkLoadRequest(args[0], args[1],
+                                                    async_start=async_start),
                             mm.StartBulkLoadResponse)
         if r.error:
             self.p(f"bulk load failed: {r.error_text}")
+        elif async_start:
+            self.p("bulk load session started "
+                   "(query_bulk_load_status to follow)")
         else:
             self.p(f"bulk load succeed, ingested {r.ingested_records} records")
+
+    def cmd_query_bulk_load(self, args):
+        from ..meta.meta_server import RPC_CM_QUERY_BULK_LOAD
+
+        r = self._meta_call(RPC_CM_QUERY_BULK_LOAD,
+                            mm.QueryBulkLoadRequest(args[0]),
+                            mm.QueryBulkLoadResponse)
+        if r.error:
+            self.p(f"query failed: {r.error_text}")
+        else:
+            extra = f" ({r.error_text})" if r.error_text else ""
+            self.p(f"bulk load of {args[0]}: {r.status}{extra}, "
+                   f"{r.done_partitions}/{r.total_partitions} partitions, "
+                   f"{r.ingested_records} records")
+
+    def _control_bulk_load(self, app, action):
+        from ..meta.meta_server import RPC_CM_CONTROL_BULK_LOAD
+
+        r = self._meta_call(RPC_CM_CONTROL_BULK_LOAD,
+                            mm.ControlBulkLoadRequest(app, action),
+                            mm.ControlBulkLoadResponse)
+        self.p(f"{action} failed: {r.error_text}" if r.error
+               else f"{action} OK")
+
+    def cmd_pause_bulk_load(self, args):
+        self._control_bulk_load(args[0], "pause")
+
+    def cmd_restart_bulk_load(self, args):
+        self._control_bulk_load(args[0], "restart")
+
+    def cmd_cancel_bulk_load(self, args):
+        self._control_bulk_load(args[0], "cancel")
+
+    def cmd_query_restore_status(self, args):
+        from ..meta.meta_server import RPC_CM_QUERY_RESTORE
+
+        r = self._meta_call(RPC_CM_QUERY_RESTORE,
+                            mm.QueryRestoreRequest(args[0]),
+                            mm.QueryRestoreResponse)
+        if r.status == "none":
+            self.p(f"no restore recorded for {args[0]}")
+        else:
+            self.p(f"restore of {args[0]}: {r.status}, from "
+                   f"{r.old_app_name}@{r.backup_id}, "
+                   f"{r.done_partitions}/{r.total_partitions} partitions")
 
     def cmd_recover(self, args):
         from ..meta.meta_server import RPC_CM_RECOVER
@@ -720,7 +858,7 @@ class Shell:
         complete, kvs = self._client().multi_get(args[0].encode(),
                                                  no_value=True)
         for sk in sorted(kvs):
-            self.p(f'"{c_escape_string(sk)}"')
+            self.p(f'"{self._esc(sk)}"')
         self.p(f"{len(kvs)} sortkeys"
                + ("" if complete else " (INCOMPLETE: server limit hit)"))
 
@@ -729,7 +867,7 @@ class Shell:
             args[0].encode(), start_sortkey=args[1].encode(),
             stop_sortkey=args[2].encode())
         for sk in sorted(kvs):
-            self.p(f'"{c_escape_string(sk)}" : "{c_escape_string(kvs[sk])}"')
+            self.p(f'"{self._esc(sk)}" : "{self._esc(kvs[sk])}"')
         self.p(f"{len(kvs)} rows"
                + ("" if complete else " (INCOMPLETE: server limit hit)"))
 
@@ -823,7 +961,7 @@ class Shell:
         for i in range(min(sst.n, limit)):
             hk, sk = restore_key(b.key(i))
             flags = "DEL" if b.deleted[i] else f"exp={int(b.expire_ts[i])}"
-            self.p(f'"{c_escape_string(hk)}" : "{c_escape_string(sk)}" '
+            self.p(f'"{self._esc(hk)}" : "{self._esc(sk)}" '
                    f'[{flags}] => {len(b.value(i))}B')
         if sst.n > limit:
             self.p(f"... {sst.n - limit} more")
@@ -860,6 +998,71 @@ class Shell:
             self.p(f"{n} mutations")
             log.close()
 
+    def cmd_cc(self, args):
+        """cc <meta1[,meta2...]> — point the shell at another cluster
+        (reference cc_command)."""
+        self.meta_addrs = args[0].split(",")
+        self.current_app = None
+        self._clients = {}
+        self.p(f"cluster changed to {','.join(self.meta_addrs)}")
+
+    def cmd_escape_all(self, args):
+        """escape_all [true|false] — toggle escaping of every output byte
+        (reference process_escape_all)."""
+        if args:
+            self.escape_all = args[0].lower() in ("true", "1", "on", "yes")
+        else:
+            self.escape_all = not getattr(self, "escape_all", False)
+        self.p(f"escape_all: {str(self.escape_all).lower()}")
+
+    def cmd_flush_log(self, args):
+        """flush_log <node|all> — fsync mutation logs on replica nodes."""
+        targets = ([n.address for n in self._nodes() if n.alive]
+                   if args[0] == "all" else [args[0]])
+        for node in targets:
+            self.p(f"{node}: {self._node_command(node, 'flush-log', [])}")
+
+    def cmd_rdb_key_str2hex(self, args):
+        """rdb_key_str2hex <hashkey> <sortkey> — engine key bytes as hex."""
+        from ..base import key_schema
+
+        key = key_schema.generate_key(args[0].encode(), args[1].encode())
+        self.p(key.hex().upper())
+
+    def cmd_rdb_key_hex2str(self, args):
+        """rdb_key_hex2str <hex> — decode an engine key to hash/sort keys."""
+        from ..base import key_schema
+
+        try:
+            hk, sk = key_schema.restore_key(bytes.fromhex(args[0]))
+        except (ValueError, IndexError) as e:
+            self.p(f"bad key hex: {e}")
+            return
+        self.p(f'hash_key: "{self._esc(hk)}"')
+        self.p(f'sort_key: "{self._esc(sk)}"')
+
+    def cmd_rdb_value_hex2str(self, args):
+        """rdb_value_hex2str <hex> — decode a stored value (schema v0/v1/v2:
+        user data + expire timestamp)."""
+        from ..base.utils import epoch_begin
+        from ..base.value_schema import ValueSchemaManager
+
+        try:
+            raw = bytes.fromhex(args[0])
+            # self-describing first byte when present, else latest schema
+            schema = ValueSchemaManager().get_value_schema(
+                2 if raw and raw[0] & 0x80 else 0, raw)
+            user = schema.extract_user_data(raw)
+            expire = schema.extract_expire_ts(raw)
+        except (ValueError, IndexError) as e:
+            self.p(f"bad value hex: {e}")
+            return
+        self.p(f'user_data: "{self._esc(user)}"')
+        if expire:
+            self.p(f"expire_ts: {expire} (unix {expire + epoch_begin})")
+        else:
+            self.p("expire_ts: 0 (no ttl)")
+
     def cmd_local_get(self, args):
         from ..base.key_schema import generate_key
         from ..base.value_schema import SCHEMAS
@@ -871,7 +1074,7 @@ class Shell:
             self.p("not found")
         else:
             data = SCHEMAS[eng.data_version()].extract_user_data(raw)
-            self.p(f'"{c_escape_string(data)}"')
+            self.p(f'"{self._esc(data)}"')
         eng.close()
 
     # ---------------------------------------------------------------- run

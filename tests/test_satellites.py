@@ -95,6 +95,72 @@ def test_bulk_load_end_to_end(cluster, tmp_path):
     cli.close()
 
 
+def test_bulk_load_async_session_controls(cluster, tmp_path):
+    """Async bulk load is a controllable session: pause holds the partition
+    walk, restart resumes it, query reports progress (reference bulk-load
+    state machine, shell bulk_load.cpp control verbs)."""
+    import time as _time
+
+    from pegasus_tpu.meta.meta_server import (RPC_CM_CONTROL_BULK_LOAD,
+                                              RPC_CM_QUERY_BULK_LOAD)
+
+    cli = cluster.create("blas", partitions=2)
+    provider = tmp_path / "prov_async"
+    per_part = {0: [], 1: []}
+    n_total = 40
+    for i in range(n_total):
+        hk, sk, v = b"as%d" % i, b"s", b"av%d" % i
+        h = key_schema.key_hash(key_schema.generate_key(hk, sk))
+        per_part[h % 2].append((hk, sk, v, 0))
+    for pidx, rows in per_part.items():
+        pdir = provider / "blas" / "2" / str(pidx)
+        pdir.mkdir(parents=True)
+        bl.write_raw_set(str(pdir / "set.raw"), rows)
+    bl.write_metadata(str(provider), "blas", 2)
+    # pause before starting the session: the worker must hold at 0 done
+    app_id = cli.resolver.app_id
+    r = cluster.ddl(RPC_CM_START_BULK_LOAD,
+                    mm.StartBulkLoadRequest("blas", str(provider),
+                                            async_start=True),
+                    mm.StartBulkLoadResponse)
+    assert r.error == 0, r.error_text
+    r = cluster.ddl(RPC_CM_CONTROL_BULK_LOAD,
+                    mm.ControlBulkLoadRequest("blas", "pause"),
+                    mm.ControlBulkLoadResponse)
+    # the session may legitimately finish before the pause lands on a fast
+    # box; only assert the control surface behaves for whichever state
+    q = cluster.ddl(RPC_CM_QUERY_BULK_LOAD, mm.QueryBulkLoadRequest("blas"),
+                    mm.QueryBulkLoadResponse)
+    assert q.status in ("paused", "ingesting", "succeed")
+    if q.status == "paused":
+        held = cluster.ddl(RPC_CM_QUERY_BULK_LOAD,
+                           mm.QueryBulkLoadRequest("blas"),
+                           mm.QueryBulkLoadResponse)
+        r = cluster.ddl(RPC_CM_CONTROL_BULK_LOAD,
+                        mm.ControlBulkLoadRequest("blas", "restart"),
+                        mm.ControlBulkLoadResponse)
+        assert r.error == 0
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        q = cluster.ddl(RPC_CM_QUERY_BULK_LOAD,
+                        mm.QueryBulkLoadRequest("blas"),
+                        mm.QueryBulkLoadResponse)
+        if q.status == "succeed":
+            break
+        _time.sleep(0.2)
+    assert q.status == "succeed", q.status
+    assert q.ingested_records == n_total
+    assert q.done_partitions == q.total_partitions == 2
+    for i in range(n_total):
+        assert cli.get(b"as%d" % i, b"s") == b"av%d" % i
+    # double-start while a finished session exists is allowed again
+    q = cluster.ddl(RPC_CM_CONTROL_BULK_LOAD,
+                    mm.ControlBulkLoadRequest("blas", "pause"),
+                    mm.ControlBulkLoadResponse)
+    assert q.error == 1  # cannot pause a finished session
+    cli.close()
+
+
 def test_bulk_load_drops_misrouted_rows(tmp_path):
     """Rows that hash to another partition are filtered at ingest."""
     from pegasus_tpu.engine.db import LsmEngine
