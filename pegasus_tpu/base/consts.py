@@ -66,6 +66,7 @@ ENV_DUPLICATION_KEY = "__duplication__"
 
 # abnormal-size read tracing thresholds (reference _abnormal_* gflags,
 # pegasus_server_impl.h:317-343); hot-applied app-envs here, 0 = disabled
+ENV_READ_THROTTLING = "replica.read_throttling"
 ENV_WRITE_THROTTLING = "replica.write_throttling"
 ENV_WRITE_THROTTLING_BY_SIZE = "replica.write_throttling_by_size"
 ENV_ABNORMAL_GET_SIZE = "replica.abnormal_get_size_threshold"

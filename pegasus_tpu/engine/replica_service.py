@@ -102,29 +102,42 @@ class ReplicaService:
             h[code] = self._on_write
         return h
 
+    def _replica_read(self, header) -> PegasusServer:
+        """Resolve + charge the read throttle (reference
+        replica.read_throttling env; qps units)."""
+        from .throttling import ThrottleReject
+
+        srv = self._replica(header)
+        try:
+            srv.read_qps_throttler.consume(1)
+        except ThrottleReject as e:
+            raise RpcError(ERR_BUSY, str(e))
+        return srv
+
     def _on_get(self, header, body) -> bytes:
         req = codec.decode(msg.KeyRequest, body)
-        return codec.encode(self._replica(header).on_get(req.key))
+        return codec.encode(self._replica_read(header).on_get(req.key))
 
     def _on_multi_get(self, header, body) -> bytes:
         req = codec.decode(msg.MultiGetRequest, body)
-        return codec.encode(self._replica(header).on_multi_get(req))
+        return codec.encode(self._replica_read(header).on_multi_get(req))
 
     def _on_sortkey_count(self, header, body) -> bytes:
         req = codec.decode(msg.KeyRequest, body)
-        return codec.encode(self._replica(header).on_sortkey_count(req.key))
+        return codec.encode(
+            self._replica_read(header).on_sortkey_count(req.key))
 
     def _on_ttl(self, header, body) -> bytes:
         req = codec.decode(msg.KeyRequest, body)
-        return codec.encode(self._replica(header).on_ttl(req.key))
+        return codec.encode(self._replica_read(header).on_ttl(req.key))
 
     def _on_get_scanner(self, header, body) -> bytes:
         req = codec.decode(msg.GetScannerRequest, body)
-        return codec.encode(self._replica(header).on_get_scanner(req))
+        return codec.encode(self._replica_read(header).on_get_scanner(req))
 
     def _on_scan(self, header, body) -> bytes:
         req = codec.decode(msg.ScanRequest, body)
-        return codec.encode(self._replica(header).on_scan(req))
+        return codec.encode(self._replica_read(header).on_scan(req))
 
     def _on_clear_scanner(self, header, body) -> bytes:
         req = codec.decode(msg.ScanRequest, body)

@@ -81,6 +81,7 @@ class PegasusServer:
 
         self.write_qps_throttler = ThrottlingController()
         self.write_size_throttler = ThrottlingController()
+        self.read_qps_throttler = ThrottlingController()
         self.cu_calculator = CapacityUnitCalculator(
             app_id, pidx, read_hotkey=self.read_hotkey,
             write_hotkey=self.write_hotkey)
@@ -111,7 +112,9 @@ class PegasusServer:
         for env_key, ctl in ((consts.ENV_WRITE_THROTTLING,
                               self.write_qps_throttler),
                              (consts.ENV_WRITE_THROTTLING_BY_SIZE,
-                              self.write_size_throttler)):
+                              self.write_size_throttler),
+                             (consts.ENV_READ_THROTTLING,
+                              self.read_qps_throttler)):
             v = envs.get(env_key)
             if v is not None and v != ctl.env_value:
                 if not ctl.parse_from_env(v):

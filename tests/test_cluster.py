@@ -234,6 +234,36 @@ def test_write_throttling_env(cluster):
     c.close()
 
 
+def test_read_throttling_env(cluster):
+    c = make_client(cluster, app="rthr", partitions=1)
+    c.set(b"rk", b"s", b"v")
+    r = cluster.ddl(RPC_CM_SET_APP_ENVS,
+                    mm.SetAppEnvsRequest(
+                        app_name="rthr",
+                        envs_json='{"replica.read_throttling": "5*reject*0"}'),
+                    mm.SetAppEnvsResponse)
+    assert r.error == 0
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        ok = any(rep.server.read_qps_throttler.enabled
+                 for stub in cluster.nodes.values()
+                 for (aid, _), rep in stub._replicas.items()
+                 if aid == c.resolver.app_id)
+        if ok:
+            break
+        time.sleep(0.1)
+    assert ok
+    rejected = 0
+    for _ in range(10):
+        try:
+            c.get(b"rk", b"s")
+        except PegasusError as e:
+            assert e.status == Status.TRY_AGAIN
+            rejected += 1
+    assert rejected > 0
+    c.close()
+
+
 def test_list_nodes_fd_view(cluster):
     time.sleep(0.3)
     r = cluster.ddl(RPC_CM_LIST_NODES, mm.ListNodesRequest(), mm.ListNodesResponse)
