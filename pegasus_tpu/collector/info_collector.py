@@ -80,7 +80,11 @@ class InfoCollector:
             per_partition_qps = {}
             agg = {"get_qps": 0.0, "put_qps": 0.0, "multi_get_qps": 0.0,
                    "scan_qps": 0.0, "recent_read_cu": 0.0,
-                   "recent_write_cu": 0.0}
+                   "recent_write_cu": 0.0,
+                   # throttling activity (reference row_data
+                   # recent_*_throttling_*_count, info_collector.h:73-81)
+                   "recent_write_throttling_delay_count": 0.0,
+                   "recent_write_throttling_reject_count": 0.0}
             nodes = {pc.primary for pc in cfg.partitions if pc.primary}
             for node in nodes:
                 try:
