@@ -49,7 +49,13 @@ RPC_CM_CONTROL_META = "RPC_CM_CONTROL_META"
 
 # meta function levels (reference meta_function_level: how much the meta
 # may move data around on its own; shell get/set_meta_level)
-META_LEVELS = ("freezed", "steady", "lively")
+META_LEVELS = ("blind", "freezed", "steady", "lively")
+# blind:   reject every state-changing DDL (reference meta_function_level
+#          FL_blind — operator lockdown); reads/queries still served
+# freezed: DDL allowed but no meta-initiated data movement (no learner
+#          rebuild on node death)
+# steady:  failover rebuild but no balancing
+# lively:  everything, including balance
 RPC_CM_DDD_DIAGNOSE = "RPC_CM_DDD_DIAGNOSE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
@@ -86,7 +92,31 @@ class MetaServer:
 
     # ----------------------------------------------------------- serverlet
 
+    # codes still served at level "blind" (pure queries + liveness):
+    # everything read-only, the beacon (liveness must not be blinded), and
+    # control_meta itself (the way back out)
+    _BLIND_ALLOWED = frozenset({
+        RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG, RPC_CM_LIST_NODES,
+        RPC_CM_QUERY_DUPLICATION, RPC_CM_LS_BACKUP_POLICY,
+        RPC_CM_QUERY_BULK_LOAD, RPC_CM_QUERY_RESTORE, RPC_CM_CONTROL_META,
+        RPC_FD_BEACON,
+    })
+
+    def _guard_blind(self, code, fn):
+        def wrapped(header, body):
+            if self.level == "blind" and code not in self._BLIND_ALLOWED:
+                raise RpcError(ERR_INVALID_STATE,
+                               f"meta level is blind; {code} refused "
+                               "(set_meta_level to unlock)")
+            return fn(header, body)
+        return wrapped
+
     def rpc_handlers(self) -> dict:
+        handlers = self._raw_rpc_handlers()
+        return {code: self._guard_blind(code, fn)
+                for code, fn in handlers.items()}
+
+    def _raw_rpc_handlers(self) -> dict:
         return {
             RPC_CM_CREATE_APP: self._on_create_app,
             RPC_CM_DROP_APP: self._on_drop_app,

@@ -145,9 +145,9 @@ class Shell:
             "clear_data": (self.cmd_clear_data,
                            "clear_data <table> yes — delete EVERY row"),
             "get_meta_level": (self.cmd_get_meta_level,
-                               "meta function level (freezed/steady/lively)"),
+                               "meta function level (blind/freezed/steady/lively)"),
             "set_meta_level": (self.cmd_set_meta_level,
-                               "set_meta_level <freezed|steady|lively>"),
+                               "set_meta_level <blind|freezed|steady|lively>"),
             "query_backup_policy": (self.cmd_ls_backup_policy,
                                     "alias of ls_backup_policy"),
             "batched_manual_compact": (self.cmd_batched_manual_compact,

@@ -510,3 +510,40 @@ beacon_interval_seconds = 0.2
             rep_app.stop()
     finally:
         meta_app.stop()
+
+
+def test_meta_level_blind_locks_down_ddl(cluster):
+    """blind refuses every state-changing DDL but keeps queries, beacons,
+    and the way back out (control_meta) working."""
+    from pegasus_tpu.meta.meta_server import (RPC_CM_CONTROL_META,
+                                              RPC_CM_CREATE_APP)
+
+    c = make_client(cluster, app="blindtest", partitions=1)
+    c.set(b"bk", b"s", b"v")
+    r = cluster.ddl(RPC_CM_CONTROL_META,
+                    mm.ControlMetaRequest(set_level="blind"),
+                    mm.ControlMetaResponse)
+    assert r.level == "blind"
+    try:
+        # DDL refused outright
+        import pytest as _pytest
+
+        from pegasus_tpu.rpc.transport import RpcError
+
+        with _pytest.raises(RpcError):
+            cluster.ddl(RPC_CM_CREATE_APP,
+                        mm.CreateAppRequest(app_name="nope",
+                                            partition_count=1,
+                                            replica_count=3),
+                        mm.CreateAppResponse)
+        # queries + data path still served
+        r = cluster.ddl(RPC_CM_LIST_NODES, mm.ListNodesRequest(),
+                        mm.ListNodesResponse)
+        assert any(n.alive for n in r.nodes)
+        assert c.get(b"bk", b"s") == b"v"
+    finally:
+        r = cluster.ddl(RPC_CM_CONTROL_META,
+                        mm.ControlMetaRequest(set_level="lively"),
+                        mm.ControlMetaResponse)
+        assert r.level == "lively"
+    c.close()
