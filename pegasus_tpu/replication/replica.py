@@ -26,6 +26,15 @@ import threading
 import time
 from dataclasses import dataclass
 
+
+def _parallel_prepare() -> bool:
+    """Concurrent prepare fan-out wins when peer RTT is real network wait
+    (multi-host deployments: set PEGASUS_PARALLEL_PREPARE=1). On a
+    single-core onebox the 'RTT' is mostly peer CPU under the same GIL and
+    the pool dispatch only adds contention — measured 3.8k -> 2.9k ops/s
+    YCSB-A at 8 threads — so the default stays sequential."""
+    return os.environ.get("PEGASUS_PARALLEL_PREPARE", "0") == "1"
+
 from ..engine import EngineOptions
 from ..engine.replica_service import WRITE_CODES
 from ..engine.server_impl import PegasusServer
@@ -101,7 +110,16 @@ class Replica:
         self.partition_count = 0
         self.last_committed = self.server.engine.last_committed_decree()
         self.last_prepared = self.last_committed
+        self._prep_pool = None
         self._recover_from_log()
+
+    def _prepare_pool(self):
+        if self._prep_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._prep_pool = ThreadPoolExecutor(
+                4, thread_name_prefix=f"prep-{self.name}")
+        return self._prep_pool
 
     # ----------------------------------------------------------- recovery
 
@@ -195,9 +213,16 @@ class Replica:
         self.last_prepared = decree
         self._uncommitted[decree] = m
         acks = 1
-        for peer_name in self.view.secondaries:
-            if self._send_prepare(peer_name, m):
-                acks += 1
+        secs = list(self.view.secondaries)
+        if len(secs) > 1 and _parallel_prepare():
+            # prepares fan out concurrently: commit latency is max(peer RTT),
+            # not the sum (the reference's parallel RPC_PREPARE sends).
+            # Wait for ALL so per-peer prepare order stays monotonic.
+            futs = [self._prepare_pool().submit(self._send_prepare, s, m)
+                    for s in secs]
+            acks += sum(1 for f in futs if f.result())
+        else:
+            acks += sum(1 for s in secs if self._send_prepare(s, m))
         if acks < self.quorum:
             # cannot commit; leave prepared (a later view change decides)
             raise ReplicaError(
@@ -382,5 +407,8 @@ class Replica:
         for d in self.duplicators.values():
             d.stop()
         self.duplicators.clear()
+        if self._prep_pool is not None:
+            self._prep_pool.shutdown(wait=False)
+            self._prep_pool = None
         self.plog.close()
         self.server.close()

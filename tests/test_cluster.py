@@ -204,18 +204,22 @@ def test_write_throttling_env(cluster):
                     armed = True
         time.sleep(0.1)
     assert armed, "throttling env never reached a replica"
-    # burst past both thresholds within one second
-    rejected = 0
-    t0 = time.perf_counter()
-    for i in range(14):
-        try:
-            c.set(b"tk", b"s%d" % i, b"v")
-        except PegasusError as e:
-            assert e.status == Status.TRY_AGAIN
-            rejected += 1
-    elapsed = time.perf_counter() - t0
+    # burst past both thresholds; the controller's tumbling window can
+    # roll over mid-burst on a loaded box, so retry the burst a few times
+    rejected, slowed = 0, False
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for i in range(14):
+            try:
+                c.set(b"tk", b"s%d" % i, b"v")
+            except PegasusError as e:
+                assert e.status == Status.TRY_AGAIN
+                rejected += 1
+        slowed = slowed or (time.perf_counter() - t0) > 0.15
+        if rejected and slowed:
+            break
     assert rejected > 0, "reject threshold never fired"
-    assert elapsed > 0.15, "delay throttling never slowed the burst"
+    assert slowed, "delay throttling never slowed the burst"
     # disabling the env restores full service
     cluster.ddl(RPC_CM_SET_APP_ENVS,
                 mm.SetAppEnvsRequest(app_name="thr",
