@@ -87,6 +87,8 @@ def stage_init() -> bool:
     from pegasus_tpu.base.utils import enable_compile_cache
 
     t0 = time.time()
+    log("init: acquiring backend (a wedged tunnel sleeps here; the plugin "
+        "gives up with UNAVAILABLE after ~25 min)")
     dev = jax.devices()[0]
     import jax.numpy as jnp
 
@@ -176,7 +178,16 @@ def stage_engine():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stages", default="init,kernels,pallas,bench,engine")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin jax to the CPU platform (validate the stage "
+                         "logic with ZERO tunnel contact; the env var alone "
+                         "is NOT enough — the image re-asserts axon)")
     args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"
     stages = args.stages.split(",")
     log(f"=== oneshot start (pid {os.getpid()}, stages {stages}) ===")
     try:
