@@ -44,12 +44,14 @@ def lex_less(a_cols, b_cols):
 
 
 def lex_cmp(a_cols, b_cols):
-    """(a < b, a == b) lexicographic over uint32 column lists, vectorized."""
-    import jax.numpy as jnp
+    """(a < b, a == b) lexicographic over uint32 column lists, vectorized.
 
-    less = jnp.zeros(a_cols[0].shape, dtype=bool)
-    eq = jnp.ones(a_cols[0].shape, dtype=bool)
-    for a, b in zip(a_cols, b_cols):
+    Seeded from the first column's comparison rather than boolean constant
+    arrays: Mosaic (pallas TPU) cannot materialize i1 vector constants
+    (i8->i1 trunci is unsupported), and this form is equivalent."""
+    less = a_cols[0] < b_cols[0]
+    eq = a_cols[0] == b_cols[0]
+    for a, b in zip(a_cols[1:], b_cols[1:]):
         less = less | (eq & (a < b))
         eq = eq & (a == b)
     return less, eq

@@ -5,24 +5,60 @@ stage in HBM: a merge of length L costs ~log2(L) full passes (~24 at 16M).
 This kernel cuts that to ~2 HBM passes: the classic GPU "merge path"
 decomposition splits the output into fixed-size chunks along cross
 diagonals of the merge matrix, and a Pallas program per chunk loads its
-two input slices into VMEM, runs the ENTIRE bitonic merge there, and
-writes its finished output chunk once.
+two input slices into VMEM, merges them entirely in VMEM, and writes its
+finished output chunk once.
 
   1. diagonal search (plain jnp, outside the kernel): for each output
      position d = p*CHUNK, binary-search the split (ai, bi), ai+bi=d, such
      that A[ai-1] < B[bi] and B[bi-1] < A[ai] in the strict lexicographic
      column order (keys are unique by construction — the packed
      klen<<8|prio column differs across runs).
-  2. pallas_call over grid=(P,): program p loads A[ai : ai+CHUNK] and
-     B[bi : bi+CHUNK] (padded loads; merge-path guarantees an output chunk
-     consumes at most CHUNK from each side), merges 2*CHUNK elements in
-     VMEM via the same compare-exchange stages as ops.device_sort, and
-     stores the first CHUNK — exactly out[d : d+CHUNK].
+  2. pallas_call over grid=(P,): program p DMAs the TILE-ALIGNED windows
+     A[al : al+W] and B[bl : bl+W] (al = ai rounded down to the 1024-lane
+     VMEM tile, W = CHUNK + TILE) from HBM into VMEM scratch, merges the
+     2W window bitonically, and stores rows [delta, delta+CHUNK) =
+     out[d : d+CHUNK], where delta = d - al - bl.
 
-Gated OFF by default (PEGASUS_PALLAS=1 enables): Mosaic lowering has not
-been validated on real TPU hardware in this environment (the tunnel was
-down); correctness is pinned against merge_two_sorted by interpret-mode
-tests (tests/test_pallas_merge.py) on the CPU mesh.
+Why aligned windows: Mosaic requires DMA slice offsets provably
+divisible by the memref tiling (1024 elements for i32 1D); arbitrary
+merge-path splits are not. Rounding both sides down to the tile keeps
+every DMA offset aligned (asserted via pl.multiple_of) at the cost of
+merging 2*(CHUNK+TILE) elements instead of 2*CHUNK. The residual
+delta = (ai-al) + (bi-bl) is < 2*TILE and congruent to 0 mod 1024
+(d is a multiple of CHUNK=2048; al, bl of 1024), so delta is always 0 or
+1024 — a whole number of (8,128) rows, making the output window a select
+between two static row slices. Correctness of the window trick: by the
+merge-path property everything in A[:ai] ∪ B[:bi] strictly precedes
+everything in A[ai:] ∪ B[bi:], so the sorted window's first delta
+elements are exactly A[al:ai] ∪ B[bl:bi] and the next CHUNK are exactly
+out[d : d+CHUNK] (the chunk consumes at most CHUNK from each side, which
+the window covers).
+
+Mosaic (real-TPU) lowering notes, learned on hardware (r3):
+  - refs in ANY/HBM space cannot be loaded directly; slices must move via
+    pltpu.make_async_copy into VMEM scratch, with tile-aligned offsets.
+  - per-program split offsets live in SMEM.
+  - the in-VMEM merge runs on a 2D (rows, 128) layout: flat element k
+    maps to (k // 128, k % 128). Stages with distance j >= 128 permute
+    whole sublane rows (slice+concat along axis 0); stages with j < 128
+    permute lanes via a 128x128 XOR one-hot matmul on the MXU (u32 split
+    into u8 quarters, exact in bf16), built in-kernel from iotas (pallas
+    forbids captured constant arrays).
+  - no rev primitive (flat reversal = row-order concat + lane-reverse
+    matmul); no select between i1 vectors (use boolean algebra); no
+    uint32<->bfloat16 casts (route through int32/float32).
+
+In interpret mode (CPU tests) the same windowed body runs with direct
+ref loads instead of DMA — the generic interpreter does not model
+Mosaic's memory spaces.
+
+Gated by PEGASUS_PALLAS (default ON since hardware validation; =0
+disables). Correctness is pinned against device_sort.merge_two_sorted by
+tests/test_pallas_merge.py (interpret mode) and by the on-hardware
+byte-equality stage of tools/tpu_session.py.
+
+Reference seam: the comparator loop inside RocksDB CompactRange
+(reference src/server/pegasus_server_impl.cpp:2814-2891).
 """
 
 import functools
@@ -30,14 +66,28 @@ import os
 
 import numpy as np
 
-from .device_sort import _exchange
+from .device_sort import _partner_concat, lex_cmp
 
 
 def pallas_enabled() -> bool:
-    return os.environ.get("PEGASUS_PALLAS", "0") == "1"
+    """Default: ON on real TPU (hardware byte-equality validated, r3),
+    OFF elsewhere — interpret mode is a correctness pin, far too slow to
+    be the CPU execution path. PEGASUS_PALLAS=1/0 forces either way."""
+    v = os.environ.get("PEGASUS_PALLAS")
+    if v is not None:
+        return v == "1"
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
-CHUNK = 2048  # output rows per program; 2*CHUNK*cols*4B stays well in VMEM
+CHUNK = 2048   # output rows per program
+LANES = 128
+TILE = 1024    # Mosaic 1D i32 VMEM tiling: DMA offsets must be multiples
+WINDOW = CHUNK + TILE          # elements DMA'd per side per program
+MERGE_ROWS = (4 * CHUNK) // LANES  # 2*WINDOW padded up to pow2, in rows
+HALF_ROWS = CHUNK // LANES     # rows in one output chunk
+WIN_ROWS = WINDOW // LANES
 
 
 def _lex_less_at(cols_a, ia, cols_b, ib):
@@ -77,6 +127,81 @@ def _diagonal_splits(a_cols, b_cols, nk, n_chunks):
     return lo  # == hi
 
 
+def _lane_permute(c, perm_of_lane):
+    """Apply out[.., l] = c[.., p] where perm_of_lane(p) == l, via the
+    MXU: multiply by the 128x128 one-hot permutation built in-kernel from
+    iotas, u32 split into u8 quarters so bf16 accumulation is exact.
+    Mosaic has no uint32<->bfloat16 casts: quarters route through int32
+    (bitcast; values 0..255) -> f32 -> bf16, and the f32 matmul result
+    back through int32."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    pr = lax.broadcasted_iota(jnp.uint32, (LANES, LANES), 0)
+    pc = lax.broadcasted_iota(jnp.uint32, (LANES, LANES), 1)
+    one = jnp.ones((LANES, LANES), jnp.float32)
+    p = jnp.where(pr == perm_of_lane(pc), one, 0.0).astype(jnp.bfloat16)
+    bits = lax.bitcast_convert_type(c, jnp.uint32)
+    out = None
+    for s in (0, 8, 16, 24):
+        q = (bits >> s) & jnp.uint32(0xFF)
+        qf = lax.bitcast_convert_type(q, jnp.int32).astype(
+            jnp.float32).astype(jnp.bfloat16)
+        sq = lax.dot(qf, p, preferred_element_type=jnp.float32)
+        sq = lax.bitcast_convert_type(sq.astype(jnp.int32), jnp.uint32) << s
+        out = sq if out is None else out | sq
+    return lax.bitcast_convert_type(out, c.dtype)
+
+
+def _lane_partner(c, j):
+    """Partner copy at lane distance j (< 128): XOR-j lane permutation."""
+    import jax.numpy as jnp
+
+    return _lane_permute(c, lambda l: l ^ jnp.uint32(j))
+
+
+def _flat_reverse(c, rows):
+    """Reverse a (rows, LANES) buffer in FLAT element order (k -> L-1-k):
+    reverse the row order (concat of row slices — Mosaic has no rev
+    primitive) then reverse within lanes (one-hot permutation matmul)."""
+    import jax.numpy as jnp
+
+    if rows > 1:
+        c = jnp.concatenate([c[r : r + 1] for r in range(rows - 1, -1, -1)],
+                            axis=0)
+    return _lane_permute(c, lambda l: jnp.uint32(LANES - 1) - l)
+
+
+def _merge_2d(cols, nk, rows):
+    """Bitonic merge of a (rows, LANES) bitonic buffer, flat order
+    k = row*LANES + lane, ascending in the first nk columns."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows_iota = lax.broadcasted_iota(jnp.uint32, (rows, LANES), 0)
+    lanes_iota = lax.broadcasted_iota(jnp.uint32, (rows, LANES), 1)
+    j = (rows * LANES) // 2
+    while j >= 1:
+        if j >= LANES:
+            # row-block swap at distance jr: _partner_concat slices the
+            # leading axis, so it works unchanged on the (rows, LANES)
+            # layout (and avoids tiny-dim reshapes Mosaic lowers poorly)
+            jr = j // LANES
+            is_high = (rows_iota & jnp.uint32(jr)) != 0
+            px = [_partner_concat(c, jr) for c in cols]
+        else:
+            is_high = (lanes_iota & jnp.uint32(j)) != 0
+            px = [_lane_partner(c, j) for c in cols]
+        p_lt, p_eq = lex_cmp(px[:nk], cols[:nk])
+        p_gt = ~p_lt & ~p_eq
+        # boolean algebra, not where(): Mosaic cannot select between i1
+        # vectors (i8->i1 trunci is unsupported)
+        take_p = (is_high & p_gt) | (~is_high & p_lt)
+        cols = [jnp.where(take_p, pc, c) for c, pc in zip(cols, px)]
+        j //= 2
+    return cols
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_merge(la, lb, n_ops, nk, interpret):
     import jax
@@ -86,55 +211,111 @@ def _compiled_merge(la, lb, n_ops, nk, interpret):
     L_out = la + lb
     n_chunks = -(-L_out // CHUNK)
 
+    def kernel(al_ref, bl_ref, fill_ref, *refs):
+        p = pl.program_id(0)
+        a_refs = refs[:n_ops]
+        b_refs = refs[n_ops : 2 * n_ops]
+        out_refs = refs[2 * n_ops : 3 * n_ops]
+        # al/bl hold ROW offsets (elements // LANES), multiples of
+        # TILE // LANES = 8 — exactly the (8, 128) VMEM tile row count
+        ar0 = pl.multiple_of(al_ref[p], TILE // LANES)
+        br0 = pl.multiple_of(bl_ref[p], TILE // LANES)
+        if interpret:
+            a_cols = [ar[pl.ds(ar0, WIN_ROWS)] for ar in a_refs]
+            b_cols = [br[pl.ds(br0, WIN_ROWS)] for br in b_refs]
+        else:
+            from jax.experimental.pallas import tpu as pltpu
+
+            scratch = refs[3 * n_ops : 5 * n_ops]
+            sem = refs[5 * n_ops]
+            copies = []
+            for i in range(n_ops):
+                copies.append(pltpu.make_async_copy(
+                    a_refs[i].at[pl.ds(ar0, WIN_ROWS)], scratch[i],
+                    sem.at[2 * i]))
+                copies.append(pltpu.make_async_copy(
+                    b_refs[i].at[pl.ds(br0, WIN_ROWS)],
+                    scratch[n_ops + i], sem.at[2 * i + 1]))
+            for c in copies:
+                c.start()
+            for c in copies:
+                c.wait()
+            a_cols = [s[...] for s in scratch[:n_ops]]
+            b_cols = [s[...] for s in scratch[n_ops : 2 * n_ops]]
+        # bitonic input: A window ascending, pad fill (sorts last), B
+        # window reversed in flat order — pow2 total of MERGE_ROWS rows
+        pad_rows = MERGE_ROWS - 2 * WIN_ROWS
+        cols = []
+        for i, (a, b) in enumerate(zip(a_cols, b_cols)):
+            fill = jnp.full((pad_rows, LANES), fill_ref[i], a.dtype)
+            cols.append(jnp.concatenate(
+                [a, fill, _flat_reverse(b, WIN_ROWS)], axis=0))
+        cols = _merge_2d(cols, nk, MERGE_ROWS)
+        # delta = d - al - bl is 0 or TILE (see module docstring): the
+        # output chunk is one of two static row windows
+        delta_rows = jnp.int32(p) * HALF_ROWS - ar0 - br0
+        hi = delta_rows > 0
+        for out_ref, c in zip(out_refs, cols):
+            lo_w = c[:HALF_ROWS]
+            hi_w = c[TILE // LANES : TILE // LANES + HALF_ROWS]
+            out_ref[...] = jnp.where(hi, hi_w, lo_w)
+
+    def row_pad(c, f):
+        """Pad so every aligned WINDOW row-range is in bounds, rounded up
+        to whole LANES rows, and reshape to (rows, LANES)."""
+        import jax.numpy as jnp
+
+        n = c.shape[0]
+        total = -(-(n + WINDOW) // LANES) * LANES
+        return jnp.concatenate(
+            [c, jnp.full((total - n,), f, c.dtype)]).reshape(-1, LANES)
+
     def fn(a_ops, b_ops, pad_fill):
-        # pad inputs so every CHUNK-window load is in bounds; pads sort last
-        # and merge-path never assigns them to a real output chunk
-        a_pad = [jnp.concatenate([c, jnp.full((CHUNK,), f, c.dtype)])
-                 for c, f in zip(a_ops, pad_fill)]
-        b_pad = [jnp.concatenate([c, jnp.full((CHUNK,), f, c.dtype)])
-                 for c, f in zip(b_ops, pad_fill)]
+        # pads sort last and merge-path never assigns them a real chunk
+        a_pad = [row_pad(c, f) for c, f in zip(a_ops, pad_fill)]
+        b_pad = [row_pad(c, f) for c, f in zip(b_ops, pad_fill)]
         ai = _diagonal_splits(a_ops, b_ops, nk, n_chunks)
         bi = jnp.arange(n_chunks, dtype=jnp.int32) * CHUNK - ai
+        # row offsets of the tile-aligned windows
+        al = ((ai // TILE) * TILE) // LANES
+        bl = ((bi // TILE) * TILE) // LANES
+        # per-column pad fill as an SMEM input; i32 bit patterns (the
+        # kernel's jnp.full converts back to each column dtype, wrapping)
+        fills = jnp.stack(
+            [jnp.asarray(f).astype(jnp.int32) for f in pad_fill])
 
-        # split points + full-array refs with manual dynamic slicing keeps
-        # the spec simple across pallas versions
-        grid = (n_chunks,)
+        out_shapes = [
+            jax.ShapeDtypeStruct((n_chunks * HALF_ROWS, LANES), c.dtype)
+            for c in a_ops
+        ]
+        out_specs = [
+            pl.BlockSpec((HALF_ROWS, LANES), lambda p: (p, 0))
+            for _ in a_ops
+        ]
+        if interpret:
+            in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (3 + 2 * n_ops)
+            scratch_shapes = []
+        else:
+            from jax.experimental.pallas import tpu as pltpu
 
-        def kernel(ai_ref, bi_ref, *refs):
-            p = pl.program_id(0)
-            a_refs = refs[:n_ops]
-            b_refs = refs[n_ops : 2 * n_ops]
-            out_refs = refs[2 * n_ops :]
-            a0 = ai_ref[p]
-            b0 = bi_ref[p]
-            cols = []
-            for ar, br in zip(a_refs, b_refs):
-                a = ar[pl.ds(a0, CHUNK)]
-                b = br[pl.ds(b0, CHUNK)]
-                cols.append(jnp.concatenate([a, b[::-1]]))
-            from jax import lax
-
-            L = 2 * CHUNK
-            iota = lax.iota(jnp.uint32, L)
-            j = L // 2
-            while j >= 1:
-                is_high = (iota & jnp.uint32(j)) != 0
-                cols = _exchange(cols, nk, j, is_high, mxu=False)
-                j //= 2
-            for out_ref, c in zip(out_refs, cols):
-                out_ref[pl.ds(p * CHUNK, CHUNK)] = c[:CHUNK]
-
-        out_shapes = [jax.ShapeDtypeStruct((n_chunks * CHUNK,), c.dtype)
-                      for c in a_ops]
+            in_specs = (
+                [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
+                + [pl.BlockSpec(memory_space=pl.ANY)] * (2 * n_ops)
+            )
+            scratch_shapes = (
+                [pltpu.VMEM((WIN_ROWS, LANES), c.dtype) for c in a_ops] * 2
+                + [pltpu.SemaphoreType.DMA((2 * n_ops,))]
+            )
         merged = pl.pallas_call(
             kernel,
-            grid=grid,
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 + 2 * n_ops),
-            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_ops,
+            grid=(n_chunks,),
+            in_specs=in_specs,
+            out_specs=out_specs,
             out_shape=out_shapes,
+            scratch_shapes=scratch_shapes,
             interpret=interpret,
-        )(ai, bi, *a_pad, *b_pad)
-        return [m[:L_out] for m in merged]
+        )(al, bl, fills, *a_pad, *b_pad)
+        return [m.reshape(-1)[:L_out] for m in merged]
 
     return jax.jit(fn)
 

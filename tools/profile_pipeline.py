@@ -1,0 +1,214 @@
+"""Per-piece timing of the TPU compaction pipeline on the live chip.
+
+Breaks the _pipeline_body cost into: merge tree, dedup mask, aux
+gathers+filter, cumsum, final scatter, and the survivor-index download,
+each timed as its own jitted call with block_until_ready. Run directly:
+
+    python tools/profile_pipeline.py [N]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def t(fn, *args, reps=3):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    n_total = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    from pegasus_tpu.base.utils import enable_compile_cache
+
+    enable_compile_cache(REPO)
+    import jax
+    import jax.numpy as jnp
+
+    import bench as B
+    from pegasus_tpu.engine.block import KVBlock
+    from pegasus_tpu.ops.compact import (CompactOptions, TpuBackend, pack_runs,
+                                         _pow2ceil)
+
+    print("platform:", jax.devices()[0], flush=True)
+    n_runs = 4
+    per = n_total // n_runs
+    t0 = time.perf_counter()
+    runs = [B.presort_run(B.make_run(per, 100, seed=s,
+                                     key_space=max(1, n_total // 2)))
+            for s in range(n_runs)]
+    opts = CompactOptions(backend="tpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    packed = pack_runs(runs, opts, need_sbytes=True)
+    concat = KVBlock.concat(runs)
+    print(f"fill+pack: {time.perf_counter()-t0:.2f}s", flush=True)
+
+    backend = TpuBackend()
+    prep = backend.prepare(packed)
+    nk = prep.w + (2 if prep.has_rank else 1)
+
+    # --- stage 1: merge tree alone (no dedup/filter/scatter) ---
+    from pegasus_tpu.ops.device_sort import merge_two_sorted
+
+    def merge_tree(run_cols):
+        items = []
+        for i, rc in enumerate(run_cols):
+            *kcols, klen, idx = rc
+            kp = (klen << jnp.uint32(8)) | jnp.uint32(i)
+            items.append((prep.padded_lens[i], list(kcols) + [kp, idx]))
+        pad_fill = tuple([0xFFFFFFFF] * nk + [np.int32(-1)])
+        while len(items) > 1:
+            items.sort(key=lambda x: x[0])
+            (la, a), (lb, b) = items[0], items[1]
+            merged = merge_two_sorted(a, b, nk, pad_fill)
+            lm = _pow2ceil(la + lb)
+            if lm > la + lb:
+                merged = [c[: la + lb] for c in merged]
+            items = items[2:] + [(la + lb, merged)]
+        return items[0][1]
+
+    jtree = jax.jit(merge_tree)
+    s, cols = t(jtree, prep.run_cols)
+    print(f"merge tree: {s:.3f}s", flush=True)
+    cols = list(cols)
+
+    # --- stage 2: dedup mask + aux gather + filter mask ---
+    def mask_of(cols, aux):
+        idx = cols[-1]
+        kp = cols[nk - 1]
+        key_eq = cols[: nk - 1] + [kp >> jnp.uint32(8)]
+        import functools
+
+        same_tail = functools.reduce(
+            jnp.logical_and, [c[1:] == c[:-1] for c in key_eq])
+        same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
+        valid = idx >= 0
+        keep = valid & ~same
+        safe = jnp.maximum(idx, 0)
+        expire = jnp.take(aux[0], safe)
+        deleted = jnp.take(aux[1], safe)
+        hash32 = jnp.take(aux[2], safe)
+        expired = (expire > 0) & (expire <= jnp.uint32(100))
+        # hash32 returned (not just gathered) so XLA cannot dead-code the
+        # third aux gather the real _pipeline_body always pays
+        return keep & ~expired & ~deleted, hash32
+
+    jmask = jax.jit(mask_of)
+    s, (keep, _h) = t(jmask, cols, prep.aux)
+    print(f"dedup+filter mask: {s:.3f}s", flush=True)
+
+    # --- stage 3a: scatter compaction (current) ---
+    def compact_scatter(keep, idx):
+        n = idx.shape[0]
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        count = pos[-1] + 1
+        tgt = jnp.where(keep, pos, n)
+        out = jnp.full((n,), -1, jnp.int32).at[tgt].set(idx, mode="drop")
+        return out, count
+
+    jscat = jax.jit(compact_scatter)
+    s, (out_idx, count) = t(jscat, keep, cols[-1])
+    print(f"scatter compact: {s:.3f}s (count={int(count)})", flush=True)
+
+    # --- stage 3b: sort-based compaction alternative ---
+    def compact_sort(keep, idx):
+        n = idx.shape[0]
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        count = pos[-1] + 1
+        # output slot for each input: pos where kept, else n (tail)
+        key = jnp.where(keep, pos, n).astype(jnp.int32)
+        # stable ascending sort of (key, idx): kept rows land at [0, count)
+        order = jnp.argsort(key, stable=True)
+        return jnp.take(idx, order), count
+
+    jsort = jax.jit(compact_sort)
+    s, (out2, count2) = t(jsort, keep, cols[-1])
+    print(f"sort compact:    {s:.3f}s", flush=True)
+
+    # --- stage 3c: searchsorted-based compaction alternative ---
+    def compact_searchsorted(keep, idx):
+        n = idx.shape[0]
+        csum = jnp.cumsum(keep.astype(jnp.int32))
+        count = csum[-1]
+        q = jnp.arange(n, dtype=jnp.int32) + 1
+        j = jnp.searchsorted(csum, q, side="left")
+        out = jnp.take(idx, jnp.minimum(j, n - 1))
+        out = jnp.where(q <= count, out, -1)
+        return out, count
+
+    jss = jax.jit(compact_searchsorted)
+    s, (out3, count3) = t(jss, keep, cols[-1])
+    print(f"searchsorted compact: {s:.3f}s", flush=True)
+
+    a = np.asarray(out_idx[: int(count)])
+    b = np.asarray(out2[: int(count2)])
+    c3 = np.asarray(out3[: int(count3)])
+    print("compact variants equal:", np.array_equal(a, b), np.array_equal(a, c3),
+          flush=True)
+
+    # --- stage 4: index download (sync vs chunked-async) ---
+    cnt = int(count)
+    t0 = time.perf_counter()
+    idx_host = np.asarray(out_idx[:cnt])
+    print(f"index download sync ({cnt*4/1e6:.0f} MB): "
+          f"{time.perf_counter()-t0:.3f}s", flush=True)
+
+    dl = out_idx[:cnt]
+    t0 = time.perf_counter()
+    try:
+        dl.copy_to_host_async()
+        print(f"copy_to_host_async returned in {time.perf_counter()-t0:.3f}s",
+              flush=True)
+    except AttributeError:
+        print("copy_to_host_async NOT AVAILABLE", flush=True)
+    t0 = time.perf_counter()
+    _ = np.asarray(dl)
+    print(f"asarray after async: {time.perf_counter()-t0:.3f}s", flush=True)
+
+    # --- stage 5: host gather variants ---
+    kl0, vl0 = int(concat.key_len[0]), int(concat.val_len[0])
+    n = concat.n
+    key2d = concat.key_arena.reshape(n, kl0)
+    val2d = concat.val_arena.reshape(n, vl0)
+    t0 = time.perf_counter()
+    _k = key2d[idx_host]
+    _v = val2d[idx_host]
+    print(f"host gather numpy 2D fancy: {time.perf_counter()-t0:.3f}s "
+          f"({(_k.nbytes+_v.nbytes)/1e9:.2f} GB out)", flush=True)
+
+    from pegasus_tpu import native
+
+    if native.available():
+        t0 = time.perf_counter()
+        idx64 = idx_host.astype(np.int64)
+        ko, _ = native.gather_arena(concat.key_arena, concat.key_off,
+                                    concat.key_len, idx64)
+        vo, _ = native.gather_arena(concat.val_arena, concat.val_off,
+                                    concat.val_len, idx64)
+        print(f"host gather native arena: {time.perf_counter()-t0:.3f}s",
+              flush=True)
+
+    t0 = time.perf_counter()
+    from pegasus_tpu.ops.compact import gather_device_survivors
+
+    out = gather_device_survivors(concat, out_idx, cnt)
+    print(f"gather_device_survivors (chunked overlap): "
+          f"{time.perf_counter()-t0:.3f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

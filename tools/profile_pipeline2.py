@@ -1,0 +1,147 @@
+"""Incremental device-pipeline timing for the axon tunnel backend, where
+block_until_ready does not actually block: every measurement is forced by
+downloading one element of the result, and stage costs come from the
+difference between successive prefixes of the pipeline.
+
+    python tools/profile_pipeline2.py [N]
+"""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def force(x):
+    """Block until x is computed by downloading one element."""
+    leaf = x
+    while isinstance(leaf, (tuple, list)):
+        leaf = leaf[0]
+    return np.asarray(leaf[:1] if getattr(leaf, "ndim", 0) else leaf)
+
+
+def timed(label, fn, *args, reps=2):
+    out = fn(*args)
+    force(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        force(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label}: {best:.3f}s", flush=True)
+    return best, out
+
+
+def main():
+    n_total = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    from pegasus_tpu.base.utils import enable_compile_cache
+
+    enable_compile_cache(REPO)
+    import jax
+    import jax.numpy as jnp
+
+    import bench as B
+    from pegasus_tpu.ops.compact import (CompactOptions, TpuBackend,
+                                         pack_runs, _pow2ceil)
+    from pegasus_tpu.ops.device_sort import merge_two_sorted
+
+    print("platform:", jax.devices()[0], flush=True)
+    n_runs = 4
+    per = n_total // n_runs
+    runs = [B.presort_run(B.make_run(per, 100, seed=s,
+                                     key_space=max(1, n_total // 2)))
+            for s in range(n_runs)]
+    opts = CompactOptions(backend="tpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    packed = pack_runs(runs, opts, need_sbytes=True)
+    backend = TpuBackend()
+    prep = backend.prepare(packed)
+    force(prep.run_cols[0][0])  # uploads done
+    nk = prep.w + (2 if prep.has_rank else 1)
+    print("prep uploaded", flush=True)
+
+    def tree(run_cols):
+        items = []
+        for i, rc in enumerate(run_cols):
+            *kcols, klen, idx = rc
+            kp = (klen << jnp.uint32(8)) | jnp.uint32(i)
+            items.append((prep.padded_lens[i], list(kcols) + [kp, idx]))
+        pad_fill = tuple([0xFFFFFFFF] * nk + [np.int32(-1)])
+        while len(items) > 1:
+            items.sort(key=lambda x: x[0])
+            (la, a), (lb, b) = items[0], items[1]
+            merged = merge_two_sorted(a, b, nk, pad_fill)
+            lm = _pow2ceil(la + lb)
+            if lm > la + lb:
+                merged = [c[: la + lb] for c in merged]
+            items = items[2:] + [(la + lb, merged)]
+        return items[0][1]
+
+    def mask_of(cols, aux):
+        idx = cols[-1]
+        kp = cols[nk - 1]
+        key_eq = cols[: nk - 1] + [kp >> jnp.uint32(8)]
+        same_tail = functools.reduce(
+            jnp.logical_and, [c[1:] == c[:-1] for c in key_eq])
+        same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
+        keep = (idx >= 0) & ~same
+        safe = jnp.maximum(idx, 0)
+        expire = jnp.take(aux[0], safe)
+        deleted = jnp.take(aux[1], safe)
+        expired = (expire > 0) & (expire <= jnp.uint32(100))
+        return keep & ~expired & ~deleted
+
+    def p1(run_cols):
+        return tree(run_cols)[-1]
+
+    def p2(run_cols, aux):
+        cols = tree(run_cols)
+        return mask_of(cols, aux)
+
+    def p3(run_cols, aux):
+        cols = tree(run_cols)
+        keep = mask_of(cols, aux)
+        idx = cols[-1]
+        n = idx.shape[0]
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, pos, n)
+        out = jnp.full((n,), -1, jnp.int32).at[tgt].set(idx, mode="drop")
+        return out, pos[-1] + 1
+
+    def p3h(run_cols, aux):
+        cols = tree(run_cols)
+        keep = mask_of(cols, aux)
+        idx = cols[-1]
+        n = idx.shape[0]
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, pos, n)
+        out = jnp.full((n,), -1, jnp.int32).at[tgt].set(
+            idx, mode="drop", unique_indices=True, indices_are_sorted=True)
+        return out, pos[-1] + 1
+
+    t1, _ = timed("p1 merge tree", jax.jit(p1), prep.run_cols)
+    t2, _ = timed("p2 +dedup/filter mask", jax.jit(p2), prep.run_cols, prep.aux)
+    t3, o3 = timed("p3 +cumsum+scatter (current)", jax.jit(p3),
+                   prep.run_cols, prep.aux)
+    t3h, o3h = timed("p3h +cumsum+scatter hinted", jax.jit(p3h),
+                     prep.run_cols, prep.aux)
+    print(f"  => mask {t2-t1:.3f}s, scatter-part {t3-t2:.3f}s, "
+          f"hinted-scatter-part {t3h-t2:.3f}s", flush=True)
+    cnt = int(np.asarray(o3[1]))
+    a = np.asarray(o3[0][:cnt]); b = np.asarray(o3h[0][:cnt])
+    print("hinted equal:", np.array_equal(a, b), flush=True)
+
+    t0 = time.perf_counter()
+    _ = np.asarray(o3h[0][:cnt])
+    print(f"index download {cnt*4/1e6:.0f}MB: {time.perf_counter()-t0:.3f}s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
