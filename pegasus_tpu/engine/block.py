@@ -142,9 +142,56 @@ class KVBlock:
                 hi = mid
         return lo
 
+    def uniform_layout(self):
+        """(key_len, val_len) when every record has the same key and value
+        widths and both arenas are contiguous in row order — the layout
+        produced by fixed-width fills and by uniform gathers; None
+        otherwise."""
+        n = self.n
+        if not n:
+            return None
+        kl0 = int(self.key_len[0])
+        vl0 = int(self.val_len[0])
+        if (kl0 > 0 and int(self.key_len.min()) == kl0 == int(self.key_len.max())
+                and vl0 > 0
+                and int(self.val_len.min()) == vl0 == int(self.val_len.max())
+                and len(self.key_arena) == n * kl0
+                and len(self.val_arena) == n * vl0
+                and self.key_off[0] == 0
+                and int(self.key_off[-1]) == (n - 1) * kl0
+                and self.val_off[0] == 0
+                and int(self.val_off[-1]) == (n - 1) * vl0):
+            return kl0, vl0
+        return None
+
     def gather(self, idx) -> "KVBlock":
         """New block with rows idx (in that order); arenas compacted."""
         idx = np.asarray(idx, dtype=np.int64)
+        count = len(idx)
+        # fused one-pass native gather (keys+values+aux together, with
+        # source-row prefetch): the separate fancy-index sweeps are
+        # DRAM-latency-bound on large random gathers
+        if count >= (1 << 15) and self.n < (1 << 31):
+            from .. import native
+
+            uni = self.uniform_layout() if native.available() else None
+            if uni is not None:
+                kl0, vl0 = uni
+                out_k = np.empty(count * kl0, np.uint8)
+                out_v = np.empty(count * vl0, np.uint8)
+                out_e = np.empty(count, np.uint32)
+                out_h = np.empty(count, np.uint32)
+                out_d = np.empty(count, np.bool_)
+                if native.gather_block_uniform(
+                        self.key_arena, kl0, self.val_arena, vl0,
+                        self.expire_ts, self.hash32, self.deleted,
+                        idx.astype(np.int32), out_k, out_v, out_e, out_h,
+                        out_d):
+                    return KVBlock(
+                        out_k, np.arange(count, dtype=np.int64) * kl0,
+                        np.full(count, kl0, np.int32),
+                        out_v, np.arange(count, dtype=np.int64) * vl0,
+                        np.full(count, vl0, np.int32), out_e, out_h, out_d)
         ka, ko, kl = _gather_arena(self.key_arena, self.key_off, self.key_len, idx)
         va, vo, vl = _gather_arena(self.val_arena, self.val_off, self.val_len, idx)
         return KVBlock(ka, ko, kl, va, vo, vl,

@@ -57,6 +57,10 @@ def _load():
                                       ctypes.c_int32, u32p]
         lib.merge_counts.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64,
                                      ctypes.c_int64, ctypes.c_int32, i64p]
+        boolp = np.ctypeslib.ndpointer(np.bool_, flags="C")
+        lib.gather_block_uniform.argtypes = [
+            u8p, ctypes.c_int64, u8p, ctypes.c_int64, u32p, u32p, boolp,
+            i32p, ctypes.c_int64, u8p, u8p, u32p, u32p, boolp]
         _lib = lib
         return _lib
 
@@ -108,6 +112,27 @@ def pack_prefixes(arena, off, len32, w):
                       np.ascontiguousarray(len32, np.int32), n, w,
                       out.reshape(-1))
     return out.T
+
+
+def gather_block_uniform(key_arena, klen, val_arena, vlen, expire, hash32,
+                         deleted, idx, out_keys, out_vals, out_expire,
+                         out_hash32, out_deleted) -> bool:
+    """Fused one-pass gather of a uniform-record block into preallocated
+    outputs (keys, values, expire, hash32, deleted) with source-row
+    prefetching. idx is int32. Returns False if the library is absent
+    (caller falls back to per-array fancy indexing)."""
+    lib = _load()
+    if lib is None:
+        return False
+    lib.gather_block_uniform(
+        np.ascontiguousarray(key_arena, np.uint8), int(klen),
+        np.ascontiguousarray(val_arena, np.uint8), int(vlen),
+        np.ascontiguousarray(expire, np.uint32),
+        np.ascontiguousarray(hash32, np.uint32),
+        np.ascontiguousarray(deleted, np.bool_),
+        np.ascontiguousarray(idx, np.int32), len(idx),
+        out_keys, out_vals, out_expire, out_hash32, out_deleted)
+    return True
 
 
 def merge_counts(a_sbytes, b_sbytes, side: str):

@@ -109,6 +109,46 @@ void pack_prefixes(const uint8_t* arena, const int64_t* off,
     }
 }
 
+// ------------------------------------------------ fused uniform gather
+
+// Materialize a compaction output block from uniform-width records in ONE
+// pass over the survivor index: keys (klen bytes each), values (vlen),
+// expire/hash32 (u32) and deleted (u8) move together, so idx is read once
+// and the random-access source rows are software-prefetched ahead of use.
+// The separate-pass form (5 fancy-index sweeps) was measured 2.0-2.9s at
+// 8.5M survivors on the 1-core dev host — DRAM-latency-bound on the
+// dependent row loads; prefetching + fusion cuts most of the stalls.
+void gather_block_uniform(const uint8_t* key_arena, int64_t klen,
+                          const uint8_t* val_arena, int64_t vlen,
+                          const uint32_t* expire, const uint32_t* hash32,
+                          const uint8_t* deleted, const int32_t* idx,
+                          int64_t n, uint8_t* out_keys, uint8_t* out_vals,
+                          uint32_t* out_expire, uint32_t* out_hash32,
+                          uint8_t* out_deleted) {
+    const int64_t AHEAD = 24;
+    for (int64_t i = 0; i < n; i++) {
+        if (i + AHEAD < n) {
+            int64_t ja = (int64_t)idx[i + AHEAD];
+            __builtin_prefetch(key_arena + ja * klen, 0, 0);
+            __builtin_prefetch(val_arena + ja * vlen, 0, 0);
+            // values can span multiple lines; touch the middle + tail too
+            if (vlen > 64)
+                __builtin_prefetch(val_arena + ja * vlen + 64, 0, 0);
+            if (vlen > 128)
+                __builtin_prefetch(val_arena + ja * vlen + vlen - 1, 0, 0);
+            __builtin_prefetch(expire + ja, 0, 0);
+            __builtin_prefetch(hash32 + ja, 0, 0);
+            __builtin_prefetch(deleted + ja, 0, 0);
+        }
+        int64_t j = (int64_t)idx[i];
+        memcpy(out_keys + i * klen, key_arena + j * klen, (size_t)klen);
+        memcpy(out_vals + i * vlen, val_arena + j * vlen, (size_t)vlen);
+        out_expire[i] = expire[j];
+        out_hash32[i] = hash32[j];
+        out_deleted[i] = deleted[j];
+    }
+}
+
 // ----------------------------------------------------- sorted-run merge
 
 // Count, for each record of run A (fixed-width keys, itemsize bytes,

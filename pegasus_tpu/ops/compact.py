@@ -96,9 +96,35 @@ class PackedRuns:
     gidx: list                  # per run: int32[n_i] global concat index
     sbytes: list                # per run: S-dtype[n_i] (lazy; may hold None)
     lens: tuple                 # per run real lengths
-    expire: np.ndarray          # concatenated, global-index order
-    deleted: np.ndarray
-    hash32: np.ndarray
+    blocks: list                # the source KVBlocks (for lazy global aux)
+    run_aux: list               # per run: (expire, deleted, hash32) in ROW
+                                # order — lets the device fold the TTL/
+                                # stale/tomb filter elementwise before the
+                                # merge instead of gathering by gidx after
+
+    # global-index-order aux, built lazily: only the CPU backend's
+    # post-merge filter reads these; the TPU path consumes run_aux, so
+    # eager concatenation would copy ~9B/record for nothing
+    @property
+    def expire(self) -> np.ndarray:
+        if self._expire is None:
+            self._expire = np.concatenate([b.expire_ts for b in self.blocks])
+        return self._expire
+
+    @property
+    def deleted(self) -> np.ndarray:
+        if self._deleted is None:
+            self._deleted = np.concatenate([b.deleted for b in self.blocks])
+        return self._deleted
+
+    @property
+    def hash32(self) -> np.ndarray:
+        if self._hash32 is None:
+            self._hash32 = np.concatenate([b.hash32 for b in self.blocks])
+        return self._hash32
+
+    def __post_init__(self):
+        self._expire = self._deleted = self._hash32 = None
 
 
 def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
@@ -112,19 +138,21 @@ def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
         concat = KVBlock.concat(runs)
         ranks_all = compute_suffix_ranks(concat, w)
     offsets = np.cumsum([0] + [b.n for b in runs])
-    cols, rank_l, klen_l, gidx_l, sb_l = [], [], [], [], []
+    cols, rank_l, klen_l, gidx_l, sb_l, aux_l = [], [], [], [], [], []
     sorted_known = bool(opts.runs_sorted)
     for i, b in enumerate(runs):
         pref = pack_key_prefixes(b.key_arena, b.key_off, b.key_len, w)
         kl = b.key_len.astype(np.uint32)
         rk = ranks_all[offsets[i] : offsets[i + 1]] if has_rank else None
         gi = np.arange(offsets[i], offsets[i + 1], dtype=np.int32)
+        ex, de, hs = b.expire_ts, b.deleted, b.hash32
         sb = None
         if need_sbytes or not sorted_known:
             sb = pack_sbytes([pref[:, j] for j in range(w)], kl, rk)
             if not sorted_known and not _is_sorted(sb):
                 order = np.argsort(sb, kind="stable")
                 pref, kl, gi, sb = pref[order], kl[order], gi[order], sb[order]
+                ex, de, hs = ex[order], de[order], hs[order]
                 if rk is not None:
                     rk = rk[order]
         cols.append([np.ascontiguousarray(pref[:, j]) for j in range(w)])
@@ -132,12 +160,11 @@ def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
         klen_l.append(kl)
         gidx_l.append(gi)
         sb_l.append(sb)
+        aux_l.append((ex, de, hs))
     return PackedRuns(
         w=w, has_rank=has_rank, cols=cols, rank=rank_l, klen=klen_l,
         gidx=gidx_l, sbytes=sb_l, lens=tuple(b.n for b in runs),
-        expire=np.concatenate([b.expire_ts for b in runs]),
-        deleted=np.concatenate([b.deleted for b in runs]),
-        hash32=np.concatenate([b.hash32 for b in runs]),
+        blocks=list(runs), run_aux=aux_l,
     )
 
 
@@ -209,7 +236,9 @@ class DevicePacked:
     not PCIe (SURVEY.md §5.7c 'HBM-resident key blocks')."""
 
     run_cols: tuple   # per run: (w [+rank] prefix cols, klen, gidx) jax arrays
-    aux: tuple        # (expire, deleted, hash32) jax arrays, concat order
+    aux: tuple        # per run: (expire, deleted, hash32) jax arrays,
+                      # ROW-aligned and padded like run_cols (feeds the
+                      # pre-merge filter fold; NOT concat order)
     padded_lens: tuple
     w: int
     has_rank: bool
@@ -310,6 +339,7 @@ class TpuBackend:
 
         padded_lens = tuple(_pow2ceil(n, _MIN_BUCKET) for n in packed.lens)
         run_cols = []
+        aux = []
         for i in range(len(packed.lens)):
             arrays = list(packed.cols[i])
             if packed.has_rank:
@@ -319,9 +349,13 @@ class TpuBackend:
             run_cols.append(tuple(
                 jnp.asarray(_pad_to(a, padded_lens[i])) for a in arrays
             ))
-        aux = (jnp.asarray(packed.expire), jnp.asarray(packed.deleted),
-               jnp.asarray(packed.hash32))
-        return DevicePacked(tuple(run_cols), aux, padded_lens,
+            # per-run ROW-aligned aux, zero-padded (pads are already
+            # excluded by gidx == -1, so their filter bits are moot)
+            ex, de, hs = packed.run_aux[i]
+            aux.append(tuple(
+                jnp.asarray(_zpad_to(a, padded_lens[i]))
+                for a in (ex, de, hs)))
+        return DevicePacked(tuple(run_cols), tuple(aux), padded_lens,
                             packed.w, packed.has_rank)
 
     def survivors_device(self, packed, now, pidx, pmask, bottommost,
@@ -361,21 +395,14 @@ def gather_device_survivors(concat: KVBlock, dev_idx, count: int,
     if count == 0:
         return KVBlock.empty()
     n = concat.n
-    kl, vl = concat.key_len, concat.val_len
-    kl0 = int(kl[0]) if n else 0
-    vl0 = int(vl[0]) if n else 0
-    uniform = (
-        count >= (1 << 16) and chunks > 1
-        and kl0 > 0 and int(kl.min()) == kl0 == int(kl.max())
-        and vl0 > 0 and int(vl.min()) == vl0 == int(vl.max())
-        and len(concat.key_arena) == n * kl0
-        and len(concat.val_arena) == n * vl0
-        and concat.key_off[0] == 0
-        and int(concat.key_off[-1]) == (n - 1) * kl0
-        and concat.val_off[0] == 0
-        and int(concat.val_off[-1]) == (n - 1) * vl0)
-    if not uniform:
+    uni = concat.uniform_layout() if (count >= (1 << 16) and chunks > 1
+                                      and n < (1 << 31)) else None
+    if uni is None:
         return concat.gather(np.asarray(dev_idx[:count]))
+    kl0, vl0 = uni
+    from .. import native
+
+    use_native = native.available()
     key2d = concat.key_arena.reshape(n, kl0)
     val2d = concat.val_arena.reshape(n, vl0)
     out_k = np.empty((count, kl0), np.uint8)
@@ -396,6 +423,12 @@ def gather_device_survivors(concat: KVBlock, dev_idx, count: int,
         parts.append((a, b, part))
     for a, b, part in parts:
         idx = np.asarray(part)
+        if use_native and native.gather_block_uniform(
+                concat.key_arena, kl0, concat.val_arena, vl0,
+                concat.expire_ts, concat.hash32, concat.deleted,
+                idx.astype(np.int32, copy=False),
+                out_k[a:b], out_v[a:b], out_e[a:b], out_h[a:b], out_d[a:b]):
+            continue
         out_k[a:b] = key2d[idx]
         out_v[a:b] = val2d[idx]
         out_e[a:b] = concat.expire_ts[idx]
@@ -418,7 +451,15 @@ def _pad_to(a: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
-def _pipeline_body(run_cols, aux, padded_lens, nk, use_pallas,
+def _zpad_to(a: np.ndarray, n: int) -> np.ndarray:
+    if len(a) == n:
+        return a
+    out = np.zeros(n, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def _pipeline_body(run_cols, aux_runs, padded_lens, nk, use_pallas,
                    now, pidx, pmask, bottommost, do_filter):
     """Traced merge→dedup→filter→compact body shared by both jitted entry
     points (host-packed and device-cached runs).
@@ -426,7 +467,14 @@ def _pipeline_body(run_cols, aux, padded_lens, nk, use_pallas,
     Sort key per record: (w prefix lanes, [suffix rank,] klen<<8|prio).
     Pads carry 0xFFFFFFFF keys / idx -1 and sort to the tail of every
     merge; they are excluded by the idx >= 0 guard at the end.
-    """
+
+    aux_runs holds each run's ROW-aligned (expire, deleted, hash32): the
+    TTL/stale/tombstone filter folds into the idx column BEFORE the merge
+    (filtered rows get idx -1, elementwise — no post-merge aux gathers,
+    which cost ~0.5s at 16M on hardware). Row-equivalent to the old
+    post-merge form: a key's duplicates are masked by `same` regardless of
+    the newest version's filter bit, so a filtered newest still shadows
+    (and drops) its older versions, exactly as before."""
     import jax.numpy as jnp
 
     from .device_sort import merge_two_sorted
@@ -435,6 +483,11 @@ def _pipeline_body(run_cols, aux, padded_lens, nk, use_pallas,
     items = []
     for i, rc in enumerate(run_cols):
         *kcols, klen, idx = rc
+        expire, deleted, hash32 = aux_runs[i]
+        expired = (expire > 0) & (expire <= now)
+        stale = jnp.where(pmask > 0, (hash32 & pmask) != pidx, False)
+        filt = expired | stale | (deleted & bottommost)
+        idx = jnp.where(do_filter & filt, np.int32(-1), idx)
         kp = (klen << jnp.uint32(8)) | jnp.uint32(i)
         items.append((padded_lens[i], list(kcols) + [kp, idx]))
     pad_fill = tuple([_U32_MAX] * nk + [np.int32(-1)])
@@ -458,16 +511,7 @@ def _pipeline_body(run_cols, aux, padded_lens, nk, use_pallas,
         jnp.logical_and, [c[1:] == c[:-1] for c in key_eq_cols]
     )
     same = jnp.concatenate([jnp.zeros(1, dtype=bool), same_tail])
-    valid = idx >= 0
-    keep = valid & ~same
-    safe_idx = jnp.maximum(idx, 0)
-    expire = jnp.take(aux[0], safe_idx)
-    deleted = jnp.take(aux[1], safe_idx)
-    hash32 = jnp.take(aux[2], safe_idx)
-    expired = (expire > 0) & (expire <= now)
-    stale = jnp.where(pmask > 0, (hash32 & pmask) != pidx, False)
-    tomb = deleted & bottommost
-    keep = jnp.where(do_filter, keep & ~expired & ~stale & ~tomb, keep)
+    keep = (idx >= 0) & ~same
     n = idx.shape[0]
     pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
     count = pos[-1] + 1
@@ -537,11 +581,11 @@ def _make_cached_fn(padded_lens: tuple, run_ws: tuple, w: int,
             gidx = jnp.where(in_run, iota + np.int32(padded_offsets[i]),
                              np.int32(-1))
             run_cols.append(tuple(kcols + [klen, gidx]))
-        aux = tuple(
-            jnp.concatenate([aux_runs[i][j] for i in range(len(aux_runs))])
-            for j in range(3))
+        # aux_runs are already per-run ROW-aligned padded columns — exactly
+        # what the pre-merge filter fold consumes (pad rows carry zeros,
+        # and their gidx is -1 regardless)
         out_idx, count = _pipeline_body(
-            run_cols, aux, padded_lens, nk, use_pallas,
+            run_cols, aux_runs, padded_lens, nk, use_pallas,
             now, pidx, pmask, bottommost, do_filter)
         # padded-concat -> real-concat index mapping: subtract each run's
         # accumulated pad slack (static boundaries, traced deltas)
