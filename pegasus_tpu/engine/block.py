@@ -146,12 +146,19 @@ class KVBlock:
         """(key_len, val_len) when every record has the same key and value
         widths and both arenas are contiguous in row order — the layout
         produced by fixed-width fills and by uniform gathers; None
-        otherwise."""
+        otherwise.
+
+        Precondition: offsets are MONOTONIC in row order (true for every
+        constructor in this codebase — _as_arena, gather, concat all emit
+        ascending offsets). The check probes endpoints plus a midpoint, so
+        a hand-built block whose offsets permute rows with matching probe
+        points would be misclassified as row-contiguous."""
         n = self.n
         if not n:
             return None
         kl0 = int(self.key_len[0])
         vl0 = int(self.val_len[0])
+        mid = n // 2
         if (kl0 > 0 and int(self.key_len.min()) == kl0 == int(self.key_len.max())
                 and vl0 > 0
                 and int(self.val_len.min()) == vl0 == int(self.val_len.max())
@@ -159,8 +166,10 @@ class KVBlock:
                 and len(self.val_arena) == n * vl0
                 and self.key_off[0] == 0
                 and int(self.key_off[-1]) == (n - 1) * kl0
+                and int(self.key_off[mid]) == mid * kl0
                 and self.val_off[0] == 0
-                and int(self.val_off[-1]) == (n - 1) * vl0):
+                and int(self.val_off[-1]) == (n - 1) * vl0
+                and int(self.val_off[mid]) == mid * vl0):
             return kl0, vl0
         return None
 
@@ -175,6 +184,13 @@ class KVBlock:
             from .. import native
 
             uni = self.uniform_layout() if native.available() else None
+            # the native kernel does unchecked pointer arithmetic; keep
+            # numpy's bounds semantics (negatives/OOB fall through to the
+            # fancy-index path, which wraps or raises) — two O(n)
+            # reductions, negligible next to the gather
+            if uni is not None and (int(idx.min()) < 0
+                                    or int(idx.max()) >= self.n):
+                uni = None
             if uni is not None:
                 kl0, vl0 = uni
                 out_k = np.empty(count * kl0, np.uint8)

@@ -73,6 +73,12 @@ class EngineOptions:
     level_base_bytes: int = 256 << 20        # L1 budget; Ln = base * ratio^(n-1)
     level_size_ratio: int = 10
     device_cache_bytes: int = 8 << 30  # HBM budget for resident run columns
+    # value residency: pin uniform-layout value rows in HBM alongside the
+    # key columns so compaction outputs materialize on device (host gather
+    # was the r3 bottleneck: 1.27s vs 0.375s merge at 10M). Off until the
+    # hardware session proves the download beats the host gather on this
+    # tunnel; engine_bench measures both.
+    device_values: bool = False
     checkpoint_reserve_min_count: int = 2
     checkpoint_reserve_time_seconds: int = 0  # 0 = no time-based retention
     user_ops: tuple = ()            # parsed user-specified compaction rules
@@ -417,7 +423,8 @@ class LsmEngine:
             if self._device_cache_used >= self.opts.device_cache_bytes:
                 return None
         try:
-            dr = sst.device_run(self.opts.prefix_u32)
+            dr = sst.device_run(self.opts.prefix_u32,
+                                with_values=self.opts.device_values)
         except Exception as e:  # device OOM / backend failure: degrade
             print(f"[engine] device-run prime failed for {sst.path}: {e!r}",
                   flush=True)

@@ -227,17 +227,19 @@ class SSTable:
         """First index with block.key(i) >= key (n if none)."""
         return self.block().lower_bound(key)
 
-    def device_run(self, prefix_u32: int):
+    def device_run(self, prefix_u32: int, with_values: bool = False):
         """Lazily pack + upload this file's sort columns to the device and
         PIN them for its lifetime (the engine's HBM-resident run cache,
         SURVEY §5.7c): compactions this file joins read HBM instead of
         re-packing and re-crossing PCIe every time. Returns None when the
         run is uncacheable (keys beyond the prefix window need per-merge
-        suffix ranks)."""
+        suffix ranks). with_values additionally pins uniform-layout value
+        rows (value residency; see EngineOptions.device_values)."""
         if self._device_run is None and not self._device_uncacheable:
             from ..ops.compact import pack_run_device
 
-            self._device_run = pack_run_device(self.block(), prefix_u32)
+            self._device_run = pack_run_device(self.block(), prefix_u32,
+                                               with_values=with_values)
             if self._device_run is None:
                 self._device_uncacheable = True
         return self._device_run

@@ -45,24 +45,52 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
-        i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
-        i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
-        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
-        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C")
-        lib.crc64_batch.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u64p]
-        lib.gather_arena.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64,
-                                     u8p, i64p]
-        lib.pack_prefixes.argtypes = [u8p, i64p, i32p, ctypes.c_int64,
-                                      ctypes.c_int32, u32p]
-        lib.merge_counts.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64,
-                                     ctypes.c_int64, ctypes.c_int32, i64p]
-        boolp = np.ctypeslib.ndpointer(np.bool_, flags="C")
-        lib.gather_block_uniform.argtypes = [
-            u8p, ctypes.c_int64, u8p, ctypes.c_int64, u32p, u32p, boolp,
-            i32p, ctypes.c_int64, u8p, u8p, u32p, u32p, boolp]
+        try:
+            _bind(lib)
+        except AttributeError:
+            # a stale prebuilt .so that predates a symbol (mtime passed on
+            # clock skew / shipped artifact): rebuild once, else degrade to
+            # the numpy fallbacks instead of crashing available(). The
+            # rebuilt library must load from a UNIQUE path — dlopen dedupes
+            # by pathname, so re-CDLLing _SO would return the stale handle
+            if not _build():
+                return None
+            import shutil
+            import tempfile
+
+            tmp = tempfile.NamedTemporaryFile(prefix="libhostops_",
+                                              suffix=".so", delete=False)
+            tmp.close()
+            try:
+                shutil.copy(_SO, tmp.name)
+                lib = ctypes.CDLL(tmp.name)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib) -> None:
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C")
+    lib.crc64_batch.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u64p]
+    lib.gather_arena.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64,
+                                 u8p, i64p]
+    lib.pack_prefixes.argtypes = [u8p, i64p, i32p, ctypes.c_int64,
+                                  ctypes.c_int32, u32p]
+    lib.merge_counts.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                                 ctypes.c_int64, ctypes.c_int32, i64p]
+    boolp = np.ctypeslib.ndpointer(np.bool_, flags="C")
+    lib.gather_block_uniform.argtypes = [
+        u8p, ctypes.c_int64, u8p, ctypes.c_int64, u32p, u32p, boolp,
+        i32p, ctypes.c_int64, u8p, u8p, u32p, u32p, boolp]
+    lib.gather_keys_uniform.argtypes = [
+        u8p, ctypes.c_int64, u32p, u32p, boolp,
+        i32p, ctypes.c_int64, u8p, u32p, u32p, boolp]
 
 
 def available() -> bool:
@@ -132,6 +160,25 @@ def gather_block_uniform(key_arena, klen, val_arena, vlen, expire, hash32,
         np.ascontiguousarray(deleted, np.bool_),
         np.ascontiguousarray(idx, np.int32), len(idx),
         out_keys, out_vals, out_expire, out_hash32, out_deleted)
+    return True
+
+
+def gather_keys_uniform(key_arena, klen, expire, hash32, deleted, idx,
+                        out_keys, out_expire, out_hash32,
+                        out_deleted) -> bool:
+    """Keys+aux half of the uniform gather (no values — they come off the
+    device in the value-residency path). Returns False if the library is
+    absent (caller falls back to fancy indexing)."""
+    lib = _load()
+    if lib is None:
+        return False
+    lib.gather_keys_uniform(
+        np.ascontiguousarray(key_arena, np.uint8), int(klen),
+        np.ascontiguousarray(expire, np.uint32),
+        np.ascontiguousarray(hash32, np.uint32),
+        np.ascontiguousarray(deleted, np.bool_),
+        np.ascontiguousarray(idx, np.int32), len(idx),
+        out_keys, out_expire, out_hash32, out_deleted)
     return True
 
 

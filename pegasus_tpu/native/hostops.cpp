@@ -149,6 +149,32 @@ void gather_block_uniform(const uint8_t* key_arena, int64_t klen,
     }
 }
 
+// Keys-and-aux-only variant: the device-value-residency materialization
+// (ops/compact.py materialize_device_survivors) downloads value rows from
+// HBM while the host gathers only keys + fixed-width aux — the two halves
+// overlap, so this loop must not touch the value arena at all.
+void gather_keys_uniform(const uint8_t* key_arena, int64_t klen,
+                         const uint32_t* expire, const uint32_t* hash32,
+                         const uint8_t* deleted, const int32_t* idx,
+                         int64_t n, uint8_t* out_keys, uint32_t* out_expire,
+                         uint32_t* out_hash32, uint8_t* out_deleted) {
+    const int64_t AHEAD = 32;
+    for (int64_t i = 0; i < n; i++) {
+        if (i + AHEAD < n) {
+            int64_t ja = (int64_t)idx[i + AHEAD];
+            __builtin_prefetch(key_arena + ja * klen, 0, 0);
+            __builtin_prefetch(expire + ja, 0, 0);
+            __builtin_prefetch(hash32 + ja, 0, 0);
+            __builtin_prefetch(deleted + ja, 0, 0);
+        }
+        int64_t j = (int64_t)idx[i];
+        memcpy(out_keys + i * klen, key_arena + j * klen, (size_t)klen);
+        out_expire[i] = expire[j];
+        out_hash32[i] = hash32[j];
+        out_deleted[i] = deleted[j];
+    }
+}
+
 // ----------------------------------------------------- sorted-run merge
 
 // Count, for each record of run A (fixed-width keys, itemsize bytes,

@@ -266,15 +266,25 @@ class DeviceRun:
     n: int
     padded_len: int
     w: int
+    # value-residency extension (uniform-layout runs only): the run's value
+    # rows live in HBM too, so compaction output values materialize on
+    # device instead of the host arena gather (VERDICT-r3 item 3)
+    val2d: object = None   # jnp.uint8[padded_len, vl0] or None
+    vl0: int = 0
 
     def nbytes(self) -> int:
-        return (len(self.cols) + 3) * 4 * self.padded_len + self.padded_len
+        base = (len(self.cols) + 3) * 4 * self.padded_len + self.padded_len
+        if self.val2d is not None:
+            base += self.padded_len * self.vl0
+        return base
 
 
-def pack_run_device(block, prefix_u32: int = DEFAULT_PREFIX_U32):
+def pack_run_device(block, prefix_u32: int = DEFAULT_PREFIX_U32,
+                    with_values: bool = False):
     """-> DeviceRun, or None when this run cannot be cached (keys longer
     than the prefix window need per-merge suffix ranks). The run must be
-    sorted (SSTs are born sorted)."""
+    sorted (SSTs are born sorted). with_values additionally pins the value
+    rows in HBM when the layout is uniform (value residency)."""
     import jax.numpy as jnp
 
     if block.n == 0:
@@ -294,12 +304,20 @@ def pack_run_device(block, prefix_u32: int = DEFAULT_PREFIX_U32):
     cols = tuple(jnp.asarray(_pad_to(np.ascontiguousarray(pref[:, j]), padded))
                  for j in range(w))
     klen = jnp.asarray(_pad_to(block.key_len.astype(np.uint32), padded))
+    val2d, vl0 = None, 0
+    if with_values:
+        uni = block.uniform_layout()
+        if uni is not None:
+            vl0 = uni[1]
+            rows = np.zeros((padded, vl0), np.uint8)
+            rows[: block.n] = block.val_arena.reshape(block.n, vl0)
+            val2d = jnp.asarray(rows)
     return DeviceRun(
         cols=cols, klen=klen,
         expire=zpad(block.expire_ts),
         deleted=zpad(block.deleted),
         hash32=zpad(block.hash32),
-        n=block.n, padded_len=padded, w=w)
+        n=block.n, padded_len=padded, w=w, val2d=val2d, vl0=vl0)
 
 
 class TpuBackend:
@@ -308,25 +326,28 @@ class TpuBackend:
     name = "tpu"
 
     def survivors_cached_device(self, device_runs, now, pidx, pmask,
-                                bottommost, do_filter):
+                                bottommost, do_filter, want_padded=False):
         """The engine hot path: merge cached DeviceRuns (newest first)
         without any host packing or re-upload. Returns the survivor index
         still ON DEVICE (+ count) so the caller can overlap its download
-        with the host arena gather."""
+        with the host arena gather. want_padded additionally returns the
+        padded-concat survivor index (the per-run value gather's input):
+        (mapped, padded, count) instead of (mapped, count)."""
         import jax.numpy as jnp
 
         w = max(r.w for r in device_runs)
-        fn = _compiled_pipeline_cached(
-            tuple(r.padded_len for r in device_runs),
-            tuple(r.w for r in device_runs), w)
+        lens = tuple(r.padded_len for r in device_runs)
+        ws = tuple(r.w for r in device_runs)
+        fn = (_compiled_pipeline_cached_padded(lens, ws, w) if want_padded
+              else _compiled_pipeline_cached(lens, ws, w))
         cached = tuple(tuple(r.cols) + (r.klen,) for r in device_runs)
         aux = tuple((r.expire, r.deleted, r.hash32) for r in device_runs)
         real_lens = jnp.asarray([r.n for r in device_runs], jnp.int32)
-        out_idx, count = fn(cached, aux, real_lens,
-                            jnp.uint32(now), jnp.uint32(pidx),
-                            jnp.uint32(pmask), jnp.asarray(bool(bottommost)),
-                            jnp.asarray(bool(do_filter)))
-        return out_idx, int(count)
+        out = fn(cached, aux, real_lens,
+                 jnp.uint32(now), jnp.uint32(pidx),
+                 jnp.uint32(pmask), jnp.asarray(bool(bottommost)),
+                 jnp.asarray(bool(do_filter)))
+        return (*out[:-1], int(out[-1]))
 
     def survivors_cached(self, device_runs, now, pidx, pmask, bottommost,
                          do_filter) -> np.ndarray:
@@ -378,6 +399,102 @@ class TpuBackend:
         out_idx, count = self.survivors_device(packed, now, pidx, pmask,
                                                bottommost, do_filter)
         return np.asarray(out_idx[:count])
+
+
+@dataclass
+class DeviceVals:
+    """Device-resident value rows for a uniform-layout block, uploaded at
+    flush time like the key columns (SURVEY §7c: the host-side arena
+    gather of 10M variable-length values was the 1.27s bottleneck at the
+    r3 best — value rows living in HBM let survivors materialize on
+    device and come back as one contiguous transfer)."""
+
+    val2d: object  # jnp.uint8[n, vl0]
+    vl0: int
+    n: int
+
+    def nbytes(self) -> int:
+        return self.n * self.vl0
+
+
+def prepare_values(block: KVBlock) -> "DeviceVals | None":
+    """Upload a uniform-layout block's value rows to device; None when the
+    layout is not uniform (variable-width values stay host-gathered)."""
+    import jax.numpy as jnp
+
+    uni = block.uniform_layout()
+    if uni is None:
+        return None
+    _, vl0 = uni
+    return DeviceVals(jnp.asarray(block.val_arena.reshape(block.n, vl0)),
+                      vl0, block.n)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_val_gather(n: int, vl0: int, bucket: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(val2d, idx):
+        # idx rows past the real count carry -1; clip to row 0 (discarded
+        # by the host-side [:count] slice)
+        safe = jnp.clip(idx, 0, np.int32(n - 1))
+        return jnp.take(val2d, safe, axis=0)
+
+    return jax.jit(fn)
+
+
+def _finish_overlapped(concat: KVBlock, out_dev, real_idx, count: int,
+                       kl0: int, vl0: int) -> KVBlock:
+    """Shared tail of both value-residency materializers: start the value
+    download, gather keys+aux on the host while it is in flight (native
+    fused loop, numpy fallback), assemble the uniform output block."""
+    try:
+        out_dev.copy_to_host_async()
+    except AttributeError:
+        pass
+    idx = np.asarray(real_idx[:count]).astype(np.int32, copy=False)
+    from .. import native
+
+    out_k = np.empty((count, kl0), np.uint8)
+    out_e = np.empty(count, np.uint32)
+    out_h = np.empty(count, np.uint32)
+    out_d = np.empty(count, np.bool_)
+    if not native.gather_keys_uniform(
+            concat.key_arena, kl0, concat.expire_ts, concat.hash32,
+            concat.deleted, idx, out_k.reshape(-1), out_e, out_h, out_d):
+        key2d = concat.key_arena.reshape(concat.n, kl0)
+        out_k[:] = key2d[idx]
+        out_e[:] = concat.expire_ts[idx]
+        out_h[:] = concat.hash32[idx]
+        out_d[:] = concat.deleted[idx]
+    out_v = np.asarray(out_dev)[:count]
+    return KVBlock(
+        out_k.reshape(-1), np.arange(count, dtype=np.int64) * kl0,
+        np.full(count, kl0, np.int32),
+        out_v.reshape(-1), np.arange(count, dtype=np.int64) * vl0,
+        np.full(count, vl0, np.int32),
+        out_e, out_h, out_d)
+
+
+def materialize_device_survivors(concat: KVBlock, dev_vals: DeviceVals,
+                                 dev_idx, count: int) -> KVBlock:
+    """Materialize the compaction output with the value rows gathered ON
+    DEVICE and downloaded as one contiguous block, overlapped with the
+    host-side keys+aux gather — the two halves pay max() instead of sum().
+    Requires uniform layout and a resident DeviceVals; anything else falls
+    back to the host-gather path."""
+    if count == 0:
+        return KVBlock.empty()
+    uni = concat.uniform_layout()
+    if uni is None or dev_vals is None or dev_vals.n != concat.n \
+            or uni[1] != dev_vals.vl0:
+        return gather_device_survivors(concat, dev_idx, count)
+    kl0, vl0 = uni
+    bucket = min(_pow2ceil(count, 1 << 16), int(dev_idx.shape[0]))
+    fn = _compiled_val_gather(dev_vals.n, vl0, bucket)
+    out_dev = fn(dev_vals.val2d, dev_idx[:bucket])
+    return _finish_overlapped(concat, out_dev, dev_idx, count, kl0, vl0)
 
 
 def gather_device_survivors(concat: KVBlock, dev_idx, count: int,
@@ -538,7 +655,7 @@ def _compiled_pipeline(padded_lens: tuple, w: int, has_rank: bool):
 
 
 def _make_cached_fn(padded_lens: tuple, run_ws: tuple, w: int,
-                    allow_pallas: bool = True):
+                    allow_pallas: bool = True, want_padded: bool = False):
     """Build the (unjitted) traceable pipeline over CACHED device runs.
 
     Each input run arrives as its cached fully-padded device columns —
@@ -597,6 +714,10 @@ def _make_cached_fn(padded_lens: tuple, run_ws: tuple, w: int,
             mapped = jnp.where(out_idx >= np.int32(padded_offsets[i]),
                                out_idx - d_i, mapped)
         mapped = jnp.where(out_idx >= 0, mapped, -1)
+        if want_padded:
+            # the padded-concat index addresses each run's padded device
+            # arrays directly — what the per-run value gather consumes
+            return mapped, out_idx, count
         return mapped, count
 
     return fn
@@ -609,6 +730,56 @@ def _compiled_pipeline_cached(padded_lens: tuple, run_ws: tuple, w: int):
     import jax
 
     return jax.jit(_make_cached_fn(padded_lens, run_ws, w))
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_pipeline_cached_padded(padded_lens: tuple, run_ws: tuple,
+                                     w: int):
+    """As _compiled_pipeline_cached but also returning the padded-concat
+    survivor index (value-residency materialization needs it)."""
+    import jax
+
+    return jax.jit(_make_cached_fn(padded_lens, run_ws, w, want_padded=True))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_cached_val_gather(padded_lens: tuple, vl0: int, bucket: int):
+    """Per-run masked value-row gather by PADDED-concat survivor index:
+    run i owns indices [offs[i], offs[i]+padded_lens[i]). K clipped
+    gathers + masked select — all HBM-bound, trivial next to the download."""
+    import jax
+    import jax.numpy as jnp
+
+    offs = np.cumsum([0] + list(padded_lens))
+
+    def fn(val2ds, idx):
+        out = jnp.zeros((bucket, vl0), jnp.uint8)
+        for i, v in enumerate(val2ds):
+            local = idx - np.int32(offs[i])
+            ok = (local >= 0) & (local < np.int32(padded_lens[i]))
+            rows = jnp.take(v, jnp.clip(local, 0, np.int32(padded_lens[i] - 1)),
+                            axis=0)
+            out = jnp.where(ok[:, None], rows, out)
+        return out
+
+    return jax.jit(fn)
+
+
+def materialize_cached_survivors(concat: KVBlock, device_runs, mapped_idx,
+                                 padded_idx, count: int) -> KVBlock:
+    """Cached-run analogue of materialize_device_survivors: value rows are
+    gathered per-run on device by padded-concat index and downloaded as one
+    block, overlapped with the host keys+aux gather by real-concat index.
+    Preconditions (caller-checked): every run has val2d with one shared
+    vl0, and concat has uniform layout matching it."""
+    if count == 0:
+        return KVBlock.empty()
+    kl0, vl0 = concat.uniform_layout()
+    padded_lens = tuple(r.padded_len for r in device_runs)
+    bucket = min(_pow2ceil(count, 1 << 16), int(padded_idx.shape[0]))
+    fn = _compiled_cached_val_gather(padded_lens, vl0, bucket)
+    out_dev = fn(tuple(r.val2d for r in device_runs), padded_idx[:bucket])
+    return _finish_overlapped(concat, out_dev, mapped_idx, count, kl0, vl0)
 
 
 _BACKENDS = {"cpu": CpuBackend(), "tpu": TpuBackend(), "jax": TpuBackend()}
@@ -666,10 +837,22 @@ def compact_blocks(blocks, opts: CompactOptions,
     if (device_runs is not None and backend.name == "tpu"
             and len(device_runs) == len(runs)
             and all(d is not None for d in device_runs)):
-        dev_idx, count = backend.survivors_cached_device(device_runs, *fargs)
         n = sum(d.n for d in device_runs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        out = gather_device_survivors(concat, dev_idx, count)
+        vl0s = {d.vl0 for d in device_runs if d.val2d is not None}
+        uni = concat.uniform_layout()
+        if (all(d.val2d is not None for d in device_runs)
+                and len(vl0s) == 1 and uni is not None
+                and uni[1] == next(iter(vl0s))):
+            # value residency: output values materialize on device
+            mapped, padded, count = backend.survivors_cached_device(
+                device_runs, *fargs, want_padded=True)
+            out = materialize_cached_survivors(concat, device_runs, mapped,
+                                               padded, count)
+        else:
+            dev_idx, count = backend.survivors_cached_device(device_runs,
+                                                             *fargs)
+            out = gather_device_survivors(concat, dev_idx, count)
     elif backend.name == "tpu":
         packed = pack_runs(runs, opts, need_sbytes=False)
         dev_idx, count = backend.survivors_device(packed, *fargs)

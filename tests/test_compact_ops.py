@@ -433,3 +433,136 @@ def test_blockwise_merge_long_keys_rank_path():
     assert split.block.n == whole.block.n
     np.testing.assert_array_equal(whole.block.key_arena, split.block.key_arena)
     np.testing.assert_array_equal(whole.block.val_arena, split.block.val_arena)
+
+
+def _uniform_runs(rng, n_runs=3, n=400):
+    """Fixed-width records (the bench/engine fast layout): 8B hash keys,
+    8B sort keys, width-10 payloads -> uniform_layout() is non-None."""
+    runs = []
+    for r in range(n_runs):
+        recs = [(b"h%07d" % rng.integers(0, 120), b"s%07d" % rng.integers(0, 40),
+                 b"p%09d" % rng.integers(0, 10**9), int(rng.integers(0, 150)),
+                 bool(rng.random() < 0.2)) for _ in range(n)]
+        # tombstones must keep the uniform value width (empty values would
+        # break the fixed layout, as in the bench fill where tombstones
+        # still carry a full-width value row)
+        rows = []
+        from pegasus_tpu.base.key_schema import generate_key
+        from pegasus_tpu.base.value_schema import SCHEMAS
+
+        for hk, sk, payload, expire, deleted in recs:
+            rows.append((generate_key(hk, sk),
+                         SCHEMAS[2].generate_value(expire, 0, payload),
+                         expire, deleted))
+        runs.append(sort_block(KVBlock.from_records(rows)))
+    return runs
+
+
+def test_materialize_device_survivors_matches_host_gather():
+    """Value-residency materialization (device value gather + host key
+    gather, overlapped) is byte-identical to the host fused gather."""
+    from pegasus_tpu.ops.compact import (TpuBackend, gather_device_survivors,
+                                         materialize_device_survivors,
+                                         pack_runs, prepare_values)
+
+    rng = np.random.default_rng(3)
+    runs = _uniform_runs(rng)
+    opts = CompactOptions(backend="tpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    packed = pack_runs(runs, opts, need_sbytes=False)
+    backend = TpuBackend()
+    prep = backend.prepare(packed)
+    dev_idx, cnt = backend.survivors_device(prep, 100, 0, 0, True, True)
+    assert cnt > 0
+    concat = KVBlock.concat(runs)
+    base = gather_device_survivors(concat, dev_idx, cnt)
+    dev_vals = prepare_values(concat)
+    assert dev_vals is not None
+    out = materialize_device_survivors(concat, dev_vals, dev_idx, cnt)
+    assert out.n == base.n == cnt
+    np.testing.assert_array_equal(base.key_arena, out.key_arena)
+    np.testing.assert_array_equal(base.val_arena, out.val_arena)
+    np.testing.assert_array_equal(base.expire_ts, out.expire_ts)
+    np.testing.assert_array_equal(base.hash32, out.hash32)
+    np.testing.assert_array_equal(base.deleted, out.deleted)
+    np.testing.assert_array_equal(base.key_off, out.key_off)
+    np.testing.assert_array_equal(base.val_off, out.val_off)
+
+
+def test_materialize_device_survivors_nonuniform_falls_back():
+    """Variable-width values: prepare_values declines, and the entry point
+    degrades to the host gather instead of corrupting rows."""
+    from pegasus_tpu.ops.compact import (TpuBackend, materialize_device_survivors,
+                                         pack_runs, prepare_values)
+
+    rng = np.random.default_rng(5)
+    runs = [sort_block(make_block(_adversarial_records(rng, 150)))
+            for _ in range(2)]
+    concat = KVBlock.concat(runs)
+    assert prepare_values(concat) is None
+    opts = CompactOptions(backend="tpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    packed = pack_runs(runs, opts, need_sbytes=False)
+    backend = TpuBackend()
+    dev_idx, cnt = backend.survivors_device(packed, 100, 0, 0, True, True)
+    out = materialize_device_survivors(concat, None, dev_idx, cnt)
+    r_cpu = compact_blocks(runs, CompactOptions(backend="cpu", now=100,
+                                                bottommost=True,
+                                                runs_sorted=True))
+    np.testing.assert_array_equal(r_cpu.block.key_arena, out.key_arena)
+    np.testing.assert_array_equal(r_cpu.block.val_arena, out.val_arena)
+
+
+def test_cached_value_residency_matches_cpu():
+    """Cached runs with pinned value rows (pack_run_device with_values):
+    compact_blocks takes the device-materialization branch and stays
+    byte-identical to the cpu lane; mixed caches (one run without values)
+    fall back to the host gather, same bytes."""
+    from pegasus_tpu.ops.compact import pack_run_device
+
+    rng = np.random.default_rng(31)
+    runs = _uniform_runs(rng, n_runs=3, n=350)
+    opts = dict(now=100, bottommost=True, runs_sorted=True)
+    cpu = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    drs_v = [pack_run_device(b, with_values=True) for b in runs]
+    assert all(d is not None and d.val2d is not None for d in drs_v)
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts),
+                         device_runs=drs_v)
+    # mixed: one run lacks values -> host-gather fallback branch
+    drs_mixed = [pack_run_device(runs[0])] + drs_v[1:]
+    mixed = compact_blocks(runs, CompactOptions(backend="tpu", **opts),
+                           device_runs=drs_mixed)
+    for other in (got, mixed):
+        assert other.block.n == cpu.block.n
+        np.testing.assert_array_equal(cpu.block.key_arena, other.block.key_arena)
+        np.testing.assert_array_equal(cpu.block.val_arena, other.block.val_arena)
+        np.testing.assert_array_equal(cpu.block.expire_ts, other.block.expire_ts)
+        np.testing.assert_array_equal(cpu.block.deleted, other.block.deleted)
+
+
+def test_engine_device_values_end_to_end(tmp_path):
+    """EngineOptions.device_values=True: uniform-width tables compact
+    through the value-residency branch and serve identical data to cpu."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+
+    engines = {}
+    for backend, dv in (("cpu", False), ("tpu", True)):
+        eng = LsmEngine(str(tmp_path / backend), EngineOptions(
+            backend=backend, memtable_bytes=8 << 10,
+            l0_compaction_trigger=3, device_values=dv))
+        for i in range(500):
+            key = generate_key(b"h%03d" % (i % 41), b"s%05d" % i)
+            eng.put(key, SCHEMAS[2].generate_value(0, 0, b"pay%07d" % i))
+        eng.manual_compact(now=100)
+        engines[backend] = eng
+    tpu = engines["tpu"]
+    primed = [s for s in tpu._l0 + sum(tpu._levels.values(), [])
+              if s._device_run is not None and s._device_run.val2d is not None]
+    assert primed, "no SST holds resident value rows"
+    for i in range(500):
+        key = generate_key(b"h%03d" % (i % 41), b"s%05d" % i)
+        assert engines["cpu"].get(key) == tpu.get(key), f"diverged at {i}"
+    for eng in engines.values():
+        eng.close()
