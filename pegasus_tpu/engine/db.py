@@ -417,14 +417,18 @@ class LsmEngine:
         OOMing the write path."""
         if self.opts.backend != "tpu":
             return None
-        if sst._device_run is not None:
-            return sst._device_run
+        want_values = self.opts.device_values
+        cached = sst._device_run
+        if cached is not None and (not want_values
+                                   or cached.val2d is not None):
+            return cached
         with self._lock:
             if self._device_cache_used >= self.opts.device_cache_bytes:
-                return None
+                return cached  # a value-less cached run still serves
+        old_bytes = cached.nbytes() if cached is not None else 0
         try:
             dr = sst.device_run(self.opts.prefix_u32,
-                                with_values=self.opts.device_values)
+                                with_values=want_values)
         except Exception as e:  # device OOM / backend failure: degrade
             print(f"[engine] device-run prime failed for {sst.path}: {e!r}",
                   flush=True)
@@ -432,7 +436,7 @@ class LsmEngine:
             return None
         if dr is not None:
             with self._lock:
-                self._device_cache_used += dr.nbytes()
+                self._device_cache_used += dr.nbytes() - old_bytes
         return dr
 
     def _release_device_run(self, sst):

@@ -235,7 +235,11 @@ class SSTable:
         run is uncacheable (keys beyond the prefix window need per-merge
         suffix ranks). with_values additionally pins uniform-layout value
         rows (value residency; see EngineOptions.device_values)."""
-        if self._device_run is None and not self._device_uncacheable:
+        needs_pack = self._device_run is None or (
+            # upgrade a value-less cached run when values are now wanted
+            # (e.g. primed earlier by a caller with the default flag)
+            with_values and self._device_run.val2d is None)
+        if needs_pack and not self._device_uncacheable:
             from ..ops.compact import pack_run_device
 
             self._device_run = pack_run_device(self.block(), prefix_u32,

@@ -454,6 +454,13 @@ def _finish_overlapped(concat: KVBlock, out_dev, real_idx, count: int,
     except AttributeError:
         pass
     idx = np.asarray(real_idx[:count]).astype(np.int32, copy=False)
+    # device-derived indices feed unchecked native pointer arithmetic (and
+    # numpy fancy indexing would silently wrap a -1): a pipeline defect
+    # must be loud, not memory corruption
+    if count and (int(idx.min()) < 0 or int(idx.max()) >= concat.n):
+        raise ValueError(
+            "survivor index outside concat rows — device pipeline bug "
+            f"(min {int(idx.min())}, max {int(idx.max())}, n {concat.n})")
     from .. import native
 
     out_k = np.empty((count, kl0), np.uint8)
@@ -839,11 +846,12 @@ def compact_blocks(blocks, opts: CompactOptions,
             and all(d is not None for d in device_runs)):
         n = sum(d.n for d in device_runs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        vl0s = {d.vl0 for d in device_runs if d.val2d is not None}
-        uni = concat.uniform_layout()
-        if (all(d.val2d is not None for d in device_runs)
-                and len(vl0s) == 1 and uni is not None
-                and uni[1] == next(iter(vl0s))):
+        # cheap checks first: uniform_layout() is four O(n) reductions,
+        # wasted work whenever value residency is off (the default)
+        vl0s = {d.vl0 for d in device_runs} \
+            if all(d.val2d is not None for d in device_runs) else set()
+        uni = concat.uniform_layout() if len(vl0s) == 1 else None
+        if uni is not None and uni[1] == next(iter(vl0s)):
             # value residency: output values materialize on device
             mapped, padded, count = backend.survivors_cached_device(
                 device_runs, *fargs, want_padded=True)
