@@ -1,0 +1,149 @@
+"""Leader election + lease for the meta-server replica set.
+
+The reference runs THREE meta servers whose election and state both live
+in ZooKeeper: `meta_state_service_type = meta_state_service_zookeeper` +
+`distributed_lock_service_zookeeper` (reference
+src/server/config.ini:160-167, :380-383) and the onebox boots
+META_COUNT=3 (run.sh:509). This build's analogue keeps both halves on
+SHARED DURABLE STORAGE — a directory every meta can reach (the onebox
+shares the local filesystem; multi-host deployments mount it via NFS or
+the block-service providers, exactly the role ZK plays for the
+reference):
+
+  - the LEASE FILE is the distributed lock: its content names the
+    leader, its mtime is the heartbeat. A leader refreshes it every
+    lease/3; anyone finding it older than the lease takes over with an
+    atomic replace + settle-and-reread round that resolves concurrent
+    takeovers (last writer wins, every racer re-reads after a settle
+    delay, losers demote).
+  - the shared state.json is the replicated meta state: every mutating
+    DDL persists BEFORE acknowledging (meta_server handlers), and a new
+    leader reloads it on takeover — so any write the old leader
+    acknowledged is visible after its SIGKILL. That is the HA contract
+    tests/test_process_kill.py::test_meta_leader_kill asserts.
+
+Followers redirect every RPC except beacons with ERR_FORWARD_TO_PRIMARY;
+clients/shell/replicas already fall through their meta list, so
+redirection needs no routing table — the leader is whoever doesn't
+refuse.
+"""
+
+import os
+import threading
+import time
+
+
+class MetaElection:
+    def __init__(self, lock_path: str, my_addr: str,
+                 lease_seconds: float = 6.0, on_acquire=None,
+                 on_demote=None, settle_seconds: float = None):
+        self.lock_path = lock_path
+        self.my_addr = my_addr
+        self.lease = lease_seconds
+        self.on_acquire = on_acquire
+        self.on_demote = on_demote
+        # long enough for a concurrent racer's replace to land, short
+        # enough to keep failover well under the FD grace
+        self.settle = (settle_seconds if settle_seconds is not None
+                       else min(0.2, lease_seconds / 10))
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"meta-election:{my_addr}")
+
+    # ------------------------------------------------------------- queries
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def leader(self):
+        """Current lease holder per the lock file (None if no live lease);
+        serves as the redirect hint in follower refusals."""
+        holder, age = self._read()
+        if holder is None or age > self.lease:
+            return None
+        return holder
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self):
+        self._tick()  # synchronous first round: a lone meta is leader
+        self._thread.start()  # by the time start() returns
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=self.lease)
+        if self._leader:
+            # graceful release: delete our lease so the next leader does
+            # not wait out the staleness window
+            holder, _ = self._read()
+            if holder == self.my_addr:
+                try:
+                    os.unlink(self.lock_path)
+                except OSError:
+                    pass
+            self._set_leader(False)
+
+    # ------------------------------------------------------------ internals
+
+    def _loop(self):
+        while not self._stop.wait(self.lease / 3):
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 - a dead election thread
+                # would freeze leadership forever; log and keep ticking
+                print(f"[meta-election] {self.my_addr}: {e!r}", flush=True)
+
+    def _tick(self):
+        holder, age = self._read()
+        if holder == self.my_addr:
+            self._refresh()
+            # re-read: our refresh and a racer's takeover can interleave
+            holder, _ = self._read()
+            self._set_leader(holder == self.my_addr)
+        elif holder is None or age > self.lease:
+            self._try_claim()
+        else:
+            self._set_leader(False)
+
+    def _read(self):
+        """-> (holder_addr | None, age_seconds)."""
+        try:
+            with open(self.lock_path) as f:
+                holder = f.read().strip()
+            age = time.time() - os.stat(self.lock_path).st_mtime
+            return (holder or None), age
+        except OSError:
+            return None, float("inf")
+
+    def _refresh(self):
+        self._write_lease()
+
+    def _write_lease(self):
+        tmp = f"{self.lock_path}.{self.my_addr.replace(':', '_')}.tmp"
+        os.makedirs(os.path.dirname(self.lock_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(self.my_addr)
+        os.replace(tmp, self.lock_path)
+
+    def _try_claim(self):
+        self._write_lease()
+        # settle-and-reread: concurrent claimants all replaced the file;
+        # exactly one write landed last. Everyone re-reads after a settle
+        # delay and only the survivor leads.
+        time.sleep(self.settle)
+        holder, _ = self._read()
+        self._set_leader(holder == self.my_addr)
+
+    def _set_leader(self, value: bool):
+        if value == self._leader:
+            return
+        self._leader = value
+        cb = self.on_acquire if value else self.on_demote
+        if cb is not None:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 - callback bugs must not
+                print(f"[meta-election] {self.my_addr} callback: {e!r}",
+                      flush=True)  # kill the election thread

@@ -19,7 +19,8 @@ import threading
 import time
 
 from ..rpc import codec
-from ..rpc.transport import ConnectionPool, ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError
+from ..rpc.transport import (ConnectionPool, ERR_FORWARD_TO_PRIMARY,
+                             ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError)
 from . import messages as mm
 
 RPC_CM_CREATE_APP = "RPC_CM_START_CREATE_APP"
@@ -47,11 +48,14 @@ RPC_CM_RECOVER = "RPC_CM_START_RECOVERY"
 RPC_CM_RECALL_APP = "RPC_CM_RECALL_APP"
 RPC_CM_CONTROL_META = "RPC_CM_CONTROL_META"
 
-# meta function levels (reference meta_function_level: how much the meta
-# may move data around on its own; shell get/set_meta_level)
-META_LEVELS = ("blind", "freezed", "steady", "lively")
+# meta function levels (reference meta_function_level enum, shell
+# rebalance.cpp:27-31: stopped/blind/freezed/steady/lively; get/set_meta_level)
+META_LEVELS = ("stopped", "blind", "freezed", "steady", "lively")
+# stopped: reject everything, queries included — full operator lockdown;
+#          only control_meta (the way out) and beacons (liveness must
+#          never be blinded) still served
 # blind:   reject every state-changing DDL (reference meta_function_level
-#          FL_blind — operator lockdown); reads/queries still served
+#          FL_blind); reads/queries still served
 # freezed: DDL allowed but no meta-initiated data movement (no learner
 #          rebuild on node death)
 # steady:  failover rebuild but no balancing
@@ -70,10 +74,13 @@ RPC_QUERY_REPLICA_INFO = "RPC_QUERY_REPLICA_INFO"
 
 class MetaServer:
     def __init__(self, state_path: str, fd_grace_seconds: float = 22.0,
-                 replica_count: int = 3):
+                 replica_count: int = 3, election=None):
         self.state_path = state_path
         self.fd_grace = fd_grace_seconds
         self.default_replica_count = replica_count
+        # meta HA (meta/election.py): state_path must live on storage every
+        # meta shares; None = single-meta mode, always leader
+        self.election = election
         self._lock = threading.RLock()
         self._apps = {}          # name -> AppInfo
         self._parts = {}         # app_id -> list[PartitionConfig]
@@ -102,8 +109,25 @@ class MetaServer:
         RPC_FD_BEACON,
     })
 
-    def _guard_blind(self, code, fn):
+    # codes still served at level "stopped" (full lockdown): only the way
+    # back out and liveness
+    _STOPPED_ALLOWED = frozenset({RPC_CM_CONTROL_META, RPC_FD_BEACON})
+
+    def _guard_level(self, code, fn):
         def wrapped(header, body):
+            if (self.election is not None and not self.election.is_leader()
+                    and code != RPC_FD_BEACON):
+                # followers still absorb beacons (a warm liveness map makes
+                # takeover instant); everything else goes to the leader —
+                # clients/shell/replicas fall through their meta list
+                leader = self.election.leader()
+                raise RpcError(ERR_FORWARD_TO_PRIMARY,
+                               f"not the meta leader (leader: "
+                               f"{leader or 'unknown'})")
+            if self.level == "stopped" and code not in self._STOPPED_ALLOWED:
+                raise RpcError(ERR_INVALID_STATE,
+                               f"meta level is stopped; {code} refused "
+                               "(set_meta_level to unlock)")
             if self.level == "blind" and code not in self._BLIND_ALLOWED:
                 raise RpcError(ERR_INVALID_STATE,
                                f"meta level is blind; {code} refused "
@@ -113,7 +137,7 @@ class MetaServer:
 
     def rpc_handlers(self) -> dict:
         handlers = self._raw_rpc_handlers()
-        return {code: self._guard_blind(code, fn)
+        return {code: self._guard_level(code, fn)
                 for code, fn in handlers.items()}
 
     def _raw_rpc_handlers(self) -> dict:
@@ -1144,9 +1168,23 @@ class MetaServer:
             self._persist()
         return codec.encode(mm.BeaconResponse(allowed=True))
 
+    def reload_state(self) -> None:
+        """Takeover path: re-read the shared state file so every DDL the
+        previous leader acknowledged (persist-before-ack) is visible here.
+        The liveness map is kept — followers absorb beacons, so takeover
+        does not re-declare every node dead."""
+        with self._lock:
+            nodes, node_reps = self._nodes, self._node_replicas
+            self._apps, self._parts = {}, {}
+            self._dups, self._policies, self._dropped = {}, {}, {}
+            self._load()
+            self._nodes, self._node_replicas = nodes, node_reps
+
     def check_leases(self) -> list:
         """Expire dead nodes and reconfigure their partitions. Returns the
         list of nodes declared dead. Call from a timer (or tests)."""
+        if self.level == "stopped":
+            return []
         now = time.monotonic()
         with self._lock:
             dead = [a for a, last in self._nodes.items()

@@ -171,12 +171,16 @@ class ReplicaStub:
                 for dupid, d in dict(rep.duplicators).items()]
         req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
                                dup_progress=progress)
+        # beacon EVERY configured meta, not just the first reachable one:
+        # follower metas absorb beacons too (meta HA — a warm liveness map
+        # makes leader takeover instant instead of re-declaring the world
+        # dead), and a node partitioned from the leader still registers
+        # with whoever can hear it
         for meta in self.meta_addrs:
             host, _, port = meta.rpartition(":")
             try:
                 conn = self.pool.get((host, int(port)))
                 conn.call(RPC_FD_BEACON, codec.encode(req), timeout=5.0)
-                return
             except (RpcError, OSError):
                 continue
 

@@ -35,6 +35,7 @@ ERR_OBJECT_NOT_FOUND = 4    # no such app / partition
 ERR_BUSY = 5
 ERR_INVALID_DATA = 6
 ERR_NETWORK_FAILURE = 7
+ERR_FORWARD_TO_PRIMARY = 8  # follower meta: retry against the leader
 
 
 @dataclass

@@ -148,12 +148,28 @@ class MetaApp:
 
         state_dir = config.get_string(section, "state_dir",
                                       os.path.join("pegasus-data", "meta"))
-        self.meta = MetaServer(
-            os.path.join(state_dir, "state.json"),
-            fd_grace_seconds=config.get_float("failure_detector",
-                                              "grace_seconds", 22.0))
+        state_path = os.path.join(state_dir, "state.json")
         self.rpc = RpcServer(config.get_string(section, "host", "127.0.0.1"),
                              config.get_int(section, "port", 34601))
+        # meta HA: with >1 configured meta, run leader election over the
+        # shared state dir (meta/election.py; every meta's state_dir must
+        # point at the SAME shared path — the ZK-stand-in). Single meta:
+        # no election, always leader.
+        metas = config.get_list("pegasus.server", "meta_servers", ())
+        self.election = None
+        if len(metas) > 1:
+            from ..meta.election import MetaElection
+
+            self.election = MetaElection(
+                state_path + ".lock", self.address,
+                lease_seconds=config.get_float(section,
+                                               "election_lease_seconds", 6.0),
+                on_acquire=lambda: self.meta.reload_state())
+        self.meta = MetaServer(
+            state_path,
+            fd_grace_seconds=config.get_float("failure_detector",
+                                              "grace_seconds", 22.0),
+            election=self.election)
         for code, fn in self.meta.rpc_handlers().items():
             self.rpc.register(code, fn)
         from .toollets import install_toollets
@@ -182,12 +198,18 @@ class MetaApp:
     def start(self):
         self._stopped = False
         self.rpc.start()
+        if self.election is not None:
+            self.election.start()
         self._schedule_fd()
         return self
 
+    def _is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader()
+
     def _schedule_fd(self):
         def tick():
-            self.meta.check_leases()
+            if self._is_leader():  # followers watch, never act
+                self.meta.check_leases()
             self._fd_timer = threading.Timer(self._fd_interval, tick)
             self._fd_timer.daemon = True
             self._fd_timer.start()
@@ -201,9 +223,10 @@ class MetaApp:
         # checks for its whole duration
         def policy_tick():
             try:
-                self.meta.run_backup_policies()
-                self.meta.push_dup_envs()
-                self.meta.purge_expired_dropped()
+                if self._is_leader():
+                    self.meta.run_backup_policies()
+                    self.meta.push_dup_envs()
+                    self.meta.purge_expired_dropped()
             except Exception as e:  # policy failure must not kill the timer
                 print(f"[meta] maintenance tick failed: {e!r}", flush=True)
             if self._stopped:
@@ -224,6 +247,8 @@ class MetaApp:
             self._fd_timer.cancel()
         if getattr(self, "_policy_timer", None):
             self._policy_timer.cancel()
+        if self.election is not None:
+            self.election.stop()
         if self.reporter:
             self.reporter.stop()
         self.rpc.stop()

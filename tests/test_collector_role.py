@@ -51,10 +51,11 @@ def wait_for(fn, timeout=30.0, interval=0.3):
 def test_collector_app_role_canary_and_hotspot(tmp_path):
     root = str(tmp_path)
     meta_port, p1, p2, p3, cport = _free_ports(5)
-    meta = ProcNode(root, "meta", "meta", meta_port, meta_port).start()
-    replicas = [ProcNode(root, f"replica{i}", "replica", p, meta_port).start()
+    meta_list = f"127.0.0.1:{meta_port}"
+    meta = ProcNode(root, "meta", "meta", meta_port, meta_list).start()
+    replicas = [ProcNode(root, f"replica{i}", "replica", p, meta_list).start()
                 for i, p in enumerate((p1, p2, p3), 1)]
-    coll = ProcNode(root, "collector", "collector", cport, meta_port)
+    coll = ProcNode(root, "collector", "collector", cport, meta_list)
     # collector-specific knobs must land in ITS app section
     with open(coll.cfg) as f:
         cfg = f.read()

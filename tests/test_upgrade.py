@@ -102,10 +102,11 @@ def test_rolling_restart_with_format_change(tmp_path):
 
     root = str(tmp_path)
     meta_port, p1, p2, p3 = _free_ports(4)
-    meta = ProcNode(root, "meta", "meta", meta_port, meta_port).start()
+    meta_list = f"127.0.0.1:{meta_port}"
+    meta = ProcNode(root, "meta", "meta", meta_port, meta_list).start()
     names = ["replica1", "replica2", "replica3"]
     ports = {"replica1": p1, "replica2": p2, "replica3": p3}
-    replicas = {n: ProcNode(root, n, "replica", ports[n], meta_port).start()
+    replicas = {n: ProcNode(root, n, "replica", ports[n], meta_list).start()
                 for n in names}
     meta_addr = f"127.0.0.1:{meta_port}"
     try:
