@@ -40,6 +40,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+_UNRESOLVED = object()  # LsmEngine._resolved_mesh: "not probed yet"
+
 from ..base.key_schema import key_hash
 from ..base.utils import epoch_now
 from ..base.value_schema import check_if_ts_expired
@@ -83,6 +85,13 @@ class EngineOptions:
     checkpoint_reserve_time_seconds: int = 0  # 0 = no time-based retention
     user_ops: tuple = ()            # parsed user-specified compaction rules
     compression: str = "none"       # SST section compression: none | zlib
+    # multi-chip compaction (VERDICT-r3 item 7): when the mesh spans >1
+    # device, manual_compact routes through the all_to_all hash-sharded
+    # kernel (parallel.sharded_compact) instead of the single-chip merge.
+    # sharded_compaction=True resolves a mesh over every visible device at
+    # first use; compaction_mesh injects one explicitly (tests, dryrun).
+    sharded_compaction: bool = False
+    compaction_mesh: object = None  # jax.sharding.Mesh | None
 
 
 @dataclass
@@ -154,6 +163,7 @@ class LsmEngine:
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
         self._compaction_lock = threading.RLock()
         self._device_cache_used = 0  # bytes of HBM pinned by resident runs
+        self._resolved_mesh = _UNRESOLVED  # lazy sharded-compaction mesh
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
 
@@ -507,17 +517,37 @@ class LsmEngine:
     def _level_budget(self, lv: int) -> int:
         return self.opts.level_base_bytes * (self.opts.level_size_ratio ** (lv - 1))
 
+    def _sharded_mesh(self):
+        """Mesh for multi-chip manual compaction, or None when the engine
+        should stay single-chip (knob off, or <2 devices visible)."""
+        if self.opts.compaction_mesh is not None:
+            mesh = self.opts.compaction_mesh
+            return mesh if mesh.devices.size > 1 else None
+        if not self.opts.sharded_compaction or self.opts.backend != "tpu":
+            return None
+        if self._resolved_mesh is _UNRESOLVED:
+            try:
+                import jax
+
+                from ..parallel import make_mesh
+
+                self._resolved_mesh = (make_mesh(len(jax.devices()))
+                                       if len(jax.devices()) > 1 else None)
+            except Exception as e:  # no backend: stay single-chip
+                print(f"[engine] sharded compaction unavailable: {e!r}",
+                      flush=True)
+                self._resolved_mesh = None
+        return self._resolved_mesh
+
     def _merge_to_level(self, newer_files, older_files, target_level: int,
-                        bottommost: bool, now=None) -> dict:
+                        bottommost: bool, now=None, sharded: bool = False) -> dict:
         """Merge newer_files (recency order) over older_files into
-        target_level, splitting output at target_file_size_bytes."""
+        target_level, splitting output at target_file_size_bytes.
+        sharded=True (manual_compact only) routes through the multi-chip
+        hash-sharded kernel when a >1-device mesh is available."""
         inputs = list(newer_files) + list(older_files)
         input_blocks = [s.block() for s in inputs]
-        device_runs = None
-        if self.opts.backend == "tpu":
-            # device-resident run cache: each SST packs+uploads once in its
-            # lifetime; this and every later compaction reads HBM directly
-            device_runs = [self._device_run_budgeted(s) for s in inputs]
+        mesh = self._sharded_mesh() if sharded else None
         opts = CompactOptions(
             now=now,
             pidx=self.opts.pidx,
@@ -532,7 +562,20 @@ class LsmEngine:
         from ..runtime.perf_counters import counters
 
         t0 = time.perf_counter()
-        result = compact_blocks(input_blocks, opts, device_runs=device_runs)
+        if mesh is not None:
+            from ..parallel import sharded_compact_block
+
+            result = sharded_compact_block(input_blocks, mesh, opts)
+            counters.rate("engine.sharded_compaction_count").increment()
+        else:
+            device_runs = None
+            if self.opts.backend == "tpu":
+                # device-resident run cache: each SST packs+uploads once in
+                # its lifetime; this and every later compaction reads HBM
+                # directly
+                device_runs = [self._device_run_budgeted(s) for s in inputs]
+            result = compact_blocks(input_blocks, opts,
+                                    device_runs=device_runs)
         counters.rate("engine.compaction_completed_count").increment()
         counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
         self._install_merge_output(newer_files, older_files, result.block,
@@ -604,7 +647,8 @@ class LsmEngine:
                 # inputs stay visible to readers until _merge_to_level swaps
                 # the output in; a failed merge leaves the levels untouched
                 stats = self._merge_to_level(newer, older, target_level=tl,
-                                             bottommost=bottommost, now=now)
+                                             bottommost=bottommost, now=now,
+                                             sharded=True)
         self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
         with self._lock:
             self._write_manifest_locked()

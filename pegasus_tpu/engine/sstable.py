@@ -151,6 +151,7 @@ class SSTable:
         self._block = None
         self._device_run = None
         self._device_uncacheable = False
+        self._values_uncacheable = False
         self._bloom = None
         if self.header.get("bloom"):
             self._bloom = np.frombuffer(
@@ -237,8 +238,12 @@ class SSTable:
         rows (value residency; see EngineOptions.device_values)."""
         needs_pack = self._device_run is None or (
             # upgrade a value-less cached run when values are now wanted
-            # (e.g. primed earlier by a caller with the default flag)
-            with_values and self._device_run.val2d is None)
+            # (e.g. primed earlier by a caller with the default flag) —
+            # unless this file's values already proved unpackable
+            # (non-uniform layout): retrying would re-upload the whole
+            # run to HBM on every compaction it joins
+            with_values and self._device_run.val2d is None
+            and not self._values_uncacheable)
         if needs_pack and not self._device_uncacheable:
             from ..ops.compact import pack_run_device
 
@@ -246,9 +251,12 @@ class SSTable:
                                                with_values=with_values)
             if self._device_run is None:
                 self._device_uncacheable = True
+            elif with_values and self._device_run.val2d is None:
+                self._values_uncacheable = True
         return self._device_run
 
     def release(self):
         self._block = None
         self._device_run = None
         self._device_uncacheable = False
+        self._values_uncacheable = False

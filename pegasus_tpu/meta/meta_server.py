@@ -94,6 +94,7 @@ class MetaServer:
         self.level = "lively"    # freezed | steady | lively (see META_LEVELS)
         self._next_app_id = 1
         self._next_dupid = 1
+        self._state_epoch = 0    # epoch the loaded state file was written under
         self.pool = ConnectionPool()
         self._load()
 
@@ -1164,8 +1165,12 @@ class MetaServer:
                     if e["dupid"] == dupid:
                         conf = e.setdefault("confirmed", {})
                         conf[str(pidx)] = max(conf.get(str(pidx), 0), decree)
-        if not known:
-            self._persist()
+        # deliberately NO _persist() here: beacons reach followers too
+        # (the leader-only RPC guard exempts RPC_FD_BEACON so takeover
+        # starts with a warm liveness map), and _load() rebuilds _nodes
+        # from re-beacons anyway — a follower persisting its stale DDL
+        # snapshot on first sight of a node would clobber every DDL the
+        # leader acked since the follower's last reload
         return codec.encode(mm.BeaconResponse(allowed=True))
 
     def reload_state(self) -> None:
@@ -1324,7 +1329,37 @@ class MetaServer:
             self._persist_locked()
 
     def _persist_locked(self):
+        if self.election is not None:
+            # fencing: a leader stalled past its lease (GIL pause, NFS
+            # hang) must not clobber state a newer leader wrote. Re-verify
+            # the lease at the last moment, and refuse to overwrite a
+            # state file carrying a newer epoch than ours. Both fences
+            # RAISE: the caller is an acking DDL handler and persist-
+            # before-ack is the HA contract — a swallowed fence would ack
+            # a write that never became durable. The RPC layer turns the
+            # raise into an error reply; clients retry against the real
+            # leader.
+            if not self.election.verify_for_persist():
+                print(f"[meta] {self.election.my_addr}: persist fenced — "
+                      "lease lost", flush=True)
+                raise RuntimeError("meta persist fenced: lease lost")
+            disk_epoch = self._read_state_epoch()
+            if disk_epoch > self.election.epoch:
+                print(f"[meta] {self.election.my_addr}: persist fenced — "
+                      f"state epoch {disk_epoch} > lease epoch "
+                      f"{self.election.epoch}", flush=True)
+                self.election._set_leader(False)
+                # release the lease carrying the NEWER lineage forward so
+                # the next claim (ours or anyone's) exceeds the state
+                # epoch and can persist again — fence-and-hold would
+                # livelock: the lease still names us, every tick would
+                # re-promote, every persist would re-fence
+                self.election.release_lease(disk_epoch)
+                raise RuntimeError(
+                    f"meta persist fenced: state epoch {disk_epoch} newer")
         state = {
+            "epoch": (self.election.epoch if self.election is not None
+                      else self._state_epoch),
             "next_app_id": self._next_app_id,
             "next_dupid": self._next_dupid,
             "apps": {n: vars(a) for n, a in self._apps.items()},
@@ -1342,11 +1377,19 @@ class MetaServer:
             json.dump(state, f)
         os.replace(tmp, self.state_path)
 
+    def _read_state_epoch(self) -> int:
+        try:
+            with open(self.state_path) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError):
+            return 0
+
     def _load(self):
         if not os.path.exists(self.state_path):
             return
         with open(self.state_path) as f:
             state = json.load(f)
+        self._state_epoch = int(state.get("epoch", 0))
         self._next_app_id = state["next_app_id"]
         self._next_dupid = state.get("next_dupid", 1)
         self._apps = {n: mm.AppInfo(**a) for n, a in state["apps"].items()}

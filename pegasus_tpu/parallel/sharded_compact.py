@@ -19,11 +19,14 @@ overflow — hash uniformity makes that rare at sane capacity factors.
 """
 
 import functools
+from dataclasses import replace
 
 import numpy as np
 
 from ..engine.block import KVBlock
-from ..ops.compact import CompactOptions, CompactResult, _apply_default_ttl, _pow2ceil, merge_body
+from ..ops.compact import (CompactOptions, CompactResult, _apply_default_ttl,
+                           _pow2ceil, _stats, apply_post_filters, merge_body,
+                           sort_block)
 from ..ops.packing import compute_suffix_ranks, pack_key_prefixes
 
 
@@ -186,3 +189,35 @@ def sharded_compact(blocks, mesh, opts: CompactOptions, axis: str = "shard",
         shards.append(shard)
     return shards, {"input_records": n, "output_records": out_total,
                     "dropped": n - out_total, "n_shards": nsh, "capacity": cap}
+
+
+def sharded_compact_block(blocks, mesh, opts: CompactOptions,
+                          axis: str = "shard") -> CompactResult:
+    """Engine seam (VERDICT-r3 item 7): run the multi-chip hash-sharded
+    compaction and reassemble ONE key-sorted block byte-equal to
+    `compact_blocks(blocks, opts)` — what LsmEngine.manual_compact installs
+    when its mesh has >1 device (the reference's analogue spreads
+    partition-ranged compaction work across nodes; here the spread is
+    hash classes across chips and the final order is restored on install).
+
+    Equality argument: hash-classing sends every version of a key to one
+    shard, each shard's merge_body output is key-sorted and deduped, so
+    shard outputs hold DISJOINT key sets whose union is exactly the
+    single-chip survivor set. A stable key sort of their concatenation is
+    therefore the single-chip output order. Post filters (user compaction
+    rules, default-TTL rewrite) run after reassembly in compact_blocks'
+    exact order — the kernel runs with them masked off."""
+    # resolve `now` ONCE: the kernel's TTL drops and the post filters must
+    # agree on the clock or the output can differ from the single-chip
+    # result for records expiring between two resolved_now() calls
+    opts = replace(opts, now=opts.resolved_now())
+    kernel_opts = replace(opts, default_ttl=0, user_ops=())
+    shards, stats = sharded_compact(blocks, mesh, kernel_opts, axis=axis)
+    live = [s for s in shards if s.n]
+    if not live:
+        return CompactResult(KVBlock.empty(), _stats(stats["input_records"], 0))
+    merged = live[0] if len(live) == 1 else KVBlock.concat(live)
+    out = sort_block(merged, CompactOptions(prefix_u32=opts.prefix_u32,
+                                            backend=opts.backend))
+    out = apply_post_filters(out, opts, opts.now)
+    return CompactResult(out, _stats(stats["input_records"], out.n))

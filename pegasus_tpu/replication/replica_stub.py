@@ -119,6 +119,7 @@ class ReplicaStub:
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
         self._stop = threading.Event()
+        self._beacon_threads = {}  # meta addr -> in-flight ping thread
         self._beacon_thread = threading.Thread(target=self._beacon_loop,
                                                daemon=True)
         self._maint_thread = threading.Thread(target=self._maintenance_loop,
@@ -171,18 +172,40 @@ class ReplicaStub:
                 for dupid, d in dict(rep.duplicators).items()]
         req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
                                dup_progress=progress)
+        body = codec.encode(req)
         # beacon EVERY configured meta, not just the first reachable one:
         # follower metas absorb beacons too (meta HA — a warm liveness map
         # makes leader takeover instant instead of re-declaring the world
         # dead), and a node partitioned from the leader still registers
-        # with whoever can hear it
-        for meta in self.meta_addrs:
+        # with whoever can hear it. CONCURRENTLY: sequential 5s timeouts
+        # with two black-holed metas ahead of the leader would eat ~10s of
+        # the fd grace per round and get a healthy node declared dead.
+        def ping(meta):
             host, _, port = meta.rpartition(":")
             try:
                 conn = self.pool.get((host, int(port)))
-                conn.call(RPC_FD_BEACON, codec.encode(req), timeout=5.0)
+                conn.call(RPC_FD_BEACON, body, timeout=2.0)
             except (RpcError, OSError):
+                pass
+        if len(self.meta_addrs) == 1:
+            ping(self.meta_addrs[0])
+            return
+        # at most ONE in-flight ping per meta: a black-holed meta blocks
+        # its thread ~connect-timeout seconds while beacons fire every
+        # second — respawning per round would pile up threads without bound
+        threads = []
+        for m in self.meta_addrs:
+            prev = self._beacon_threads.get(m)
+            if prev is not None and prev.is_alive():
                 continue
+            t = threading.Thread(target=ping, args=(m,), daemon=True,
+                                 name=f"beacon:{self.address}->{m}")
+            self._beacon_threads[m] = t
+            threads.append(t)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.5)
 
     # ------------------------------------------------- meta-driven lifecycle
 

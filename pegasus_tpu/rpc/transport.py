@@ -282,10 +282,20 @@ class ConnectionPool:
         addr = tuple(addr)
         with self._lock:
             conn = self._conns.get(addr)
-            if conn is None or conn._dead:
-                conn = RpcConnection(addr)
-                self._conns[addr] = conn
+        if conn is not None and not conn._dead:
             return conn
+        # connect OUTSIDE the pool lock: a black-holed peer blocks
+        # create_connection for its full timeout, and holding the pool-wide
+        # lock through that would serialize every other caller (including
+        # the replication write path) behind one dead host
+        fresh = RpcConnection(addr)
+        with self._lock:
+            cur = self._conns.get(addr)
+            if cur is not None and not cur._dead and cur is not conn:
+                fresh.close()  # lost the race to another connector
+                return cur
+            self._conns[addr] = fresh
+        return fresh
 
     def invalidate(self, addr) -> None:
         with self._lock:

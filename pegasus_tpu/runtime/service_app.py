@@ -164,7 +164,10 @@ class MetaApp:
                 state_path + ".lock", self.address,
                 lease_seconds=config.get_float(section,
                                                "election_lease_seconds", 6.0),
-                on_acquire=lambda: self.meta.reload_state())
+                on_acquire=lambda: self.meta.reload_state(),
+                # claims must exceed the durable state epoch even when the
+                # lease file's lineage was lost (fresh mount, manual rm)
+                claim_floor=lambda: self.meta._read_state_epoch())
         self.meta = MetaServer(
             state_path,
             fd_grace_seconds=config.get_float("failure_detector",
@@ -208,8 +211,14 @@ class MetaApp:
 
     def _schedule_fd(self):
         def tick():
-            if self._is_leader():  # followers watch, never act
-                self.meta.check_leases()
+            try:
+                if self._is_leader():  # followers watch, never act
+                    self.meta.check_leases()
+            except Exception as e:  # a fenced persist (or any failure)
+                # must not kill the FD timer for the process lifetime
+                print(f"[meta] fd tick failed: {e!r}", flush=True)
+            if self._stopped:
+                return
             self._fd_timer = threading.Timer(self._fd_interval, tick)
             self._fd_timer.daemon = True
             self._fd_timer.start()
@@ -264,11 +273,16 @@ class ReplicaApp:
         backend = config.get_string("pegasus.server", "compaction_backend", "cpu")
         compression = config.get_string("pegasus.server", "sst_compression",
                                         "none")
+        # multi-chip manual compaction over every visible device (the
+        # engine resolves the mesh lazily; <2 devices = single-chip)
+        sharded = config.get_bool("pegasus.server", "sharded_compaction",
+                                  False)
         data_dir = config.get_string(section, "data_dir",
                                      os.path.join("pegasus-data", name))
 
         def options_factory():
-            return EngineOptions(backend=backend, compression=compression)
+            return EngineOptions(backend=backend, compression=compression,
+                                 sharded_compaction=sharded)
 
         # [pegasus.clusters]: name = comma-separated meta list; the
         # duplication target directory (reference config.ini cluster section)

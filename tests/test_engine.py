@@ -276,3 +276,55 @@ def test_sst_compression_zlib(tmp_path):
     eng2 = LsmEngine(str(tmp_path / "db"), EngineOptions(backend="cpu"))
     assert sum(1 for _ in eng2.scan(now=1)) == 100
     eng2.close()
+
+
+def test_values_uncacheable_not_repacked(tmp_path, monkeypatch):
+    """A non-uniform-layout run asked for with_values returns a DeviceRun
+    with val2d=None; the SSTable must remember that instead of re-packing
+    and re-uploading the whole run on every compaction it joins
+    (ADVICE-r4 medium: the residency-cache defeat)."""
+    from pegasus_tpu.engine.sstable import SSTable, write_sst
+    from pegasus_tpu.engine.block import KVBlock
+    from pegasus_tpu.ops import compact as cops
+
+    # varying value widths -> uniform_layout() is None
+    recs = [(generate_key(b"h%02d" % i, b"s"), b"v" * (10 + i % 3), 0, False)
+            for i in range(64)]
+    recs.sort(key=lambda r: r[0])
+    block = KVBlock.from_records(recs)
+    assert block.uniform_layout() is None
+    path = str(tmp_path / "a.sst")
+    write_sst(path, block)
+    sst = SSTable(path)
+
+    calls = []
+    real = cops.pack_run_device
+
+    def counting(block, prefix_u32=cops.DEFAULT_PREFIX_U32, **kw):
+        calls.append(kw.get("with_values", False))
+        return real(block, prefix_u32, **kw)
+
+    monkeypatch.setattr(cops, "pack_run_device", counting)
+    dr1 = sst.device_run(cops.DEFAULT_PREFIX_U32, with_values=True)
+    assert dr1 is not None and dr1.val2d is None
+    assert sst._values_uncacheable
+    dr2 = sst.device_run(cops.DEFAULT_PREFIX_U32, with_values=True)
+    assert dr2 is dr1
+    assert len(calls) == 1  # no re-pack, no re-upload
+
+    # a uniform run upgrades exactly once and then stays cached
+    recs_u = [(generate_key(b"u%02d" % i, b"s"), b"v" * 16, 0, False)
+              for i in range(64)]
+    recs_u.sort(key=lambda r: r[0])
+    bu = KVBlock.from_records(recs_u)
+    assert bu.uniform_layout() is not None
+    path_u = str(tmp_path / "b.sst")
+    write_sst(path_u, bu)
+    sst_u = SSTable(path_u)
+    calls.clear()
+    d0 = sst_u.device_run(cops.DEFAULT_PREFIX_U32)           # value-less prime
+    assert d0 is not None and d0.val2d is None
+    d1 = sst_u.device_run(cops.DEFAULT_PREFIX_U32, with_values=True)
+    assert d1.val2d is not None and not sst_u._values_uncacheable
+    d2 = sst_u.device_run(cops.DEFAULT_PREFIX_U32, with_values=True)
+    assert d2 is d1 and len(calls) == 2

@@ -68,6 +68,141 @@ def test_election_takeover_after_silent_death(tmp_path):
         live.stop()
 
 
+def test_election_stop_before_start(tmp_path):
+    """stop() after a failed start() must not join a never-started thread
+    (that raises RuntimeError and masks the original error)."""
+    el = MetaElection(str(tmp_path / "meta.lock"), "127.0.0.1:1")
+    el.stop()  # no raise
+
+
+def test_stale_leader_persist_is_fenced(tmp_path):
+    """A leader stalled past its lease must not clobber state a newer
+    leader wrote: verify_for_persist re-reads the lease last-moment, the
+    persist RAISES (the DDL must not be acked) and the stale holder
+    demotes in place."""
+    import json
+
+    from pegasus_tpu.meta.meta_server import MetaServer
+
+    lock = str(tmp_path / "meta.lock")
+    state = str(tmp_path / "state.json")
+    old = MetaElection(lock, "127.0.0.1:1", lease_seconds=60.0,
+                       settle_seconds=0.01)
+    old._try_claim()
+    assert old.is_leader() and old.epoch == 1
+    ms_old = MetaServer(state, election=old)
+    ms_old._persist()
+    assert json.load(open(state))["epoch"] == 1
+
+    # takeover: B fences A with a higher epoch and persists its own state
+    new = MetaElection(lock, "127.0.0.1:2", lease_seconds=60.0,
+                       settle_seconds=0.01)
+    new._try_claim(lease_epoch=new._read()[2])
+    assert new.is_leader() and new.epoch == 2
+    ms_new = MetaServer(state, election=new)
+    ms_new.level = "steady"
+    ms_new._persist()
+
+    # stale A wakes up mid-persist: lease re-check fences it
+    ms_old.level = "blind"
+    with pytest.raises(RuntimeError, match="fenced"):
+        ms_old._persist()
+    assert not old.is_leader()
+    st = json.load(open(state))
+    assert st["level"] == "steady" and st["epoch"] == 2
+
+
+def test_persist_refuses_newer_state_epoch(tmp_path):
+    """Even when the lease read races in the stale leader's favor, a state
+    file carrying a newer epoch is never overwritten (the fencing token
+    itself, ADVICE-r4 medium) — and the fence releases the lease carrying
+    the newer lineage forward so the cluster does not livelock."""
+    import json
+
+    from pegasus_tpu.meta.meta_server import MetaServer
+
+    lock = str(tmp_path / "meta.lock")
+    state = str(tmp_path / "state.json")
+    el = MetaElection(lock, "127.0.0.1:1", lease_seconds=60.0,
+                      settle_seconds=0.01)
+    el._try_claim()
+    ms = MetaServer(state, election=el)
+    ms._persist()
+    # a newer leader's state lands while A still (wrongly) holds the lease
+    newer = json.load(open(state))
+    newer["epoch"], newer["level"] = 7, "steady"
+    json.dump(newer, open(state, "w"))
+    ms.level = "lively"
+    with pytest.raises(RuntimeError, match="fenced"):
+        ms._persist()  # fenced by epoch comparison
+    assert not el.is_leader()
+    st = json.load(open(state))
+    assert st["level"] == "steady" and st["epoch"] == 7
+    # the released lease carries epoch 7: the next claim exceeds it
+    holder, _, epoch = el._read()
+    assert holder is None and epoch == 7
+    el._try_claim(lease_epoch=epoch)
+    assert el.is_leader() and el.epoch == 8
+    ms.level = "lively"
+    ms._persist()  # no longer fenced
+    assert json.load(open(state))["epoch"] == 8
+
+
+def test_graceful_release_keeps_epoch_lineage(tmp_path):
+    """r5 review finding: a graceful stop() must not reset the epoch
+    lineage — the next claimant's epoch has to exceed the persisted state
+    epoch or every later persist would fence forever (livelock)."""
+    import json
+
+    from pegasus_tpu.meta.meta_server import MetaServer
+
+    lock = str(tmp_path / "meta.lock")
+    state = str(tmp_path / "state.json")
+
+    a = MetaElection(lock, "127.0.0.1:1", lease_seconds=1.0,
+                     settle_seconds=0.02,
+                     claim_floor=lambda: MetaServer(state)._state_epoch)
+    a.start()
+    assert _wait(lambda: a.is_leader())
+    ms_a = MetaServer(state, election=a)
+    ms_a._persist()
+    persisted = json.load(open(state))["epoch"]
+    a.stop()  # graceful: clears the holder, KEEPS the lineage
+
+    holder, _, kept = a._read()
+    assert holder is None and kept >= persisted
+
+    b = MetaElection(lock, "127.0.0.1:2", lease_seconds=1.0,
+                     settle_seconds=0.02,
+                     claim_floor=lambda: MetaServer(state)._state_epoch)
+    b.start()
+    assert _wait(lambda: b.is_leader())
+    assert b.epoch > persisted
+    ms_b = MetaServer(state, election=b)
+    ms_b.level = "steady"
+    ms_b._persist()  # must NOT fence
+    assert json.load(open(state))["level"] == "steady"
+    b.stop()
+
+
+def test_beacon_never_persists(tmp_path):
+    """Beacons reach followers too (the leader guard exempts them); a
+    follower absorbing a beacon from an unknown node must not write its
+    stale DDL snapshot over the shared state file (ADVICE-r4 high). The
+    beacon path now never persists — _load() rebuilds the node map from
+    re-beacons anyway."""
+    from pegasus_tpu.meta import messages as mm
+    from pegasus_tpu.meta.meta_server import MetaServer
+    from pegasus_tpu.rpc import codec
+
+    state = str(tmp_path / "state.json")
+    ms = MetaServer(state)
+    body = codec.encode(mm.BeaconRequest(node="127.0.0.1:7777"))
+    ms._on_beacon(None, body)
+    assert "127.0.0.1:7777" in ms._nodes
+    assert not os.path.exists(state)
+
+
 THREE_META_INI = """
 [apps.meta1]
 type = meta

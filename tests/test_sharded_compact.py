@@ -58,3 +58,71 @@ def test_empty_input(mesh):
     shards, stats = sharded_compact([], mesh, CompactOptions(backend="cpu", now=1))
     assert all(s.n == 0 for s in shards)
     assert stats["output_records"] == 0
+
+
+def _digest(block) -> bytes:
+    import hashlib
+
+    h = hashlib.sha256()
+    for arr in (block.key_arena, block.key_off, block.key_len,
+                block.val_arena, block.val_off, block.val_len,
+                block.expire_ts, block.hash32, block.deleted):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.digest()
+
+
+def test_sharded_block_byte_equal(mesh):
+    """sharded_compact_block (the engine seam) reassembles the exact
+    single-chip output block, byte for byte."""
+    from pegasus_tpu.parallel import sharded_compact_block
+
+    rng = np.random.default_rng(7)
+    runs = [make_block(_adversarial_records(rng, 400)) for _ in range(4)]
+    opts = CompactOptions(backend="cpu", now=100, pidx=1, partition_mask=1,
+                          bottommost=True, default_ttl=25, runs_sorted=None)
+    single = compact_blocks(runs, opts)
+    sharded = sharded_compact_block(runs, mesh, opts)
+    assert _digest(sharded.block) == _digest(single.block)
+    assert sharded.stats["output_records"] == single.block.n
+
+
+def test_engine_manual_compact_sharded_byte_equal(mesh, tmp_path):
+    """VERDICT-r3 item 7: manual_compact through the REAL engine routes to
+    the multi-chip kernel when a >1-device mesh is injected, and the
+    on-disk result is byte-equal to the single-chip engine's."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    def fill(eng):
+        rng = np.random.default_rng(3)
+        for i in range(600):
+            hk, sk = b"h%03d" % int(rng.integers(0, 80)), b"s%03d" % i
+            k = generate_key(hk, sk)
+            if rng.random() < 0.15:
+                eng.delete(k)
+            else:
+                expire = int(rng.integers(0, 3)) * 50
+                eng.put(k, SCHEMAS[2].generate_value(expire, 0, b"v%d" % i))
+            if i % 150 == 149:
+                eng.flush()
+
+    eng_s = LsmEngine(str(tmp_path / "sharded"),
+                      EngineOptions(backend="tpu", compaction_mesh=mesh))
+    eng_1 = LsmEngine(str(tmp_path / "single"),
+                      EngineOptions(backend="cpu"))
+    fill(eng_s)
+    fill(eng_1)
+    before = counters.rate("engine.sharded_compaction_count").value()
+    st_s = eng_s.manual_compact(now=60)
+    st_1 = eng_1.manual_compact(now=60)
+    assert counters.rate("engine.sharded_compaction_count").value() > before
+    assert st_s["output_records"] == st_1["output_records"]
+    bot_s = [s for s in eng_s._levels[eng_s.opts.max_levels]]
+    bot_1 = [s for s in eng_1._levels[eng_1.opts.max_levels]]
+    assert len(bot_s) == len(bot_1)
+    for a, b in zip(bot_s, bot_1):
+        assert _digest(a.block()) == _digest(b.block())
+    eng_s.close()
+    eng_1.close()
