@@ -94,3 +94,92 @@ def test_happy_path_child_lane_byte_equal():
     assert line["value"] is not None
     assert line["detail"]["byte_equal"] is True
     assert line["unit"] == "x"
+
+
+SCALE = os.path.join(REPO, "tools", "scale_bench.py")
+
+
+def run_scale(env_extra, timeout_s, n=50_000, maxdev=8192):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PEGASUS_SCALE_N": str(n),
+        "PEGASUS_SCALE_MAXDEV": str(maxdev),
+    })
+    env.update(env_extra)
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, SCALE], capture_output=True,
+                          text=True, timeout=timeout_s, env=env, cwd=REPO)
+    elapsed = time.monotonic() - t0
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line. rc={proc.returncode} err={proc.stderr[-800:]}"
+    return proc.returncode, json.loads(lines[-1]), elapsed
+
+
+def test_scale_bench_wedge_bounded():
+    """tools/scale_bench.py under a wedged device lane must emit a
+    degraded-but-parseable line within its watchdog budget, rc=0 (the
+    worst-case-runtime guarantee every tool needs, VERDICT-r3 item 8)."""
+    rc, line, elapsed = run_scale({"PEGASUS_SCALE_FAKE": "sleep",
+                                   "PEGASUS_SCALE_TIMEOUT_S": "12"},
+                                  timeout_s=120)
+    assert rc == 0
+    assert line["value"] is None
+    assert line["detail"]["degraded"] is True
+    assert "watchdog" in line["detail"]["reason"]
+    # the cpu lane's numbers still made it into the degraded line
+    assert line["detail"]["cpu_compact_s"] > 0
+    assert elapsed < 60
+
+
+def test_scale_bench_happy_blockwise():
+    """Happy path on the CPU platform: the device lane takes the blockwise
+    range-decomposition (n > max_device_records) and the output is
+    byte-equal to the native CPU lane."""
+    rc, line, elapsed = run_scale({"PEGASUS_SCALE_TIMEOUT_S": "300"},
+                                  timeout_s=360)
+    assert rc == 0
+    assert line["detail"]["byte_equal"] is True
+    assert line["detail"]["blocks"] >= 2
+    assert line["value"] is not None
+
+
+EBENCH = os.path.join(REPO, "tools", "engine_bench.py")
+
+
+def test_engine_bench_wedge_bounded():
+    """tools/engine_bench.py with a wedged backend init must emit a
+    degraded JSON line within its watchdog budget, rc=0 — the engine lane
+    is driven in-process by tpu_oneshot, but driven standalone it needs
+    its own worst-case bound (VERDICT-r3 item 8)."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PEGASUS_EBENCH_N": "20000",
+                "PEGASUS_EBENCH_FAKE": "sleep",
+                "PEGASUS_EBENCH_TIMEOUT_S": "8"})
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, EBENCH], capture_output=True,
+                          text=True, timeout=120, env=env, cwd=REPO)
+    elapsed = time.monotonic() - t0
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line. rc={proc.returncode} err={proc.stderr[-500:]}"
+    line = json.loads(lines[-1])
+    assert proc.returncode == 0
+    assert line["degraded"] is True and "watchdog" in line["reason"]
+    assert elapsed < 60
+
+
+def test_engine_bench_happy_cpu_only():
+    """Happy path: cpu-only lane completes well under the watchdog and
+    prints its lane line."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PEGASUS_EBENCH_N": "20000",
+                "PEGASUS_EBENCH_REPS": "1",
+                "PEGASUS_EBENCH_BACKENDS": "cpu",
+                "PEGASUS_EBENCH_DIR": "/tmp/pegasus_ebench_test",
+                "PEGASUS_EBENCH_TIMEOUT_S": "300"})
+    proc = subprocess.run([sys.executable, EBENCH], capture_output=True,
+                          text=True, timeout=320, env=env, cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert proc.returncode == 0 and lines
+    lane = json.loads(lines[0])
+    assert lane["backend"] == "cpu" and lane["manual_compact_s"] > 0

@@ -16,6 +16,12 @@ Lanes (PEGASUS_EBENCH_BACKENDS, default "cpu,tpu,tpu_dv"): cpu, tpu
 output values materialize on device; the measurement that decides
 whether the flag defaults on). Prints one JSON line per lane + a final
 comparison line of cpu vs the best tpu lane.
+
+Bounded (VERDICT-r3 item 8): a watchdog hard-exits with a degraded JSON
+line after PEGASUS_EBENCH_TIMEOUT_S (default 1200 s) carrying whatever
+lanes completed — a wedged tunnel mid-backend-init can stall the tpu
+lanes forever, and no tool may be able to hang its caller.
+PEGASUS_EBENCH_FAKE=sleep simulates that wedge (tests).
 """
 
 import json
@@ -27,6 +33,32 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+_RESULTS = {}  # lanes completed so far (the watchdog reports them)
+_PRINTED_FINAL = False
+
+
+def _arm_watchdog():
+    import threading
+
+    budget = int(os.environ.get("PEGASUS_EBENCH_TIMEOUT_S", 1200))
+    if budget <= 0:
+        return
+
+    def boom():
+        if not _PRINTED_FINAL:
+            print(json.dumps({
+                "metric": "engine manual_compact speedup tpu vs cpu",
+                "value": None, "unit": "x", "degraded": True,
+                "reason": f"watchdog fired after {budget}s",
+                "completed_lanes": {k: v.get("manual_compact_s")
+                                    for k, v in _RESULTS.items()},
+            }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(budget, boom)
+    t.daemon = True
+    t.start()
 
 
 def build_table(path: str, backend: str, n: int, value_size: int,
@@ -106,6 +138,8 @@ def table_digest(eng) -> str:
 
 
 def main():
+    global _PRINTED_FINAL
+    _arm_watchdog()
     n = int(os.environ.get("PEGASUS_EBENCH_N", 2_000_000))
     value_size = int(os.environ.get("PEGASUS_EBENCH_VALUE", 100))
     n_files = int(os.environ.get("PEGASUS_EBENCH_FILES", 4))
@@ -114,6 +148,8 @@ def main():
                               "cpu,tpu,tpu_dv").split(",")
     root = os.environ.get("PEGASUS_EBENCH_DIR", "/tmp/pegasus_engine_bench")
     if any(b.startswith("tpu") for b in backends):
+        if os.environ.get("PEGASUS_EBENCH_FAKE") == "sleep":
+            time.sleep(3600)  # test hook: backend init wedges
         import jax
 
         from pegasus_tpu.base.utils import enable_compile_cache
@@ -124,7 +160,7 @@ def main():
             jax.config.update("jax_platforms", "cpu")
         enable_compile_cache(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-    results = {}
+    results = _RESULTS
     for backend in backends:
         results[backend] = run_lane(backend, root, n, value_size, n_files,
                                     reps)
@@ -142,6 +178,7 @@ def main():
                               for k in tpu_lanes),
         }
         print(json.dumps(cmp), flush=True)
+    _PRINTED_FINAL = True
     shutil.rmtree(root, ignore_errors=True)
 
 
