@@ -162,6 +162,7 @@ def stage_engine():
     sys.path.insert(0, os.path.join(REPO, "tools"))
     import engine_bench
 
+    os.environ.setdefault("PEGASUS_EBENCH_TIMEOUT_S", "0")  # parent bounds
     buf = io.StringIO()
     real = sys.stdout
     t0 = time.time()
@@ -173,6 +174,30 @@ def stage_engine():
     for line in buf.getvalue().strip().splitlines():
         log(f"engine: {line}")
     log(f"engine: done in {time.time() - t0:.1f}s")
+
+
+def stage_scale():
+    """North-star scale ON CHIP, same lease: the blockwise
+    bigger-than-device compaction at PEGASUS_SCALE_N (default here 100M,
+    ~14 GB of input arenas — the v5e merge columns fit per 16M-record
+    range block; values stay host-side on this lane)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import scale_bench
+
+    os.environ.setdefault("PEGASUS_SCALE_N", "100000000")
+    os.environ.setdefault("PEGASUS_SCALE_TIMEOUT_S", "0")  # parent bounds
+    buf = io.StringIO()
+    real = sys.stdout
+    t0 = time.time()
+    try:
+        sys.stdout = buf
+        scale_bench.main()
+    finally:
+        sys.stdout = real
+        scale_bench._PRINTED = False
+    for line in buf.getvalue().strip().splitlines():
+        log(f"scale: {line}")
+    log(f"scale: done in {time.time() - t0:.1f}s")
 
 
 def main():
@@ -201,6 +226,8 @@ def main():
             stage_bench(pallas_ok)
         if "engine" in stages:
             stage_engine()
+        if "scale" in stages:
+            stage_scale()
     except Exception as e:  # noqa: BLE001 - log whatever stage died
         log(f"FATAL {type(e).__name__}: {str(e)[:300]}")
         for ln in traceback.format_exc().splitlines()[-10:]:
