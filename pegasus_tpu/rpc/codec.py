@@ -34,6 +34,9 @@ def _unzigzag(n: int) -> int:
 
 
 def write_varint(out: bytearray, n: int) -> None:
+    if n < 0x80:  # the overwhelmingly common case: counts, lengths,
+        out.append(n)  # small zigzagged ints — one append, no loop
+        return
     while True:
         b = n & 0x7F
         n >>= 7
@@ -45,6 +48,9 @@ def write_varint(out: bytearray, n: int) -> None:
 
 
 def read_varint(buf, off: int):
+    b = buf[off]
+    if not b & 0x80:
+        return b, off + 1
     shift = 0
     val = 0
     while True:
@@ -161,13 +167,22 @@ def _codec_for(t):
 
         return enc, dec
     if t is int:
-
+        # the hottest codec leaf (decrees, ballots, ids, error codes…):
+        # zigzag + varint inlined for the 1-byte case, no helper calls
         def enc(out, v):
-            write_varint(out, _zigzag(int(v)))
+            v = int(v)
+            v = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+            if v < 0x80:
+                out.append(v)
+            else:
+                write_varint(out, v)
 
         def dec(buf, off):
+            b = buf[off]
+            if not b & 0x80:
+                return (b >> 1) ^ -(b & 1), off + 1
             n, off = read_varint(buf, off)
-            return _unzigzag(n), off
+            return (n >> 1) ^ -(n & 1), off
 
         return enc, dec
     if isinstance(t, type) and issubclass(t, int):  # IntEnum
@@ -200,7 +215,7 @@ def _codec_for(t):
 
 
 class _StructPlan:
-    __slots__ = ("cls", "names", "encs", "decs", "n")
+    __slots__ = ("cls", "names", "encs", "decs", "n", "pairs")
 
     def __init__(self, cls):
         self.cls = cls
@@ -210,10 +225,12 @@ class _StructPlan:
         self.encs = [_codec_for(hints[f.name])[0] for f in fields]
         self.decs = [_codec_for(hints[f.name])[1] for f in fields]
         self.n = len(fields)
+        assert self.n < 0x80  # encode() writes the count as one raw byte
+        self.pairs = list(zip(self.names, self.encs))
 
     def encode(self, out, obj):
-        write_varint(out, self.n)
-        for name, enc in zip(self.names, self.encs):
+        out.append(self.n)  # field counts are tiny; 1-byte varint always
+        for name, enc in self.pairs:
             enc(out, getattr(obj, name))
 
     def decode(self, buf, off):

@@ -59,8 +59,13 @@ class RpcError(Exception):
 
 def _send_frame(sock, header: RpcHeader, body: bytes, lock=None) -> None:
     h = codec.encode(header)
-    payload = struct.pack("<I", len(h)) + h + body
-    frame = struct.pack("<I", len(payload)) + payload
+    hl = len(h)
+    # one buffer, one copy of the body (the old payload+frame concats
+    # copied large values twice per send)
+    frame = bytearray(8 + hl + len(body))
+    struct.pack_into("<II", frame, 0, 4 + hl + len(body), hl)
+    frame[8 : 8 + hl] = h
+    frame[8 + hl :] = body
     if lock:
         with lock:
             sock.sendall(frame)
@@ -69,7 +74,12 @@ def _send_frame(sock, header: RpcHeader, body: bytes, lock=None) -> None:
 
 
 def _recv_exact(sock, n: int) -> bytes:
-    buf = bytearray()
+    chunk = sock.recv(n)
+    if not chunk:
+        raise ConnectionError("peer closed")
+    if len(chunk) == n:  # common case: whole segment in one recv —
+        return chunk     # no bytearray, no copy
+    buf = bytearray(chunk)
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
