@@ -97,6 +97,72 @@ def available() -> bool:
     return _load() is not None
 
 
+# ------------------------------------------------------------- fastcodec
+# The RPC wire codec's C interpreter (fastcodec.c): a true CPython
+# extension (needs Python.h, unlike hostops' plain ctypes), compiled on
+# first use and imported from its file path. rpc.codec falls back to the
+# pure-Python closures when this returns None.
+
+_FC_SRC = os.path.join(_DIR, "fastcodec.c")
+_fc_lock = threading.Lock()
+_fc_mod = None
+_fc_tried = False
+
+
+def fastcodec():
+    """-> the compiled fastcodec extension module, or None."""
+    global _fc_mod, _fc_tried
+    with _fc_lock:
+        if _fc_tried:
+            return _fc_mod
+        _fc_tried = True
+        import sysconfig
+
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        so = os.path.join(_DIR, "fastcodec" + suffix)
+
+        def try_load(path):
+            try:
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location("fastcodec",
+                                                              path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                return mod
+            except Exception:  # noqa: BLE001 - load failure -> rebuild/None
+                return None
+
+        mod = None
+        if (os.path.exists(so)
+                and os.path.getmtime(so) >= os.path.getmtime(_FC_SRC)):
+            mod = try_load(so)
+        if mod is None:
+            # build to a per-process tmp then atomically replace: several
+            # server processes may race the first build, and gcc writing
+            # the final path directly could leave a corrupt (and
+            # fresher-than-source, so never rebuilt) artifact
+            tmp = f"{so}.{os.getpid()}.tmp"
+            inc = sysconfig.get_paths()["include"]
+            cmd = ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+                   "-o", tmp, _FC_SRC]
+            try:
+                res = subprocess.run(cmd, capture_output=True, timeout=120)
+                if res.returncode != 0:
+                    return None
+                os.replace(tmp, so)
+            except (OSError, subprocess.TimeoutExpired):
+                return None
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            mod = try_load(so)
+        _fc_mod = mod
+        return _fc_mod
+
+
 def crc64_batch(arena, offsets, lengths):
     """uint64[n] crc64 of each slice; native slice-by-8 when available."""
     lib = _load()

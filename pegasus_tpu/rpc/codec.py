@@ -22,6 +22,7 @@ the append-only evolution rule the thrift ids gave the reference.
 
 import dataclasses
 import functools
+import threading
 import typing
 
 
@@ -197,18 +198,29 @@ def _codec_for(t):
         return enc, dec
     if dataclasses.is_dataclass(t):
         # bind the plan once on first use (lazy, not eager, so recursive
-        # dataclasses don't loop during plan construction)
+        # dataclasses don't loop during plan construction). The bound plan
+        # may be the C fast path (bytes-returning encode / offset-aware
+        # decode_from) or the Python _StructPlan.
         plan = []
 
         def enc(out, v):
             if not plan:
-                plan.append(_plan_of(t))
-            plan[0].encode(out, v)
+                p = _plan_of(t)
+                plan.append((p, isinstance(p, _StructPlan)))
+            p, is_py = plan[0]
+            if is_py:
+                p.encode(out, v)
+            else:
+                out += p.encode(v)
 
         def dec(buf, off):
             if not plan:
-                plan.append(_plan_of(t))
-            return plan[0].decode(buf, off)
+                p = _plan_of(t)
+                plan.append((p, isinstance(p, _StructPlan)))
+            p, is_py = plan[0]
+            if is_py:
+                return p.decode(buf, off)
+            return p.decode_from(buf, off)
 
         return enc, dec
     raise CodecError(f"unsupported type {t!r}")
@@ -225,11 +237,13 @@ class _StructPlan:
         self.encs = [_codec_for(hints[f.name])[0] for f in fields]
         self.decs = [_codec_for(hints[f.name])[1] for f in fields]
         self.n = len(fields)
-        assert self.n < 0x80  # encode() writes the count as one raw byte
         self.pairs = list(zip(self.names, self.encs))
 
     def encode(self, out, obj):
-        out.append(self.n)  # field counts are tiny; 1-byte varint always
+        # write_varint, not a raw byte: its <0x80 fast path is one append
+        # anyway, and a 128+-field dataclass (which the C plan rejects,
+        # landing exactly here) still frames correctly
+        write_varint(out, self.n)
         for name, enc in self.pairs:
             enc(out, getattr(obj, name))
 
@@ -244,21 +258,141 @@ class _StructPlan:
         return self.cls(**kwargs), off
 
 
-@functools.lru_cache(maxsize=None)
-def _plan_of(cls) -> _StructPlan:
-    return _StructPlan(cls)
+# ----------------------------------------------------------- C fast path
+# native/fastcodec.c interprets the same wire format from a node tree
+# compiled once per dataclass; ~half the serving CPU was inside the
+# Python closures above. Specs mirror _codec_for case by case; any shape
+# the C side can't express falls the WHOLE class back to _StructPlan
+# (differential fuzzing in tests/test_fastcodec.py pins byte equality).
+
+_fast_plans = {}  # cls -> fastcodec.Plan (two-phase: create, then init)
+_plan_lock = threading.RLock()  # serializes ALL plan construction:
+# lru_cache does not serialize concurrent misses, and a racing thread
+# must never see a created-but-uninitialized fc.Plan
+
+
+def _lazy_unsupported(t) -> bool:
+    """Would the PYTHON codec defer this type lazily (raise only on first
+    real use)? That is the oracle for the C 'X' node: anything the Python
+    path genuinely supports must NOT narrow to empty-only, or C-path and
+    Python-fallback peers split wire compatibility."""
+    try:
+        _codec_for(t)
+        return False
+    except CodecError:
+        return True
+
+
+def _spec_for(t, fc, created):
+    """Build the C node spec for one annotation, inside the transaction
+    `created` (the classes whose plans this top-level build created)."""
+    if dataclasses.is_dataclass(t):
+        return ("D", _fast_plan(t, fc, created))
+    origin = typing.get_origin(t)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) != 1:
+            raise CodecError(f"unsupported union {t!r}")
+        try:
+            return ("O", _spec_for(args[0], fc, created))
+        except CodecError:
+            if _lazy_unsupported(args[0]):
+                return ("O", ("X",))  # always-None Optionals still work
+            raise  # C-specific failure: fall the WHOLE class back
+    if origin in (list, typing.List):
+        (item_t,) = typing.get_args(t)
+        try:
+            return ("L", _spec_for(item_t, fc, created))
+        except CodecError:
+            if _lazy_unsupported(item_t):
+                return ("L", ("X",))  # empty lists still round-trip
+            raise  # C-specific failure: fall the WHOLE class back
+    if t is bytes:
+        return ("y",)
+    if t is str:
+        return ("s",)
+    if t is bool:
+        return ("b",)
+    if t is int:
+        return ("i",)
+    if isinstance(t, type) and issubclass(t, int):  # IntEnum
+        return ("e", t)
+    raise CodecError(f"unsupported type {t!r}")
+
+
+def _fast_plan(cls, fc, created=None):
+    plan = _fast_plans.get(cls)
+    if plan is not None:
+        return plan
+    # transactional build: a failure anywhere in a recursive plan graph
+    # must discard EVERY plan created during this top-level call — an
+    # initialized sibling that captured the failing in-flight plan in a
+    # 'D' node would otherwise encode it as an empty struct forever
+    top = created is None
+    if top:
+        created = []
+    # two-phase so recursive dataclasses resolve to the in-flight plan
+    plan = fc.Plan()
+    _fast_plans[cls] = plan
+    created.append(cls)
+    try:
+        hints = typing.get_type_hints(cls)
+        fields = dataclasses.fields(cls)
+        names = tuple(f.name for f in fields)
+        specs = tuple(_spec_for(hints[f.name], fc, created)
+                      for f in fields)
+        plan.init_plan(cls, names, specs)
+    except Exception:
+        if top:
+            for c in created:
+                _fast_plans.pop(c, None)
+        raise
+    return plan
+
+
+_plan_cache = {}  # cls -> finished plan; published only AFTER init
+
+
+def _plan_of(cls):
+    plan = _plan_cache.get(cls)  # lock-free hot path (GIL-atomic dict)
+    if plan is not None:
+        return plan
+    with _plan_lock:
+        plan = _plan_cache.get(cls)
+        if plan is not None:
+            return plan
+        from .. import native
+
+        fc = native.fastcodec()
+        if fc is not None:
+            fc.register_error(CodecError)
+            try:
+                plan = _fast_plan(cls, fc)
+            except Exception:  # noqa: BLE001 - unsupported shape: Python
+                plan = _StructPlan(cls)
+        else:
+            plan = _StructPlan(cls)
+        _plan_cache[cls] = plan
+        return plan
 
 
 def encode(obj) -> bytes:
     """Serialize a rpc.messages dataclass instance."""
-    out = bytearray()
-    _plan_of(type(obj)).encode(out, obj)
-    return bytes(out)
+    plan = _plan_of(type(obj))
+    if type(plan) is _StructPlan:
+        out = bytearray()
+        plan.encode(out, obj)
+        return bytes(out)
+    return plan.encode(obj)  # C fast path: one call, returns bytes
 
 
 def decode(cls, data) -> object:
     """Deserialize `data` into an instance of dataclass `cls`."""
-    obj, off = _plan_of(cls).decode(data, 0)
-    if off != len(data):
-        raise CodecError(f"{cls.__name__}: {len(data) - off} trailing bytes")
-    return obj
+    plan = _plan_of(cls)
+    if type(plan) is _StructPlan:
+        obj, off = plan.decode(data, 0)
+        if off != len(data):
+            raise CodecError(
+                f"{cls.__name__}: {len(data) - off} trailing bytes")
+        return obj
+    return plan.decode(data)  # C fast path: trailing check included
