@@ -134,9 +134,15 @@ def fastcodec():
                 return None
 
         mod = None
-        if (os.path.exists(so)
-                and os.path.getmtime(so) >= os.path.getmtime(_FC_SRC)):
+        try:
+            fresh = (os.path.exists(so)
+                     and os.path.getmtime(so) >= os.path.getmtime(_FC_SRC))
+        except OSError:  # e.g. source missing but artifact present:
+            fresh = os.path.exists(so)  # trust the artifact, else None
+        if fresh:
             mod = try_load(so)
+        if mod is None and not os.path.exists(_FC_SRC):
+            return None  # nothing to build from
         if mod is None:
             # build to a per-process tmp then atomically replace: several
             # server processes may race the first build, and gcc writing

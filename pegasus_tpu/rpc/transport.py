@@ -73,27 +73,44 @@ def _send_frame(sock, header: RpcHeader, body: bytes, lock=None) -> None:
         sock.sendall(frame)
 
 
-def _recv_exact(sock, n: int) -> bytes:
-    chunk = sock.recv(n)
-    if not chunk:
-        raise ConnectionError("peer closed")
-    if len(chunk) == n:  # common case: whole segment in one recv —
-        return chunk     # no bytearray, no copy
-    buf = bytearray(chunk)
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
+class _FrameReader:
+    """Buffered framing for a socket with a SINGLE reader thread: one
+    kernel recv typically yields several pipelined frames (length word +
+    header + body used to cost 2+ recv syscalls per frame)."""
 
+    __slots__ = ("sock", "buf", "pos")
 
-def _recv_frame(sock):
-    (plen,) = struct.unpack("<I", _recv_exact(sock, 4))
-    payload = _recv_exact(sock, plen)
-    (hlen,) = struct.unpack("<I", payload[:4])
-    header = codec.decode(RpcHeader, payload[4 : 4 + hlen])
-    return header, payload[4 + hlen :]
+    def __init__(self, sock):
+        self.sock = sock
+        self.buf = bytearray()
+        self.pos = 0
+
+    def _fill(self, need: int) -> None:
+        buf = self.buf
+        if self.pos and (len(buf) == self.pos or self.pos > (1 << 16)):
+            del buf[: self.pos]  # compact consumed bytes
+            self.pos = 0
+        while len(buf) - self.pos < need:
+            chunk = self.sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+
+    def frame(self):
+        self._fill(4)
+        pos = self.pos
+        (plen,) = struct.unpack_from("<I", self.buf, pos)
+        self._fill(4 + plen)
+        pos = self.pos  # _fill may have compacted
+        (hlen,) = struct.unpack_from("<I", self.buf, pos + 4)
+        mv = memoryview(self.buf)
+        try:
+            header = codec.decode(RpcHeader, mv[pos + 8 : pos + 8 + hlen])
+            body = bytes(mv[pos + 8 + hlen : pos + 4 + plen])  # ONE copy
+        finally:
+            mv.release()  # buf must be resizable before the next _fill
+        self.pos = pos + 4 + plen
+        return header, body
 
 
 class RpcServer:
@@ -125,9 +142,10 @@ class RpcServer:
                 self.request.setsockopt(socket.IPPROTO_TCP,
                                         socket.TCP_NODELAY, 1)
                 wlock = threading.Lock()
+                reader = _FrameReader(self.request)
                 try:
                     while True:
-                        header, body = _recv_frame(self.request)
+                        header, body = reader.frame()
                         outer._dispatch(self.request, wlock, header, body)
                 except (ConnectionError, OSError):
                     pass
@@ -221,13 +239,15 @@ class RpcConnection:
         self._pending = {}   # seq -> (event, slot)
         self._seq = 0
         self._dead = None
+        self._ev_pool = []   # recycled Events (success path only)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     def _read_loop(self):
         try:
+            reader = _FrameReader(self._sock)
             while True:
-                header, body = _recv_frame(self._sock)
+                header, body = reader.frame()
                 with self._plock:
                     ent = self._pending.pop(header.seq, None)
                 if ent:
@@ -252,7 +272,11 @@ class RpcConnection:
         with self._plock:
             self._seq += 1
             seq = self._seq
-            ev, slot = threading.Event(), []
+            # recycle Events from completed calls: one allocation
+            # (Event + its Condition + lock) per RPC adds up at
+            # thousands of calls/s
+            ev = self._ev_pool.pop() if self._ev_pool else threading.Event()
+            slot = []
             self._pending[seq] = (ev, slot)
         header = RpcHeader(seq=seq, code=code, app_id=app_id,
                            partition_index=partition_index,
@@ -264,12 +288,18 @@ class RpcConnection:
                 self._pending.pop(seq, None)
             raise RpcError(ERR_NETWORK_FAILURE, str(e))
         if not ev.wait(timeout):
+            # do NOT recycle: the reader may still set this event later
             with self._plock:
                 self._pending.pop(seq, None)
             raise RpcError(ERR_TIMEOUT, f"{code} after {timeout}s")
         if not slot or slot[0] is None:
             raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
         rh, rbody = slot[0]
+        # set + consumed: nobody else references this event again
+        ev.clear()
+        with self._plock:
+            if len(self._ev_pool) < 64:
+                self._ev_pool.append(ev)
         if rh.error != ERR_OK:
             raise RpcError(rh.error, rh.error_text)
         return rh, rbody
