@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-KNOWN_BENCHMARKS = ("fillseq_pegasus", "fillrandom_pegasus",
+KNOWN_BENCHMARKS = ("scan_pegasus", "fillseq_pegasus", "fillrandom_pegasus",
                     "readrandom_pegasus", "deleterandom_pegasus")
 
 
@@ -99,6 +99,42 @@ def run_lane(name, meta_addr, table, n_per_thread, n_threads, value_size):
     }
 
 
+def run_scan_lane(meta_addr, table, n_threads):
+    """Full-table scan throughput (the copy_data / backup / bulk-export
+    shape, reference scan_data in pegasus_bench): every partition's
+    unordered scanner drained, split over n_threads."""
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+
+    cli = PegasusClient(MetaResolver([meta_addr], table), timeout=15)
+    scanners = cli.get_unordered_scanners()
+    counts = [0] * n_threads
+    lock = threading.Lock()
+    queue = list(scanners)
+
+    def worker(tid):
+        while True:
+            with lock:
+                if not queue:
+                    return
+                sc = queue.pop()
+            for _ in sc:
+                counts[tid] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    cli.close()
+    total = sum(counts)
+    return {"benchmark": "scan_pegasus", "threads": n_threads,
+            "qps": round(total / elapsed, 1), "ops": total,
+            "errors": 0, "elapsed_s": round(elapsed, 2)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--meta", default="")
@@ -126,8 +162,11 @@ def main():
     try:
         for n_threads in (int(t) for t in args.threads.split(",")):
             for name in names:
-                out = run_lane(name, meta_addr, args.table,
-                               args.num, n_threads, args.value_size)
+                if name == "scan_pegasus":
+                    out = run_scan_lane(meta_addr, args.table, n_threads)
+                else:
+                    out = run_lane(name, meta_addr, args.table,
+                                   args.num, n_threads, args.value_size)
                 print(json.dumps(out), flush=True)
     finally:
         if box is not None:
