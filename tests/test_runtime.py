@@ -181,3 +181,51 @@ def test_config_strips_inline_comments(tmp_path):
     assert cfg.get_string("pegasus.server", "compaction_backend", "") == "tpu"
     assert cfg.get_list("pegasus.server", "meta_servers", []) == \
         ["127.0.0.1:34601"]
+
+
+def test_frame_reader_fragmented_and_large():
+    """_FrameReader must parse frames regardless of how the kernel chops
+    the byte stream: 1-byte drips, segment-straddling boundaries, frames
+    bigger than the 64KB refill, and multiple frames per chunk."""
+    import struct
+
+    from pegasus_tpu.rpc import codec
+    from pegasus_tpu.rpc.transport import RpcHeader, _FrameReader
+
+    def make_frame(seq, body):
+        h = codec.encode(RpcHeader(seq=seq, code="RPC_T"))
+        payload = struct.pack("<I", len(h)) + h + body
+        return struct.pack("<I", len(payload)) + payload
+
+    bodies = [b"", b"x", b"y" * 10, b"z" * 200_000, b"tail"]
+    stream = b"".join(make_frame(i, b) for i, b in enumerate(bodies))
+
+    class FakeSock:
+        """Feeds the stream in adversarial chunk sizes."""
+
+        def __init__(self, data, sizes):
+            self.data = data
+            self.off = 0
+            self.sizes = sizes
+            self.i = 0
+
+        def recv(self, n):
+            if self.off >= len(self.data):
+                return b""
+            take = min(n, self.sizes[self.i % len(self.sizes)],
+                       len(self.data) - self.off)
+            self.i += 1
+            chunk = self.data[self.off : self.off + take]
+            self.off += take
+            return chunk
+
+    for sizes in ([1], [3, 7, 11], [65536], [5, 100000], [2, 65536, 9]):
+        r = _FrameReader(FakeSock(stream, sizes))
+        for i, body in enumerate(bodies):
+            header, got = r.frame()
+            assert header.seq == i and got == body, (sizes, i)
+        # stream exhausted -> peer-closed surfaces as ConnectionError
+        import pytest as _pytest
+
+        with _pytest.raises(ConnectionError):
+            r.frame()
