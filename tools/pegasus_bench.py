@@ -25,7 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-KNOWN_BENCHMARKS = ("scan_pegasus", "fillseq_pegasus", "fillrandom_pegasus",
+KNOWN_BENCHMARKS = ("scan_pegasus", "multisetrandom_pegasus",
+                    "multigetrandom_pegasus",
+                    "fillseq_pegasus", "fillrandom_pegasus",
                     "readrandom_pegasus", "deleterandom_pegasus")
 
 
@@ -56,6 +58,16 @@ def run_lane(name, meta_addr, table, n_per_thread, n_threads, value_size):
             def op():
                 cli.delete(b"bk%02d%08d" % (tid, rng.randrange(n_per_thread)),
                            b"s")
+        elif name == "multisetrandom_pegasus":
+            # reference pegasus_bench multi_set: 10 sortkeys per op under
+            # one hash key (one batched write RPC / one decree)
+            def op():
+                hk = b"mk%02d%06d" % (tid, rng.randrange(n_per_thread))
+                cli.multi_set(hk, {b"s%02d" % i: value for i in range(10)})
+        elif name == "multigetrandom_pegasus":
+            def op():
+                hk = b"mk%02d%06d" % (tid, rng.randrange(n_per_thread))
+                cli.multi_get(hk)
         else:
             raise ValueError(f"unknown benchmark {name}")
         return op
