@@ -155,6 +155,30 @@ def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
                 ex, de, hs = ex[order], de[order], hs[order]
                 if rk is not None:
                     rk = rk[order]
+        # LSM runs are intra-run UNIQUE (flush dedups, compaction outputs
+        # dedup, ingest requires dedup); inputs that violate that (tests,
+        # raw external sets) get first-wins dedup HERE, on EVERY backend —
+        # the device merge networks are not stable, so duplicate
+        # (key, prio) rows would survive nondeterministically. Sorted runs
+        # have duplicates adjacent, so the check is one vector compare
+        # (over sbytes when packed, else over the raw sort columns).
+        n_run = len(kl)
+        dup = np.zeros(n_run, dtype=bool)
+        if sb is not None:
+            dup[1:] = sb[1:] == sb[:-1]
+        elif n_run > 1:
+            same = np.all(pref[1:] == pref[:-1], axis=1) & (kl[1:] == kl[:-1])
+            if rk is not None:
+                same &= rk[1:] == rk[:-1]
+            dup[1:] = same
+        if dup.any():
+            keep_rows = ~dup
+            pref, kl, gi = pref[keep_rows], kl[keep_rows], gi[keep_rows]
+            ex, de, hs = ex[keep_rows], de[keep_rows], hs[keep_rows]
+            if sb is not None:
+                sb = sb[keep_rows]
+            if rk is not None:
+                rk = rk[keep_rows]
         cols.append([np.ascontiguousarray(pref[:, j]) for j in range(w)])
         rank_l.append(rk)
         klen_l.append(kl)
@@ -163,7 +187,9 @@ def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
         aux_l.append((ex, de, hs))
     return PackedRuns(
         w=w, has_rank=has_rank, cols=cols, rank=rank_l, klen=klen_l,
-        gidx=gidx_l, sbytes=sb_l, lens=tuple(b.n for b in runs),
+        gidx=gidx_l, sbytes=sb_l,
+        # post-dedup lengths (gidx still indexes the ORIGINAL concat)
+        lens=tuple(len(g) for g in gidx_l),
         blocks=list(runs), run_aux=aux_l,
     )
 
@@ -844,7 +870,6 @@ def compact_blocks(blocks, opts: CompactOptions,
     if (device_runs is not None and backend.name == "tpu"
             and len(device_runs) == len(runs)
             and all(d is not None for d in device_runs)):
-        n = sum(d.n for d in device_runs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
         # cheap checks first: uniform_layout() is four O(n) reductions,
         # wasted work whenever value residency is off (the default)
@@ -864,17 +889,18 @@ def compact_blocks(blocks, opts: CompactOptions,
     elif backend.name == "tpu":
         packed = pack_runs(runs, opts, need_sbytes=False)
         dev_idx, count = backend.survivors_device(packed, *fargs)
-        n = sum(packed.lens)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
         out = gather_device_survivors(concat, dev_idx, count)
     else:
         packed = pack_runs(runs, opts, need_sbytes=True)
         survivors = backend.survivors(packed, *fargs)
-        n = sum(packed.lens)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
         out = concat.gather(survivors)
     out = apply_post_filters(out, opts, now)
-    return CompactResult(out, _stats(n, out.n))
+    # stats count RAW input rows (pre any pack-time intra-run dedup) so
+    # every path — cpu, device, cached, sharded, blockwise — reports the
+    # same input_records for the same inputs
+    return CompactResult(out, _stats(sum(b.n for b in runs), out.n))
 
 
 def apply_post_filters(out: KVBlock, opts: CompactOptions,
@@ -964,13 +990,21 @@ def sort_block(block: KVBlock, opts: CompactOptions = None) -> KVBlock:
 
 
 def merge_body(cols, rank, klen, prio, expire, deleted, hash32, valid,
-               now, pidx, pmask, bottommost, do_filter):
+               now, pidx, pmask, bottommost, do_filter, pos=None):
     """Single-array device merge: full sort + dedup + filter on jnp arrays.
 
     Used by the shard_map'd multi-chip path (parallel.sharded_compact),
     whose all_to_all routing scrambles run order, and by the driver's
     single-chip compile check. Returns (perm, keep) in sorted order.
     Input length must be a power of two (callers pad).
+
+    `pos` (uint32) is the LAST sort key: the tie-break among rows with
+    identical (key, prio) — i.e. duplicate keys within one run. Sort
+    networks are not stable, so without a keyed position the surviving
+    version of an intra-run duplicate is nondeterministic (and the
+    sharded path's all_to_all re-orders rows, so its local iota is NOT
+    original order). Callers with scrambled layouts pass the original
+    concat index; None = rows are in original order, use iota.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -982,8 +1016,11 @@ def merge_body(cols, rank, klen, prio, expire, deleted, hash32, valid,
     key_cols = [jnp.where(valid, c, big) for c in cols]
     key_cols.append(jnp.where(valid, rank, big))
     key_cols.append(jnp.where(valid, klen, big))
-    sort_ops = key_cols + [jnp.where(valid, prio, big)]
     iota = lax.iota(jnp.int32, n)
+    if pos is None:
+        pos = iota.astype(jnp.uint32)
+    sort_ops = key_cols + [jnp.where(valid, prio, big),
+                           jnp.where(valid, pos, big)]
     out = sort_network(sort_ops + [iota], nk=len(sort_ops))
     s_key_cols = out[: len(key_cols)]
     perm = out[-1]

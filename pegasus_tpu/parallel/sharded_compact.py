@@ -90,6 +90,11 @@ def _sharded_kernel(mesh_key, w: int, n_loc: int, cap: int, axis: str):
         perm, keep = merge_body(
             r_cols, r_rank, r_klen, r_prio, r_expire, r_deleted, r_hash, r_valid,
             now, pidx, pmask, bottommost, do_filter,
+            # the routing scrambled row order: tie-break intra-run
+            # duplicate keys by ORIGINAL concat position, matching the
+            # host backend's stable first-wins (invalid rows carry gid -1
+            # but every sort key is already forced to the max there)
+            pos=r_gid.astype(jnp.uint32),
         )
         return r_gid[perm], keep, overflow[None]
 

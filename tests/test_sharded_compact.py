@@ -126,3 +126,27 @@ def test_engine_manual_compact_sharded_byte_equal(mesh, tmp_path):
         assert _digest(a.block()) == _digest(b.block())
     eng_s.close()
     eng_1.close()
+
+
+def test_sharded_block_byte_equal_with_user_rules(mesh):
+    """Post filters (user compaction rules then default-TTL rewrite) must
+    run in compact_blocks' exact order after shard reassembly — a clock or
+    ordering skew between the kernel and the post pass would break the
+    byte-equality contract."""
+    from pegasus_tpu.engine.compaction_rules import \
+        parse_user_specified_compaction
+    from pegasus_tpu.parallel import sharded_compact_block
+
+    ops = tuple(parse_user_specified_compaction(
+        '{"ops": [{"type": "COT_DELETE", "params": "{}", "rules": '
+        '[{"type": "FRT_SORTKEY_PATTERN", "params": '
+        '"{\\"pattern\\": \\"s1\\", \\"match_type\\": '
+        '\\"SMT_MATCH_PREFIX\\"}"}]}]}'))
+    assert ops
+    rng = np.random.default_rng(11)
+    runs = [make_block(_adversarial_records(rng, 350)) for _ in range(3)]
+    opts = CompactOptions(backend="cpu", now=60, bottommost=True,
+                          user_ops=ops, default_ttl=500, runs_sorted=None)
+    single = compact_blocks(runs, opts)
+    sharded = sharded_compact_block(runs, mesh, opts)
+    assert _digest(sharded.block) == _digest(single.block)
