@@ -1,0 +1,118 @@
+"""redis-benchmark-style lanes through the RESP proxy (reference
+redis_proxy over pegasus, ecosystem row SURVEY §2.6): SET / GET / INCR
+driven over raw RESP sockets against a proxy backed by a live onebox,
+one JSON line per lane.
+
+    python tools/redis_bench.py [--ops 10000] [--threads 1,4]
+"""
+
+import argparse
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def resp_cmd(*args) -> bytes:
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        a = a if isinstance(a, bytes) else str(a).encode()
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+def read_reply(f):
+    line = f.readline().rstrip(b"\r\n")
+    t, rest = line[:1], line[1:]
+    if t in (b"+", b"-", b":"):
+        return rest
+    if t == b"$":
+        n = int(rest)
+        if n < 0:
+            return None
+        data = f.read(n + 2)[:-2]
+        return data
+    if t == b"*":
+        return [read_reply(f) for _ in range(int(rest))]
+    raise ValueError(f"bad RESP type {t!r}")
+
+
+def run_lane(name, addr, n_ops, n_threads, value):
+    lats = [[] for _ in range(n_threads)]
+    errors = [0] * n_threads
+
+    def worker(tid):
+        rng = random.Random(tid * 31)
+        sock = socket.create_connection(addr, timeout=15)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        f = sock.makefile("rwb")
+        for i in range(n_ops):
+            key = b"rb%02d%06d" % (tid, rng.randrange(n_ops))
+            if name == "SET":
+                cmd = resp_cmd(b"SET", key, value)
+            elif name == "GET":
+                cmd = resp_cmd(b"GET", key)
+            else:
+                cmd = resp_cmd(b"INCR", b"ctr%02d" % tid)
+            t0 = time.perf_counter()
+            f.write(cmd)
+            f.flush()
+            reply = read_reply(f)
+            lats[tid].append((time.perf_counter() - t0) * 1e6)
+            if isinstance(reply, bytes) and reply.startswith(b"ERR"):
+                errors[tid] += 1
+        sock.close()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    flat = sorted(x for lane in lats for x in lane)
+    total = len(flat)
+    return {"benchmark": f"redis_{name}", "threads": n_threads,
+            "qps": round(total / elapsed, 1),
+            "avg_us": round(sum(flat) / max(1, total), 1),
+            "p99_us": round(flat[min(total - 1, int(total * .99))], 1),
+            "ops": total, "errors": sum(errors)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--meta", default="")
+    ap.add_argument("--ops", type=int, default=10_000)
+    ap.add_argument("--threads", default="1")
+    ap.add_argument("--value-size", type=int, default=100)
+    ns = ap.parse_args()
+
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+    from pegasus_tpu.redis_proxy import RedisProxy
+
+    from tools._onebox import resolve_cluster
+
+    meta_addr, box = resolve_cluster(ns.meta, "redisbench", 8)
+    cli = PegasusClient(MetaResolver([meta_addr], "redisbench"), timeout=15)
+    proxy = RedisProxy(cli).start()
+    value = os.urandom(ns.value_size)
+    try:
+        for n_threads in (int(t) for t in ns.threads.split(",")):
+            for lane in ("SET", "GET", "INCR"):
+                print(json.dumps(run_lane(lane, proxy.address, ns.ops,
+                                          n_threads, value)), flush=True)
+    finally:
+        proxy.stop()
+        cli.close()
+        if box is not None:
+            box.stop()
+
+
+if __name__ == "__main__":
+    main()
