@@ -450,3 +450,58 @@ def test_capacity_units_per_op_semantics(tmp_path):
     r, w = delta(lambda: srv.on_get(key_schema.generate_key(b"h", b"s")))
     assert r >= 1 and w == 0 and gb._value > b0
     srv.close()
+
+
+def test_scan_session_survives_manual_compact(srv):
+    """SURVEY §7 hard part (f): a scan session opened before a compaction
+    must keep iterating its snapshot correctly after the compaction swaps
+    and UNLINKS every input file mid-session — pinned-iterator semantics
+    (the reference pins RocksDB iterators; here readers hold cached
+    SSTable blocks across the swap)."""
+    for i in range(80):
+        put(srv, b"scc", b"s%03d" % i, b"v%d" % i)
+    srv.engine.flush()
+    for i in range(80, 160):
+        put(srv, b"scc", b"s%03d" % i, b"v%d" % i)
+    srv.engine.flush()
+    srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "30"})
+    try:
+        req = msg.GetScannerRequest(
+            start_key=key_schema.generate_key(b"scc", b""),
+            stop_key=key_schema.generate_next_bytes(b"scc"),
+            batch_size=25, validate_partition_hash=False)
+        r = srv.on_get_scanner(req)
+        got = [(kv.key, kv.value) for kv in r.kvs]
+        compacted = False
+        rounds = 0
+        while r.context_id >= 0:
+            if not compacted and len(got) >= 25:
+                # mid-session: full manual compaction rewrites + unlinks
+                # every file the scan context's snapshot points at
+                srv.engine.manual_compact(now=1)
+                # and a second write burst + flush + compact churns again
+                for i in range(160, 200):
+                    put(srv, b"scc", b"s%03d" % i, b"x")
+                srv.engine.flush()
+                srv.engine.manual_compact(now=1)
+                compacted = True
+            r = srv.on_scan(msg.ScanRequest(r.context_id))
+            got.extend((kv.key, kv.value) for kv in r.kvs)
+            rounds += 1
+            assert rounds < 100
+        assert compacted
+        from pegasus_tpu.base.key_schema import restore_key
+
+        # the session's snapshot: exactly the 160 pre-compaction rows, in
+        # order, with their values intact (rows written mid-scan are not
+        # required to appear — snapshot semantics)
+        sks = [restore_key(k)[1] for k, _ in got]
+        assert sks == sorted(sks)
+        base = {b"s%03d" % i: b"v%d" % i for i in range(160)}
+        for k, v in got:
+            sk = restore_key(k)[1]
+            if sk in base:
+                assert v == base[sk], sk
+        assert len([s for s in sks if s in base]) == 160
+    finally:
+        srv.update_app_envs({consts.ROCKSDB_ITERATION_THRESHOLD_COUNT: "1000"})
