@@ -1,4 +1,4 @@
-from .mesh import make_mesh
+from .mesh import init_multihost, make_mesh
 from .sharded_compact import sharded_compact, sharded_compact_block
 
-__all__ = ["make_mesh", "sharded_compact", "sharded_compact_block"]
+__all__ = ["init_multihost", "make_mesh", "sharded_compact", "sharded_compact_block"]

@@ -5,11 +5,36 @@ The only parallel axis a KV store's compaction needs is the hash-shard axis
 hash ranges per replica, reference src/base/pegasus_key_schema.h:178), so
 within one partition's compaction we shard records by key-hash across chips
 and exchange with a single all_to_all over ICI (SURVEY.md §5.7c/§5.8).
+
+Multi-HOST (the reference's NCCL/MPI-backend analogue, §5.8): the data
+plane needs no new code — `init_multihost()` joins this process into a
+jax.distributed job, after which `jax.devices()` spans every host's chips,
+`make_mesh()` builds a global mesh, and the same all_to_all lowers to ICI
+within a pod slice / DCN across slices. XLA owns the transport exactly
+where the reference hand-rolls collectives over NCCL. The control plane
+(RPC, replication, meta) is multi-host by construction — plain TCP.
 """
+
+import os
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+
+def init_multihost(coordinator: str = None, num_processes: int = None,
+                   process_id: int = None) -> bool:
+    """Join a multi-host jax.distributed job (idempotent; False = single
+    host). Args default from the standard env (PEGASUS_COORDINATOR /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID); a TPU-pod runtime that sets its
+    own cluster env needs no arguments at all."""
+    coordinator = coordinator or os.environ.get("PEGASUS_COORDINATOR")
+    if coordinator is None and num_processes is None:
+        return False  # single-host: nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes, process_id=process_id)
+    return True
 
 
 def make_mesh(n_devices: int = None, axis: str = "shard") -> Mesh:
