@@ -210,6 +210,8 @@ def _lane(backend, packed_in, concat, fargs, reps, dev_vals=None):
     from pegasus_tpu.ops.compact import (gather_device_survivors,
                                          materialize_device_survivors)
 
+    from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+
     best, out, split = float("inf"), None, {}
     for _ in range(reps + 1):
         t0 = time.perf_counter()
@@ -226,7 +228,8 @@ def _lane(backend, packed_in, concat, fargs, reps, dev_vals=None):
         else:
             surv = backend.survivors(packed_in, *fargs)
             t1 = time.perf_counter()
-            out = concat.gather(surv)
+            with COMPACT_TRACER.span("gather", records=len(surv)):
+                out = concat.gather(surv)
         total = time.perf_counter() - t0
         if total < best:
             best = total
@@ -316,14 +319,35 @@ def _compact_opts():
 def tpu_lane_main():
     """Child process: backend init (doubles as the probe — one process,
     one lease) + full TPU lane. Prints ONE json line with timings and the
-    output digest; the parent compares digests for byte equality."""
+    output digest; the parent compares digests for byte equality.
+
+    The device-health watchdog heartbeats to PEGASUS_BENCH_STATUS_FILE
+    (set by the parent) for the whole lane: if the tunnel wedges and the
+    parent has to abandon this child, the parent reads the heartbeat and
+    reports WHICH stage wedged (device_init / pack / h2d / device /
+    gather) instead of a bare timeout — the BENCH_r05 gap."""
+    from pegasus_tpu.ops.device_watchdog import WATCHDOG
+    from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+
+    WATCHDOG.status_path = os.environ.get("PEGASUS_BENCH_STATUS_FILE")
+    # heartbeat-only until the platform is up: a probe-thread jit racing
+    # our jax.config/platform init could bind the wrong backend, and a
+    # probe starved behind a healthy-but-slow backend init would report a
+    # false wedge. A wedge DURING init is still attributed — the heartbeat
+    # keeps writing open_stages, and the parent's fallback reads the open
+    # device_init span
+    WATCHDOG.probes_armed = False
+    WATCHDOG.start()
+
     n_total, n_runs, value_size, reps = _bench_params()
     t_init = time.perf_counter()
-    _enable_compile_cache()
-    import jax
+    with COMPACT_TRACER.span("device_init"):
+        _enable_compile_cache()
+        import jax
 
-    platform = str(jax.devices()[0])
+        platform = str(jax.devices()[0])
     init_s = time.perf_counter() - t_init
+    WATCHDOG.probes_armed = True  # platform bound: liveness probes are safe
     print(f"tpu-lane: backend up in {init_s:.1f}s ({platform})",
           file=sys.stderr, flush=True)
 
@@ -332,15 +356,16 @@ def tpu_lane_main():
 
     runs, fill_s = _fill(n_total, n_runs, value_size)
     opts, fargs = _compact_opts()
-    packed = pack_runs(runs, opts, need_sbytes=False)
-    concat = KVBlock.concat(runs)
-    del runs
-    backend = TpuBackend()
-    prep = backend.prepare(packed)  # device residency: flush-time, untimed
-    tpu_s, out, split = _tpu_lanes(backend, prep, concat, fargs, reps)
+    with COMPACT_TRACER.session() as sess:
+        packed = pack_runs(runs, opts, need_sbytes=False)
+        concat = KVBlock.concat(runs)
+        del runs
+        backend = TpuBackend()
+        prep = backend.prepare(packed)  # device residency: flush-time, untimed
+        tpu_s, out, split = _tpu_lanes(backend, prep, concat, fargs, reps)
     result = {"ok": True, "tpu_s": tpu_s, "split": split,
               "platform": platform, "init_s": round(init_s, 1),
-              "fill_s": round(fill_s, 3)}
+              "fill_s": round(fill_s, 3), "trace": sess.summary()}
     result.update(_out_digest(out))
     print(json.dumps(result), flush=True)
 
@@ -350,10 +375,25 @@ def _run_tpu_lane_child(lane_timeout_s: float):
 
     Child stdout/stderr go to temp FILES: if the child wedges in backend
     init it gets abandoned, and an abandoned child holding an inherited
-    pipe would block the driver's output capture after the parent exits."""
+    pipe would block the driver's output capture after the parent exits.
+    The child's watchdog heartbeats its stage/liveness state to a status
+    FILE the parent reads on timeout — a wedged lane reports the stage it
+    wedged at (stored in _LANE_STATE['wedge_status'] for the degraded
+    detail) instead of only the generic message."""
     fake = os.environ.get("PEGASUS_BENCH_FAKE_LANE")
+    status_f = tempfile.NamedTemporaryFile(prefix="bench_lane_",
+                                           suffix=".status", delete=False)
+    status_f.close()
+    child_env = dict(os.environ, PEGASUS_BENCH_STATUS_FILE=status_f.name)
     if fake == "sleep":  # test hook: simulates a post-probe tunnel wedge
         cmd = [sys.executable, "-c", "import time; time.sleep(3600)"]
+    elif fake == "wedge":  # test hook: a wedge AFTER the watchdog captured
+        # the stage — exercises the parent's status-file read path
+        cmd = [sys.executable, "-c",
+               "import json, os, time; json.dump("
+               "{'wedged_at_stage': 'device', 'last_ok': time.time()},"
+               " open(os.environ['PEGASUS_BENCH_STATUS_FILE'], 'w'));"
+               " time.sleep(3600)"]
     elif fake == "crash":  # test hook: simulates backend-init death
         cmd = [sys.executable, "-c",
                "import sys; print('boom', file=sys.stderr); sys.exit(7)"]
@@ -366,9 +406,9 @@ def _run_tpu_lane_child(lane_timeout_s: float):
     with out_f, err_f:
         proc = subprocess.Popen(
             cmd, stdout=out_f, stderr=err_f, stdin=subprocess.DEVNULL,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=child_env)
         _LANE_STATE["proc"] = proc
-        _LANE_STATE["files"] = [out_f.name, err_f.name]
+        _LANE_STATE["files"] = [out_f.name, err_f.name, status_f.name]
         abandoned = timed_out = False
         try:
             proc.wait(timeout=lane_timeout_s)
@@ -385,7 +425,14 @@ def _run_tpu_lane_child(lane_timeout_s: float):
         err_tail = " | ".join(f.read().strip().splitlines()[-3:])[-400:]
     with open(out_f.name, "r", errors="replace") as f:
         stdout = f.read()
-    for name in (out_f.name, err_f.name):
+    status = None
+    try:
+        with open(status_f.name, "r") as f:
+            status = json.loads(f.read() or "null")
+    except (OSError, ValueError):
+        pass
+    _LANE_STATE["wedge_status"] = status
+    for name in (out_f.name, err_f.name, status_f.name):
         try:
             os.unlink(name)
         except OSError:
@@ -402,8 +449,15 @@ def _run_tpu_lane_child(lane_timeout_s: float):
     if timed_out:
         how = ("ignored SIGTERM; child abandoned"
                if abandoned or proc.returncode is None else "terminated")
+        where = ""
+        if status and status.get("wedged_at_stage"):
+            where = f"; wedged at stage: {status['wedged_at_stage']}"
+        elif status and status.get("open_stages"):
+            open_all = [s for st in status["open_stages"].values() for s in st]
+            if open_all:
+                where = f"; last open stage: {open_all[-1]}"
         return None, (f"tpu lane exceeded {lane_timeout_s:.0f}s (device "
-                      f"tunnel wedged mid-init or mid-run); {how}")
+                      f"tunnel wedged mid-init or mid-run){where}; {how}")
     if proc.returncode != 0:
         return None, (f"tpu lane died rc={proc.returncode}: {err_tail}")
     return None, "tpu lane exited 0 but produced no result line: " + err_tail
@@ -453,12 +507,19 @@ def main():
     from pegasus_tpu.engine.block import KVBlock
     from pegasus_tpu.ops.compact import CpuBackend, TpuBackend, pack_runs
 
+    from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+
     runs, fill_s = _fill(n_total, n_runs, value_size)
     opts, fargs = _compact_opts()
-    packed = pack_runs(runs, opts, need_sbytes=True)
-    concat = KVBlock.concat(runs)
-    n_in = sum(packed.lens)
-    cpu_s, cpu_out, cpu_split = _lane(CpuBackend(), packed, concat, fargs, reps)
+    # the session turns the instrumented pipeline spans (pack / device /
+    # gather) into the per-stage `trace` breakdown of the JSON detail —
+    # summed over all reps (see `calls`), present even on degraded lines
+    with COMPACT_TRACER.session() as cpu_sess:
+        packed = pack_runs(runs, opts, need_sbytes=True)
+        concat = KVBlock.concat(runs)
+        n_in = sum(packed.lens)
+        cpu_s, cpu_out, cpu_split = _lane(CpuBackend(), packed, concat,
+                                          fargs, reps)
     cpu_digest = _out_digest(cpu_out)
     global _CPU_DETAIL
     cpu_detail = _CPU_DETAIL = {
@@ -468,6 +529,7 @@ def main():
         "cpu_records_per_s": int(n_in / cpu_s),
         "input_records": n_in,
         "output_records": cpu_digest["n_out"],
+        "trace": cpu_sess.summary(),
     }
 
     # 2) TPU lane
@@ -496,8 +558,12 @@ def main():
     if lane_result is None:
         print(f"bench: TPU lane unavailable ({reason}); reporting the cpu "
               "lane as a degraded result.", file=sys.stderr, flush=True)
-        _emit(_degraded(n_total, n_runs, value_size, reason,
-                        detail=cpu_detail))
+        detail = dict(cpu_detail)
+        if _LANE_STATE.get("wedge_status"):
+            # the abandoned child's last heartbeat: stage attribution for
+            # the wedge (last_ok / wedged_at_stage / open stages)
+            detail["watchdog"] = _LANE_STATE["wedge_status"]
+        _emit(_degraded(n_total, n_runs, value_size, reason, detail=detail))
         return
 
     assert lane_result["n_out"] == cpu_digest["n_out"], \

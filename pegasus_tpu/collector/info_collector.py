@@ -30,6 +30,8 @@ class InfoCollector:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self.hotspots = {}   # app_name -> [pidx...] flagged last round
         self.app_stats = {}  # app_name -> aggregated dict
+        self.compact_stats = {}  # cluster-summed compact.*/engine.* counters
+        self._cluster_published = set()  # gauge names set last round
 
     def start(self):
         self._thread.start()
@@ -69,10 +71,37 @@ class InfoCollector:
         out = codec.decode(RemoteCommandResponse, body)
         return json.loads(out.output)
 
+    def collect_compact_stats(self, nodes) -> dict:
+        """Sum every node's compaction-pipeline telemetry (compact.* stage
+        spans + watchdog, engine.* flush/compaction/sst-write counters —
+        runtime/tracing.py naming) and republish the cluster totals as
+        `collector.cluster.*`, so one scrape of the collector answers
+        'where is compaction time going cluster-wide'."""
+        agg = {}
+        for node in sorted(nodes):
+            for prefix in ("compact.", "engine."):
+                try:
+                    snap = self.scrape_node(node, prefix=prefix)
+                except (RpcError, OSError, ValueError):
+                    continue
+                for name, v in snap.items():
+                    agg[name] = agg.get(name, 0.0) + float(v)
+        for name, v in agg.items():
+            counters.number(f"collector.cluster.{name}").set(v)
+        # a counter that stops being reported (node restarted, scrape
+        # failing) must not freeze at its last sum — a stale
+        # collector.cluster.compact.watchdog.wedged=1 would page forever
+        for name in self._cluster_published - set(agg):
+            counters.number(f"collector.cluster.{name}").set(0.0)
+        self._cluster_published = set(agg)
+        self.compact_stats = agg
+        return agg
+
     def collect_once(self) -> dict:
         apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
                                mm.ListAppsResponse).apps
         summary = {}
+        all_nodes = set()
         for app in apps:
             cfg = self._meta_call(RPC_CM_QUERY_CONFIG,
                                   mm.QueryConfigRequest(app.app_name),
@@ -86,6 +115,7 @@ class InfoCollector:
                    "recent_write_throttling_delay_count": 0.0,
                    "recent_write_throttling_reject_count": 0.0}
             nodes = {pc.primary for pc in cfg.partitions if pc.primary}
+            all_nodes |= nodes
             for node in nodes:
                 try:
                     snap = self.scrape_node(node, prefix=f"app.{app.app_id}.")
@@ -105,6 +135,7 @@ class InfoCollector:
                 counters.number(f"collector.app.{app.app_name}.{cname}").set(v)
             self.hotspots[app.app_name] = hotspot_partitions(per_partition_qps)
             summary[app.app_name] = agg
+        self.collect_compact_stats(all_nodes)
         self.app_stats = summary
         return summary
 

@@ -633,6 +633,8 @@ class LsmEngine:
                        target_level: int = None) -> dict:
         """Full compaction: everything merged into one run at target_level
         (default: the bottommost configured level)."""
+        from ..runtime.tracing import COMPACT_TRACER
+
         self.flush()
         tl = target_level or self.opts.max_levels
         stats = {"input_records": 0, "output_records": 0, "dropped": 0}
@@ -645,10 +647,16 @@ class LsmEngine:
                 older = list(self._levels.get(tl, []))
             if newer or older:
                 # inputs stay visible to readers until _merge_to_level swaps
-                # the output in; a failed merge leaves the levels untouched
-                stats = self._merge_to_level(newer, older, target_level=tl,
-                                             bottommost=bottommost, now=now,
-                                             sharded=True)
+                # the output in; a failed merge leaves the levels untouched.
+                # The session records the per-stage breakdown (pack / h2d /
+                # device / gather / sst_write) into the stats the manual-
+                # compact service and shell report.
+                with COMPACT_TRACER.session() as sess:
+                    stats = self._merge_to_level(newer, older,
+                                                 target_level=tl,
+                                                 bottommost=bottommost,
+                                                 now=now, sharded=True)
+                stats = dict(stats, trace=sess.summary())
         self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
         with self._lock:
             self._write_manifest_locked()

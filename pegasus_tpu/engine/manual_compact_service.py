@@ -69,6 +69,7 @@ class ManualCompactService:
         self._last_finish_ms = int(server.engine.meta_store.get(
             "pegasus_last_manual_compact_finish_time", 0)) * 1000
         self._last_used_ms = 0
+        self._last_trace = None  # per-stage breakdown of the last run
 
     # ------------------------------------------------------------------ time
 
@@ -154,13 +155,30 @@ class ManualCompactService:
             self._state = _RUNNING
             self._start_ms = self.now_ms()
         counters.rate("manual_compact.running_count").increment()
+        # device-backed compactions get a liveness probe BEFORE the merge
+        # (a wedged tunnel should be attributed to pre-existing device
+        # state, not to the compaction) and AFTER it (refresh last_ok /
+        # catch an in-run wedge the moment the merge returns or raises)
+        is_device = getattr(self.server.engine.opts, "backend",
+                            "cpu") != "cpu"
+        if is_device:
+            # start() arms the background probe loop (idempotent): a merge
+            # that WEDGES never returns, so only a re-probing loop can
+            # accumulate the consecutive failures that flip
+            # wedged_at_stage while query_compact_state reports 'running'
+            self._watchdog().start()
+            self._watchdog().probe()
         try:
-            self.server.engine.manual_compact(
+            stats = self.server.engine.manual_compact(
                 bottommost=opts["bottommost"],
                 target_level=opts["target_level"],
                 now=self._mock_now,
             )
+            with self._lock:
+                self._last_trace = stats.get("trace")
         finally:
+            if is_device:
+                self._watchdog().probe()
             finish = self.now_ms()
             with self._lock:
                 self._last_used_ms = finish - self._start_ms
@@ -169,20 +187,40 @@ class ManualCompactService:
             self.server.engine.meta_store[
                 "pegasus_last_manual_compact_finish_time"] = finish // 1000
 
+    @staticmethod
+    def _watchdog():
+        from ..ops.device_watchdog import WATCHDOG
+
+        return WATCHDOG
+
     # ----------------------------------------------------------------- state
 
     def query_compact_state(self) -> str:
-        """Human string like the reference's query_compact_state."""
+        """Human string like the reference's query_compact_state — plus the
+        watchdog's wedge attribution, so a stuck compaction reports WHERE
+        it wedged instead of just 'running' forever."""
         with self._lock:
             if self._state == _RUNNING:
-                return (f"running; started at {self._start_ms} "
-                        f"(queued at {self._enqueue_ms})")
-            if self._state == _QUEUED:
-                return f"queued at {self._enqueue_ms}"
-            if self._last_finish_ms:
-                return (f"idle; last finish at {self._last_finish_ms}, "
-                        f"used {self._last_used_ms} ms")
-            return "idle; never compacted"
+                out = (f"running; started at {self._start_ms} "
+                       f"(queued at {self._enqueue_ms})")
+            elif self._state == _QUEUED:
+                out = f"queued at {self._enqueue_ms}"
+            elif self._last_finish_ms:
+                out = (f"idle; last finish at {self._last_finish_ms}, "
+                       f"used {self._last_used_ms} ms")
+            else:
+                out = "idle; never compacted"
+        wedged = self._watchdog().wedged_at_stage
+        if wedged is not None:
+            out += f"; device wedged at stage {wedged}"
+        return out
+
+    @property
+    def last_trace(self):
+        """Per-stage breakdown (tracing.TraceSession.summary) of the last
+        completed manual compaction, or None."""
+        with self._lock:
+            return self._last_trace
 
     @property
     def last_finish_time_ms(self) -> int:

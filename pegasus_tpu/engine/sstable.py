@@ -63,6 +63,24 @@ def write_sst(path: str, block: KVBlock, meta: dict = None,
     compression="zlib" deflates each section (the per-table rocksdb
     compression knob, reference value-compression options); readers
     auto-detect from the header, so tables can mix files."""
+    import time as _time
+
+    from ..runtime.perf_counters import counters
+    from ..runtime.tracing import COMPACT_TRACER
+
+    t0 = _time.perf_counter()
+    nbytes = block.key_bytes_total + block.val_bytes_total
+    with COMPACT_TRACER.span("sst_write", records=block.n, nbytes=nbytes):
+        header = _write_sst_impl(path, block, meta, compression)
+    counters.rate("engine.sst_write_count").increment()
+    counters.rate("engine.sst_write_bytes").increment(nbytes)
+    counters.percentile("engine.sst_write_s").set(
+        round(_time.perf_counter() - t0, 6))
+    return header
+
+
+def _write_sst_impl(path: str, block: KVBlock, meta: dict,
+                    compression: str) -> dict:
     import zlib
 
     sections = {}

@@ -135,13 +135,18 @@ class MetaElection:
 
     def _tick(self):
         holder, age, epoch = self._read()
-        if holder == self.my_addr:
+        if holder == self.my_addr and age <= self.lease:
             self.epoch = max(self.epoch, epoch)
             self._refresh()
             # re-read: our refresh and a racer's takeover can interleave
             holder, _, _ = self._read()
             self._set_leader(holder == self.my_addr)
         elif holder is None or age > self.lease:
+            # holder == us with an EXPIRED lease (a stall outlived our own
+            # lease) takes this branch too: resuming with a plain refresh
+            # would keep the OLD epoch and could clobber a concurrent
+            # claimant's epoch+1 lease inside the settle window (ADVICE
+            # r5) — re-claim like anyone else, with a bumped epoch
             self._try_claim(lease_epoch=epoch)
         else:
             self._set_leader(False)

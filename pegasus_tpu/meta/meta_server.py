@@ -95,6 +95,10 @@ class MetaServer:
         self._next_app_id = 1
         self._next_dupid = 1
         self._state_epoch = 0    # epoch the loaded state file was written under
+        self._state_fp = None    # (ino, mtime_ns, size) of the state file as
+                                 # last read/written by THIS process — guards
+                                 # the cached epoch (ADVICE r5: no full json
+                                 # re-parse per acked DDL)
         self.pool = ConnectionPool()
         self._load()
 
@@ -1343,7 +1347,7 @@ class MetaServer:
                 print(f"[meta] {self.election.my_addr}: persist fenced — "
                       "lease lost", flush=True)
                 raise RuntimeError("meta persist fenced: lease lost")
-            disk_epoch = self._read_state_epoch()
+            disk_epoch = self._disk_state_epoch_locked()
             if disk_epoch > self.election.epoch:
                 print(f"[meta] {self.election.my_addr}: persist fenced — "
                       f"state epoch {disk_epoch} > lease epoch "
@@ -1375,7 +1379,35 @@ class MetaServer:
         os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(state, f)
+            f.flush()
+            st = os.fstat(f.fileno())
         os.replace(tmp, self.state_path)
+        self._state_epoch = int(state["epoch"])
+        # fingerprint from the fd we WROTE, never a path re-stat: a racer's
+        # replace landing between our os.replace and a stat would get
+        # fingerprinted with OUR cached epoch and permanently disarm the
+        # persist fence (rename keeps tmp's inode, so fstat matches the
+        # file now at state_path — unless someone else already replaced it,
+        # which is exactly the case that must MISS the cache)
+        self._state_fp = (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def _disk_state_epoch_locked(self) -> int:
+        """The on-disk state epoch for the persist fence, WITHOUT re-parsing
+        the whole state file on every acked DDL (ADVICE r5: that parse is
+        O(state size) per persist). The cached epoch is valid as long as the
+        file's stat fingerprint still matches what this process last
+        read/wrote; any external write (a newer leader's persist, a manual
+        edit) changes inode/mtime/size and forces one full re-read — so the
+        epoch fence still catches exactly the writes it existed for."""
+        try:
+            st = os.stat(self.state_path)
+            fp = (st.st_ino, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return 0
+        if fp != self._state_fp:
+            self._state_epoch = self._read_state_epoch()
+            self._state_fp = fp
+        return self._state_epoch
 
     def _read_state_epoch(self) -> int:
         try:
@@ -1389,7 +1421,9 @@ class MetaServer:
             return
         with open(self.state_path) as f:
             state = json.load(f)
+            st = os.fstat(f.fileno())  # the file we READ, race-free
         self._state_epoch = int(state.get("epoch", 0))
+        self._state_fp = (st.st_ino, st.st_mtime_ns, st.st_size)
         self._next_app_id = state["next_app_id"]
         self._next_dupid = state.get("next_dupid", 1)
         self._apps = {n: mm.AppInfo(**a) for n, a in state["apps"].items()}

@@ -332,7 +332,13 @@ static int rd_varint(Rd *r, unsigned __int128 *out)
         if (!(b & 0x80))
             break;
         shift += 7;
-        if (shift > 126) {
+        if (shift > 63) {
+            /* 10 bytes (shifts 0..63) cover every value the encoder can
+             * emit for [-2^63, 2^64); a longer varint is corrupt input,
+             * and continuing would shift continuation bits off the
+             * 128-bit accumulator into a silently-wrong small value.
+             * Matching the Python decoder's 10-byte bound, both paths
+             * raise on the same malformed frames. */
             RAISE("varint overflow");
             return -1;
         }

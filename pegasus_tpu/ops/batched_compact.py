@@ -25,6 +25,7 @@ import functools
 
 import numpy as np
 
+from ..runtime.tracing import COMPACT_TRACER as _TRACE
 from .compact import (CompactOptions, _make_cached_fn, apply_post_filters,
                       gather_device_survivors)
 
@@ -136,26 +137,31 @@ def _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts=None):
 
     padded_lens, run_ws, w = sig
     fn = _compiled_batched_pipeline(padded_lens, run_ws, w)
-    cached, aux, real_lens, pidx_arr = _stack_group(
-        [(jobs[j][1], jobs[j][2]) for j in idxs])
-    if mesh is not None and len(idxs) % mesh.size == 0:
-        from jax.sharding import NamedSharding, PartitionSpec
+    # "h2d" here is HBM-to-HBM batch stacking (+ the dp re-placement): the
+    # PCIe upload already happened when the DeviceRuns were born
+    with _TRACE.span("h2d", records=len(idxs) * sum(padded_lens)):
+        cached, aux, real_lens, pidx_arr = _stack_group(
+            [(jobs[j][1], jobs[j][2]) for j in idxs])
+        if mesh is not None and len(idxs) % mesh.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-        axis = mesh.axis_names[0]
+            axis = mesh.axis_names[0]
 
-        def shard_batch(x):
-            spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
-            return jax.device_put(x, NamedSharding(mesh, spec))
+            def shard_batch(x):
+                spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+                return jax.device_put(x, NamedSharding(mesh, spec))
 
-        cached = jax.tree_util.tree_map(shard_batch, cached)
-        aux = jax.tree_util.tree_map(shard_batch, aux)
-        real_lens = shard_batch(real_lens)
-        pidx_arr = shard_batch(pidx_arr)
-    out_idx, counts = fn(cached, aux, real_lens, jnp.uint32(now),
-                         pidx_arr, jnp.uint32(opts.partition_mask),
-                         jnp.asarray(bool(opts.bottommost)),
-                         jnp.asarray(bool(opts.filter)))
-    counts = np.asarray(counts)
+            cached = jax.tree_util.tree_map(shard_batch, cached)
+            aux = jax.tree_util.tree_map(shard_batch, aux)
+            real_lens = shard_batch(real_lens)
+            pidx_arr = shard_batch(pidx_arr)
+    # np.asarray(counts) syncs on the whole batched dispatch
+    with _TRACE.span("device", records=len(idxs) * sum(padded_lens)):
+        out_idx, counts = fn(cached, aux, real_lens, jnp.uint32(now),
+                             pidx_arr, jnp.uint32(opts.partition_mask),
+                             jnp.asarray(bool(opts.bottommost)),
+                             jnp.asarray(bool(opts.filter)))
+        counts = np.asarray(counts)
     for row, j in enumerate(idxs):
         runs = jobs[j][0]
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)

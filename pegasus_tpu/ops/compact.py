@@ -39,6 +39,7 @@ import numpy as np
 
 from ..base.utils import epoch_now
 from ..engine.block import KVBlock
+from ..runtime.tracing import COMPACT_TRACER as _TRACE
 from .packing import DEFAULT_PREFIX_U32, compute_suffix_ranks, pack_key_prefixes, pack_sbytes
 
 _U32_MAX = np.uint32(0xFFFFFFFF)
@@ -128,6 +129,13 @@ class PackedRuns:
 
 
 def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
+    with _TRACE.span("pack", records=sum(b.n for b in runs),
+                     nbytes=sum(b.key_bytes_total + b.val_bytes_total
+                                for b in runs)):
+        return _pack_runs_impl(runs, opts, need_sbytes)
+
+
+def _pack_runs_impl(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
     max_klen = max(int(b.key_len.max()) for b in runs)
     if max_klen >= 1 << 24:
         raise ValueError("keys >= 16MiB unsupported")
@@ -219,6 +227,14 @@ class CpuBackend:
 
     def survivors(self, packed: PackedRuns, now, pidx, pmask, bottommost,
                   do_filter) -> np.ndarray:
+        # "device" = the merge+dedup+filter stage on whichever backend runs
+        # it — same stage name as the tpu path so traces compare 1:1
+        with _TRACE.span("device", records=sum(packed.lens)):
+            return self._survivors(packed, now, pidx, pmask, bottommost,
+                                   do_filter)
+
+    def _survivors(self, packed: PackedRuns, now, pidx, pmask, bottommost,
+                   do_filter) -> np.ndarray:
         K = len(packed.lens)
         if K == 1:
             merged_sb, merged_gidx = packed.sbytes[0], packed.gidx[0]
@@ -369,11 +385,14 @@ class TpuBackend:
         cached = tuple(tuple(r.cols) + (r.klen,) for r in device_runs)
         aux = tuple((r.expire, r.deleted, r.hash32) for r in device_runs)
         real_lens = jnp.asarray([r.n for r in device_runs], jnp.int32)
-        out = fn(cached, aux, real_lens,
-                 jnp.uint32(now), jnp.uint32(pidx),
-                 jnp.uint32(pmask), jnp.asarray(bool(bottommost)),
-                 jnp.asarray(bool(do_filter)))
-        return (*out[:-1], int(out[-1]))
+        # the int(count) below syncs on the kernel, so the span's wall time
+        # covers dispatch + device execution
+        with _TRACE.span("device", records=sum(r.n for r in device_runs)):
+            out = fn(cached, aux, real_lens,
+                     jnp.uint32(now), jnp.uint32(pidx),
+                     jnp.uint32(pmask), jnp.asarray(bool(bottommost)),
+                     jnp.asarray(bool(do_filter)))
+            return (*out[:-1], int(out[-1]))
 
     def survivors_cached(self, device_runs, now, pidx, pmask, bottommost,
                          do_filter) -> np.ndarray:
@@ -382,6 +401,14 @@ class TpuBackend:
         return np.asarray(out_idx[:count])
 
     def prepare(self, packed: PackedRuns) -> DevicePacked:
+        with _TRACE.span("h2d", records=sum(packed.lens)) as sp:
+            prep = self._prepare(packed)
+            sp["bytes"] = sum(
+                sum(int(a.size) * a.dtype.itemsize for a in rc)
+                for rc in prep.run_cols)
+            return prep
+
+    def _prepare(self, packed: PackedRuns) -> DevicePacked:
         import jax.numpy as jnp
 
         padded_lens = tuple(_pow2ceil(n, _MIN_BUCKET) for n in packed.lens)
@@ -413,12 +440,14 @@ class TpuBackend:
 
         prep = packed if isinstance(packed, DevicePacked) else self.prepare(packed)
         fn = _compiled_pipeline(prep.padded_lens, prep.w, prep.has_rank)
-        out_idx, count = fn(
-            prep.run_cols, prep.aux,
-            jnp.uint32(now), jnp.uint32(pidx), jnp.uint32(pmask),
-            jnp.asarray(bool(bottommost)), jnp.asarray(bool(do_filter)),
-        )
-        return out_idx, int(count)
+        # int(count) syncs on the kernel: the span covers dispatch + device
+        with _TRACE.span("device", records=sum(prep.padded_lens)):
+            out_idx, count = fn(
+                prep.run_cols, prep.aux,
+                jnp.uint32(now), jnp.uint32(pidx), jnp.uint32(pmask),
+                jnp.asarray(bool(bottommost)), jnp.asarray(bool(do_filter)),
+            )
+            return out_idx, int(count)
 
     def survivors(self, packed, now, pidx, pmask, bottommost,
                   do_filter) -> np.ndarray:
@@ -475,6 +504,14 @@ def _finish_overlapped(concat: KVBlock, out_dev, real_idx, count: int,
     """Shared tail of both value-residency materializers: start the value
     download, gather keys+aux on the host while it is in flight (native
     fused loop, numpy fallback), assemble the uniform output block."""
+    with _TRACE.span("gather", records=count,
+                     nbytes=count * (kl0 + vl0)):
+        return _finish_overlapped_impl(concat, out_dev, real_idx, count,
+                                       kl0, vl0)
+
+
+def _finish_overlapped_impl(concat: KVBlock, out_dev, real_idx, count: int,
+                            kl0: int, vl0: int) -> KVBlock:
     try:
         out_dev.copy_to_host_async()
     except AttributeError:
@@ -544,6 +581,12 @@ def gather_device_survivors(concat: KVBlock, dev_idx, count: int,
     anything else falls back to the one-shot download + gather."""
     if count == 0:
         return KVBlock.empty()
+    with _TRACE.span("gather", records=count):
+        return _gather_device_survivors_impl(concat, dev_idx, count, chunks)
+
+
+def _gather_device_survivors_impl(concat: KVBlock, dev_idx, count: int,
+                                  chunks: int) -> KVBlock:
     n = concat.n
     uni = concat.uniform_layout() if (count >= (1 << 16) and chunks > 1
                                       and n < (1 << 31)) else None
@@ -835,6 +878,15 @@ def compact_blocks(blocks, opts: CompactOptions,
     merge consumes HBM-resident columns directly — no host packing, no
     re-upload (the engine's device-resident run cache, VERDICT-r2 item 4).
     """
+    with _TRACE.span("compact",
+                     records=sum(b.n for b in blocks)) as sp:
+        result = _compact_blocks_impl(blocks, opts, device_runs)
+        sp["records"] = result.stats.get("input_records", sp["records"])
+        return result
+
+
+def _compact_blocks_impl(blocks, opts: CompactOptions,
+                         device_runs=None) -> CompactResult:
     if device_runs is not None:
         device_runs = [d for b, d in zip(blocks, device_runs) if b.n]
     runs = [b for b in blocks if b.n]
@@ -895,7 +947,8 @@ def compact_blocks(blocks, opts: CompactOptions,
         packed = pack_runs(runs, opts, need_sbytes=True)
         survivors = backend.survivors(packed, *fargs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        out = concat.gather(survivors)
+        with _TRACE.span("gather", records=len(survivors)):
+            out = concat.gather(survivors)
     out = apply_post_filters(out, opts, now)
     # stats count RAW input rows (pre any pack-time intra-run dedup) so
     # every path — cpu, device, cached, sharded, blockwise — reports the

@@ -22,18 +22,32 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+_joined = False  # idempotence: jax.distributed.initialize rejects a re-init
+
+
 def init_multihost(coordinator: str = None, num_processes: int = None,
                    process_id: int = None) -> bool:
     """Join a multi-host jax.distributed job (idempotent; False = single
     host). Args default from the standard env (PEGASUS_COORDINATOR /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID); a TPU-pod runtime that sets its
-    own cluster env needs no arguments at all."""
+    own cluster env needs no arguments at all. Invoked automatically by
+    service startup (runtime.service_app) when that env is present."""
+    global _joined
     coordinator = coordinator or os.environ.get("PEGASUS_COORDINATOR")
+    if num_processes is None:
+        env_np = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env_np) if env_np else None
+    if process_id is None:
+        env_pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env_pid) if env_pid else None
     if coordinator is None and num_processes is None:
         return False  # single-host: nothing to join
+    if _joined:
+        return True
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes, process_id=process_id)
+    _joined = True
     return True
 
 

@@ -28,6 +28,7 @@ from ..ops.compact import (CompactOptions, CompactResult, _apply_default_ttl,
                            _pow2ceil, _stats, apply_post_filters, merge_body,
                            sort_block)
 from ..ops.packing import compute_suffix_ranks, pack_key_prefixes
+from ..runtime.tracing import COMPACT_TRACER as _TRACE
 
 
 def _next_bucket(n: int) -> int:
@@ -139,22 +140,24 @@ def sharded_compact(blocks, mesh, opts: CompactOptions, axis: str = "shard",
     n_loc = _next_bucket(-(-n // nsh))
     n_pad = n_loc * nsh
 
-    prefixes = pack_key_prefixes(block.key_arena, block.key_off, block.key_len, w)
-    rank = compute_suffix_ranks(block, w, prefixes)
+    with _TRACE.span("pack", records=n):
+        prefixes = pack_key_prefixes(block.key_arena, block.key_off,
+                                     block.key_len, w)
+        rank = compute_suffix_ranks(block, w, prefixes)
 
-    def pad(a, fill=0):
-        out = np.full(n_pad, fill, dtype=a.dtype)
-        out[:n] = a
-        return out
+        def pad(a, fill=0):
+            out = np.full(n_pad, fill, dtype=a.dtype)
+            out[:n] = a
+            return out
 
-    cols = np.zeros((w, n_pad), np.uint32)
-    cols[:, :n] = prefixes.T
-    args = (
-        pad(rank), pad(block.key_len.astype(np.uint32)), pad(prio),
-        pad(block.expire_ts), pad(block.deleted), pad(block.hash32),
-        pad(np.ones(n, dtype=bool), False),
-        pad(np.arange(n, dtype=np.int32), -1),
-    )
+        cols = np.zeros((w, n_pad), np.uint32)
+        cols[:, :n] = prefixes.T
+        args = (
+            pad(rank), pad(block.key_len.astype(np.uint32)), pad(prio),
+            pad(block.expire_ts), pad(block.deleted), pad(block.hash32),
+            pad(np.ones(n, dtype=bool), False),
+            pad(np.arange(n, dtype=np.int32), -1),
+        )
     now = opts.resolved_now()
     scalars = (jnp.uint32(now), jnp.uint32(opts.pidx), jnp.uint32(opts.partition_mask),
                jnp.asarray(bool(opts.bottommost)), jnp.asarray(bool(opts.filter)))
@@ -169,11 +172,14 @@ def sharded_compact(blocks, mesh, opts: CompactOptions, axis: str = "shard",
         return p
 
     cap = min(n_loc, max(8, pow2ceil(int(n_loc / nsh * capacity_factor))))
+    # the kernel span covers upload + all_to_all + merge + download (the
+    # np.asarray calls sync); a capacity-overflow retry re-enters the span
     while True:
-        fn = _sharded_kernel(mesh_key, w, n_loc, cap, axis)
-        gid_sorted, keep, overflow = fn(cols, *args, *scalars)
-        gid_sorted = np.asarray(gid_sorted)
-        keep = np.asarray(keep)
+        with _TRACE.span("device", records=n):
+            fn = _sharded_kernel(mesh_key, w, n_loc, cap, axis)
+            gid_sorted, keep, overflow = fn(cols, *args, *scalars)
+            gid_sorted = np.asarray(gid_sorted)
+            keep = np.asarray(keep)
         if int(np.asarray(overflow).sum()) == 0:
             break
         if cap >= n_loc:  # can't happen: full capacity admits every row
@@ -183,15 +189,17 @@ def sharded_compact(blocks, mesh, opts: CompactOptions, axis: str = "shard",
     nrecv = nsh * cap
     shards = []
     out_total = 0
-    for s in range(nsh):
-        seg_ids = gid_sorted[s * nrecv : (s + 1) * nrecv]
-        seg_keep = keep[s * nrecv : (s + 1) * nrecv]
-        ids = seg_ids[seg_keep]
-        shard = block.gather(ids)
-        if opts.filter and opts.default_ttl > 0:
-            _apply_default_ttl(shard, now + opts.default_ttl)
-        out_total += shard.n
-        shards.append(shard)
+    with _TRACE.span("gather") as sp:
+        for s in range(nsh):
+            seg_ids = gid_sorted[s * nrecv : (s + 1) * nrecv]
+            seg_keep = keep[s * nrecv : (s + 1) * nrecv]
+            ids = seg_ids[seg_keep]
+            shard = block.gather(ids)
+            if opts.filter and opts.default_ttl > 0:
+                _apply_default_ttl(shard, now + opts.default_ttl)
+            out_total += shard.n
+            shards.append(shard)
+        sp["records"] = out_total
     return shards, {"input_records": n, "output_records": out_total,
                     "dropped": n - out_total, "n_shards": nsh, "capacity": cap}
 

@@ -54,12 +54,19 @@ def read_varint(buf, off: int):
         return b, off + 1
     shift = 0
     val = 0
+    end = off + 10  # the longest varint the encoder emits for [-2^63, 2^64)
     while True:
         b = buf[off]
         off += 1
         val |= (b & 0x7F) << shift
         if not b & 0x80:
             return val, off
+        if off >= end:
+            # corrupt frame: without the bound this would keep absorbing
+            # continuation bytes into an ever-growing int where the C
+            # decoder (native/fastcodec.c rd_varint) raises — both paths
+            # must reject the same malformed input
+            raise CodecError("varint overflow (longer than 10 bytes)")
         shift += 7
 
 

@@ -49,8 +49,25 @@ class RemoteCommandService:
         self.register("perf-counters-by-substr",
                       lambda a: self._dump_counters(
                           lambda n: any(p in n for p in a)))
+        self.register("compact-trace-dump", self._cmd_compact_trace_dump)
+        self.register("device-health", self._cmd_device_health)
         if describe is not None:
             self.register("describe", lambda a: json.dumps(describe(), indent=1))
+
+    @staticmethod
+    def _cmd_compact_trace_dump(args) -> str:
+        """compact-trace-dump [last] — recent compaction stage spans from
+        the process-wide ring buffer (runtime/tracing.py)."""
+        from .tracing import COMPACT_TRACER
+
+        return COMPACT_TRACER.dump(int(args[0]) if args else 100)
+
+    @staticmethod
+    def _cmd_device_health(args) -> str:
+        """device-health — the device watchdog's liveness/wedge state."""
+        from ..ops.device_watchdog import WATCHDOG
+
+        return json.dumps(WATCHDOG.state(), indent=1)
 
     def _cmd_server_stat(self, args) -> str:
         """One-line digest of selected counters (brief_stat.cpp role)."""

@@ -37,12 +37,31 @@ def register_app_factory(type_name: str, factory) -> None:
     _FACTORIES[type_name] = factory
 
 
+def _maybe_join_multihost() -> bool:
+    """ADVICE r5: the multi-host join hook (parallel.mesh.init_multihost)
+    existed but nothing invoked it. Service startup joins the
+    jax.distributed job whenever the standard env is present; env-free
+    processes never pay the jax import."""
+    if not (os.environ.get("PEGASUS_COORDINATOR")
+            or os.environ.get("JAX_NUM_PROCESSES")):
+        return False
+    try:
+        from ..parallel.mesh import init_multihost
+
+        return init_multihost()
+    except Exception as e:  # noqa: BLE001 - a failed join must not stop
+        # the control plane; the data plane degrades to single-host
+        print(f"[service-app] multi-host join failed: {e!r}", flush=True)
+        return False
+
+
 class ServiceAppContainer:
     def __init__(self, config: Config):
         self.config = config
         self.apps = {}
 
     def start(self, only: list = None) -> dict:
+        _maybe_join_multihost()
         for section in self.config.sections():
             if not section.startswith("apps."):
                 continue
@@ -79,6 +98,24 @@ def _version_info(kind: str) -> dict:
 
     return {"version": VERSION, "server_type": kind,
             "uptime_seconds": int(_time.time() - _START_TIME)}
+
+
+def _compact_trace_route(path: str) -> dict:
+    """GET /compact/trace[?last=N]: the compaction stage-span ring buffer
+    plus the device watchdog's liveness state — the JSON twin of the
+    `compact-trace-dump` remote command. (`/metrics` itself is served by
+    CounterReporter for every role; this is the structured-trace surface.)"""
+    from urllib.parse import parse_qs, urlparse
+
+    from ..ops.device_watchdog import WATCHDOG
+    from .tracing import COMPACT_TRACER
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        last = int((q.get("last") or ["100"])[0])
+    except ValueError:
+        last = 100
+    return {"watchdog": WATCHDOG.state(), "spans": COMPACT_TRACER.trace(last)}
 
 
 def _meta_http_routes(meta) -> dict:
@@ -118,7 +155,8 @@ def _meta_http_routes(meta) -> dict:
     return {"/version": lambda p: _version_info("meta"),
             "/meta/cluster_info": cluster_info,
             "/meta/apps": apps,
-            "/meta/app": app}
+            "/meta/app": app,
+            "/compact/trace": _compact_trace_route}
 
 
 def _replica_http_routes(stub) -> dict:
@@ -135,7 +173,8 @@ def _replica_http_routes(stub) -> dict:
                 for r in reps]
 
     return {"/version": lambda p: _version_info("replica"),
-            "/replica/info": info}
+            "/replica/info": info,
+            "/compact/trace": _compact_trace_route}
 
 
 # ---------------------------------------------------------- built-in apps
@@ -365,6 +404,7 @@ class CollectorApp:
                 "availability": self.detector.report(),
                 "hotspots": self.collector.hotspots,
                 "app_stats": self.collector.app_stats,
+                "compact_stats": self.collector.compact_stats,
             })
 
         self.commands.register("collector-info", info)
@@ -374,7 +414,9 @@ class CollectorApp:
         if http_port >= 0:
             from ..collector.reporter import CounterReporter
 
-            self.reporter = CounterReporter(port=http_port).start()
+            self.reporter = CounterReporter(
+                port=http_port,
+                routes={"/compact/trace": _compact_trace_route}).start()
 
     @property
     def address(self):

@@ -1,0 +1,184 @@
+"""Stage-span tracing for the compaction pipeline.
+
+The RPC layer already has a toollet tracer (runtime/toollets.py), but every
+bench wedge recorded so far (BENCH_r05: "tpu lane exceeded 360s (device
+tunnel wedged mid-init or mid-run)") happened BELOW the RPC layer, inside
+the compaction pipeline: device init, host pack, H2D upload, the sort/merge
+kernel, or the survivor gather. This module is the in-pipeline probe that
+LUDA/RESYSTANCE-style offload perf work needs before any kernel tuning is
+trustworthy: nestable stage spans with wall time, record and byte counts,
+
+  - ring-buffered like the RPC tracer: the recent spans dump through the
+    `compact-trace-dump` remote command and the `/compact/trace` HTTP
+    route (runtime/service_app.py);
+  - exported into the process-wide perf-counter registry under
+    `compact.stage.<name>.*` (rate counters for span/record/byte
+    throughput, a percentile counter for duration), so `/metrics`,
+    `perf-counters*`, and the collector all read ONE registry;
+  - visible while still OPEN (open_stages / innermost_open): the
+    device-health watchdog (ops/device_watchdog.py) reads the live span
+    stack to attribute a wedge to the exact stage that never returned.
+
+Stage names used by the pipeline: compact > pack / h2d / device / gather,
+plus sst_write at the engine write-out. Spans nest (depth is recorded);
+a stage entered recursively (blockwise range decomposition re-enters
+`compact`) shows up once per entry, so session sums for such stages count
+the nested time once per level — read `calls` alongside `s`.
+
+A TraceSession aggregates every span closed in its thread while active;
+bench.py and the manual-compact service record per-stage breakdowns from
+it (the `trace` detail in BENCH_*.json).
+"""
+
+import collections
+import threading
+import time
+from contextlib import contextmanager
+
+from .perf_counters import counters
+
+
+class TraceSession:
+    """Per-stage aggregate of the spans closed (in the owning thread)
+    while the session was active: stage -> {s, calls, records, bytes}."""
+
+    def __init__(self):
+        self.stages = {}
+        self.started_at = time.time()
+
+    def _add(self, stage: str, dur_s: float, records: int, nbytes: int):
+        agg = self.stages.setdefault(
+            stage, {"s": 0.0, "calls": 0, "records": 0, "bytes": 0})
+        agg["s"] += dur_s
+        agg["calls"] += 1
+        agg["records"] += records
+        agg["bytes"] += nbytes
+
+    def summary(self) -> dict:
+        """JSON-ready copy with rounded wall times (stage order = first
+        close order, which for a straight-line pipeline is stage order)."""
+        return {k: dict(v, s=round(v["s"], 6))
+                for k, v in self.stages.items()}
+
+
+class StageTracer:
+    def __init__(self, capacity: int = 4096, prefix: str = "compact"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._spans = collections.deque(maxlen=capacity)
+        self._local = threading.local()
+        # thread ident -> [(stage, started_wall_ts), ...] innermost LAST;
+        # shared (not thread-local) so the watchdog thread can read which
+        # stage another thread is currently stuck in
+        self._open = {}
+
+    # ----------------------------------------------------------- span API
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _session_list(self) -> list:
+        s = getattr(self._local, "sessions", None)
+        if s is None:
+            s = self._local.sessions = []
+        return s
+
+    @contextmanager
+    def span(self, stage: str, records: int = 0, nbytes: int = 0):
+        """Time one pipeline stage. Yields a mutable {records, bytes} box
+        so counts discovered mid-span (e.g. survivor count) can be added
+        before the span closes."""
+        stack = self._stack()
+        depth = len(stack)
+        stack.append(stage)
+        tid = threading.get_ident()
+        with self._lock:
+            self._open.setdefault(tid, []).append((stage, time.time()))
+        box = {"records": records, "bytes": nbytes}
+        t0 = time.perf_counter()
+        try:
+            yield box
+        finally:
+            dur_s = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                open_list = self._open.get(tid)
+                if open_list:
+                    open_list.pop()
+                    if not open_list:
+                        self._open.pop(tid, None)
+                self._spans.append((time.time(), depth, stage, dur_s,
+                                    box["records"], box["bytes"]))
+            self._export(stage, dur_s, box["records"], box["bytes"])
+            for sess in self._session_list():
+                sess._add(stage, dur_s, box["records"], box["bytes"])
+
+    def _export(self, stage, dur_s, records, nbytes):
+        base = f"{self.prefix}.stage.{stage}"
+        counters.rate(f"{base}.count").increment()
+        counters.percentile(f"{base}.duration_us").set(int(dur_s * 1e6))
+        if records:
+            counters.rate(f"{base}.records").increment(records)
+        if nbytes:
+            counters.rate(f"{base}.bytes").increment(nbytes)
+
+    @contextmanager
+    def session(self):
+        """Aggregate the spans this thread closes while the context is
+        active (sessions nest; each gets its own aggregate)."""
+        sess = TraceSession()
+        sessions = self._session_list()
+        sessions.append(sess)
+        try:
+            yield sess
+        finally:
+            sessions.remove(sess)
+
+    # ----------------------------------------------- live-state inspection
+
+    def open_stages(self) -> dict:
+        """thread ident -> [stage, ...] (outermost first) for every thread
+        with an open span — what the watchdog snapshots on a failed probe."""
+        with self._lock:
+            return {tid: [s for s, _ in st] for tid, st in self._open.items()}
+
+    def innermost_open(self):
+        """(stage, started_wall_ts) of the open span most likely wedged:
+        the innermost span of whichever stack has been sitting in its
+        innermost stage the LONGEST. None when nothing is open."""
+        best = None
+        with self._lock:
+            for st in self._open.values():
+                if not st:
+                    continue
+                stage, t0 = st[-1]
+                if best is None or t0 < best[1]:
+                    best = (stage, t0)
+        return best
+
+    # ------------------------------------------------------ ring-buffer IO
+
+    def trace(self, last: int = 100) -> list:
+        """The most recent closed spans as JSON-ready dicts (close order:
+        children close before their parents)."""
+        with self._lock:
+            spans = list(self._spans)[-last:]
+        return [{"ts": ts, "depth": depth, "stage": stage,
+                 "duration_us": int(dur_s * 1e6),
+                 "records": records, "bytes": nbytes}
+                for ts, depth, stage, dur_s, records, nbytes in spans]
+
+    def dump(self, last: int = 100) -> str:
+        rows = self.trace(last)
+        return "\n".join(
+            f"{r['ts']:.6f} {'  ' * r['depth']}{r['stage']} "
+            f"{r['duration_us']}us records={r['records']} bytes={r['bytes']}"
+            for r in rows) or "no spans"
+
+
+# process-wide tracer, like the global counter registry: every pipeline
+# layer (ops, engine, parallel, bench) threads spans through this instance
+COMPACT_TRACER = StageTracer()

@@ -80,6 +80,13 @@ class Shell:
             "server_stat": (self.cmd_server_stat, "server-stat on every node"),
             "perf_counters": (self.cmd_perf_counters,
                               "perf_counters <node> [prefix]"),
+            "compact_trace": (self.cmd_compact_trace,
+                              "compact_trace [node] [last] — recent "
+                              "compaction stage spans (pack/h2d/device/"
+                              "gather) from the tracing ring buffer"),
+            "device_health": (self.cmd_device_health,
+                              "device-health watchdog state on every node "
+                              "(last_ok / wedged_at_stage)"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
             "propose": (self.cmd_propose,
@@ -505,6 +512,16 @@ class Shell:
         node = args[0]
         cmd = "perf-counters-by-prefix" if len(args) > 1 else "perf-counters"
         self.p(self._node_command(node, cmd, args[1:]))
+
+    def cmd_compact_trace(self, args):
+        if args:
+            self.p(self._node_command(args[0], "compact-trace-dump",
+                                      args[1:]))
+        else:
+            self.cmd_remote_command(["all", "compact-trace-dump"])
+
+    def cmd_device_health(self, args):
+        self.cmd_remote_command(["all", "device-health"])
 
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]

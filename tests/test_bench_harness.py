@@ -183,3 +183,22 @@ def test_engine_bench_happy_cpu_only():
     assert proc.returncode == 0 and lines
     lane = json.loads(lines[0])
     assert lane["backend"] == "cpu" and lane["manual_compact_s"] > 0
+
+
+def test_lane_wedge_reports_stage_attribution():
+    """A wedged lane whose watchdog heartbeated before dying must be
+    attributed: the degraded reason names the stage (the BENCH_r05 gap —
+    no more bare '360s exceeded'), the watchdog heartbeat rides in the
+    detail, and the cpu lane's per-stage trace is present regardless."""
+    rc, line, _ = run_bench(
+        {"PEGASUS_BENCH_FAKE_LANE": "wedge", "PEGASUS_BENCH_LANE_S": "4"},
+        timeout_s=120)
+    assert rc == 0
+    assert line["value"] is None
+    d = line["detail"]
+    assert "wedged at stage: device" in d["reason"]
+    assert d["watchdog"]["wedged_at_stage"] == "device"
+    # acceptance: the cpu lane's trace breakdown is in the detail
+    for stage in ("pack", "device", "gather"):
+        assert stage in d["trace"], d["trace"]
+    assert d["trace"]["pack"]["records"] == 30_000

@@ -199,3 +199,27 @@ def test_list_of_c_unsupported_dataclass_matches_python():
     assert codec.decode(Holder, data) == empty
     with pytest.raises(codec.CodecError):
         codec.encode(Holder(items=[BadItem()]))  # non-empty: both raise
+
+
+def test_overlong_varint_rejected_by_both_decoders():
+    """ADVICE r5: rd_varint must bound varints at 10 bytes like the Python
+    decoder — a corrupt frame raises on BOTH paths instead of the C side
+    shifting continuation bits into a silently-wrong value."""
+
+    @dataclasses.dataclass
+    class OneInt:
+        v: int = 0
+
+    py_plan = codec._StructPlan(OneInt)
+    c_plan = codec._fast_plan(OneInt, fc)
+    # the longest legal varint: -2**63 zigzags to 2**64-1 (10 bytes)
+    legal = bytes(c_plan.encode(OneInt(-2**63)))
+    assert len(legal) == 11  # 1 field-count byte + 10 varint bytes
+    assert py_plan.decode(legal, 0)[0] == OneInt(-2**63)
+    assert c_plan.decode(legal) == OneInt(-2**63)
+    # corrupt: every byte keeps the continuation bit past the 10-byte cap
+    bad = bytes([1]) + b"\xff" * 11 + b"\x01"
+    with pytest.raises(codec.CodecError):
+        py_plan.decode(bad, 0)
+    with pytest.raises((codec.CodecError, ValueError)):
+        c_plan.decode(bad)

@@ -338,3 +338,33 @@ def test_follower_redirects_and_failover_keeps_ddl(ha_box):
     # and the follower-aware resolver finds the new leader on its own
     r = MetaResolver(metas, "t")
     assert r.partition_count == 4
+
+
+def test_persist_caches_state_epoch_until_external_write(tmp_path):
+    """ADVICE r5: the persist fence must not re-parse the whole state file
+    on every acked DDL. Repeat persists from one process serve the epoch
+    from cache (zero full re-reads); an external writer changes the stat
+    fingerprint and forces exactly one re-read — which still fences."""
+    import json
+
+    from pegasus_tpu.meta.meta_server import MetaServer
+
+    lock = str(tmp_path / "meta.lock")
+    state = str(tmp_path / "state.json")
+    el = MetaElection(lock, "127.0.0.1:1", lease_seconds=60.0,
+                      settle_seconds=0.01)
+    el._try_claim()
+    ms = MetaServer(state, election=el)
+    reads = []
+    orig = ms._read_state_epoch
+    ms._read_state_epoch = lambda: (reads.append(1), orig())[1]
+    for _ in range(5):
+        ms._persist()
+    assert reads == []  # fingerprint matched every time: cache served
+    # external writer (a newer leader) lands a higher-epoch state
+    newer = json.load(open(state))
+    newer["epoch"] = 9
+    json.dump(newer, open(state, "w"))
+    with pytest.raises(RuntimeError, match="fenced"):
+        ms._persist()
+    assert reads == [1]  # the fingerprint miss forced ONE full re-read
