@@ -42,8 +42,10 @@ reference publishes no in-repo numbers — BASELINE.md).
 Env knobs: PEGASUS_BENCH_N (records, default 10_000_000), PEGASUS_BENCH_VALUE
 (user bytes per value, default 100), PEGASUS_BENCH_RUNS (L0 runs, default 4),
 PEGASUS_BENCH_REPS (timed reps, default 3), PEGASUS_BENCH_LANE_S (TPU child
-deadline, default 360), PEGASUS_BENCH_TIMEOUT_S (whole-bench watchdog,
-default 600).
+deadline, default 360), PEGASUS_BENCH_DEADLINE_S (in-process per-attempt
+lane-guard deadline, default 0.7 * LANE_S so the stage-attributed abandon
+undercuts the external kill), PEGASUS_BENCH_TIMEOUT_S (whole-bench
+watchdog, default 600).
 """
 
 import hashlib
@@ -202,11 +204,32 @@ def _out_digest(block) -> dict:
     }
 
 
+def _lane_deadline_s() -> float:
+    """Per-attempt in-process deadline for the guarded device lane. It
+    must undercut PEGASUS_BENCH_LANE_S by a real margin: the parent's
+    timer covers the whole child lifetime (init + fill + prep too), so an
+    equal deadline would always lose the race to the external SIGTERM and
+    the stage-attributed abandon would never fire. The parent kill stays
+    the backstop for wedges outside the guarded merge itself."""
+    v = os.environ.get("PEGASUS_BENCH_DEADLINE_S")
+    if v:
+        return float(v)
+    # strictly under lane_s even for tiny operator-set budgets, or the
+    # external SIGTERM always wins and this deadline is dead code
+    lane_s = float(os.environ.get("PEGASUS_BENCH_LANE_S", 360))
+    return max(5.0, min(lane_s * 0.7, lane_s - 10.0))
+
+
 def _lane(backend, packed_in, concat, fargs, reps, dev_vals=None):
     """Timed compaction lane: merge + survivor materialization, best of
     reps (first rep is jit-compile warmup). dev_vals switches the device
     lane's materialization to HBM-resident value rows (downloaded as one
-    block, overlapped with the host key gather)."""
+    block, overlapped with the host key gather).
+
+    The device lane runs under the lane guard with fallback DISABLED: a
+    bench must report the device number or fail loudly — a silent cpu
+    fallback would publish a cpu time as "tpu". Retries/abandons land in
+    the guard's counters, exported as the JSON line's detail.lane."""
     from pegasus_tpu.ops.compact import (gather_device_survivors,
                                          materialize_device_survivors)
 
@@ -216,15 +239,22 @@ def _lane(backend, packed_in, concat, fargs, reps, dev_vals=None):
     for _ in range(reps + 1):
         t0 = time.perf_counter()
         if hasattr(backend, "survivors_device"):
-            dev_idx, cnt = backend.survivors_device(packed_in, *fargs)
-            t1 = time.perf_counter()
-            if dev_vals is not None:
-                # values come off the device; host gathers only keys+aux
-                out = materialize_device_survivors(concat, dev_vals,
-                                                   dev_idx, cnt)
-            else:
-                # index download overlaps the memcpy-bound arena gather
-                out = gather_device_survivors(concat, dev_idx, cnt)
+            from pegasus_tpu.runtime.lane_guard import LANE_GUARD
+
+            def _attempt():
+                dev_idx, cnt = backend.survivors_device(packed_in, *fargs)
+                t_merge = time.perf_counter()
+                if dev_vals is not None:
+                    # values come off the device; host gathers keys+aux
+                    o = materialize_device_survivors(concat, dev_vals,
+                                                     dev_idx, cnt)
+                else:
+                    # index download overlaps the memcpy-bound arena gather
+                    o = gather_device_survivors(concat, dev_idx, cnt)
+                return t_merge, o
+
+            t1, out = LANE_GUARD.run(_attempt, None, op="bench-lane",
+                                     deadline_s=_lane_deadline_s())
         else:
             surv = backend.survivors(packed_in, *fargs)
             t1 = time.perf_counter()
@@ -363,9 +393,14 @@ def tpu_lane_main():
         backend = TpuBackend()
         prep = backend.prepare(packed)  # device residency: flush-time, untimed
         tpu_s, out, split = _tpu_lanes(backend, prep, concat, fargs, reps)
+    from pegasus_tpu.runtime.lane_guard import LANE_GUARD
+
     result = {"ok": True, "tpu_s": tpu_s, "split": split,
               "platform": platform, "init_s": round(init_s, 1),
-              "fill_s": round(fill_s, 3), "trace": sess.summary()}
+              "fill_s": round(fill_s, 3), "trace": sess.summary(),
+              # lane-guard totals: a run with fallbacks/abandons > 0 can
+              # never silently masquerade as a clean tpu number
+              "lane": LANE_GUARD.state()}
     result.update(_out_digest(out))
     print(json.dumps(result), flush=True)
 
@@ -544,8 +579,10 @@ def main():
         prep = backend.prepare(packed)
         tpu_s, tpu_out, tpu_split = _tpu_lanes(backend, prep, concat, fargs,
                                                reps)
+        from pegasus_tpu.runtime.lane_guard import LANE_GUARD
+
         lane_result = {"tpu_s": tpu_s, "split": tpu_split,
-                       "platform": platform}
+                       "platform": platform, "lane": LANE_GUARD.state()}
         lane_result.update(_out_digest(tpu_out))
         reason = ""
     else:
@@ -561,8 +598,11 @@ def main():
         detail = dict(cpu_detail)
         if _LANE_STATE.get("wedge_status"):
             # the abandoned child's last heartbeat: stage attribution for
-            # the wedge (last_ok / wedged_at_stage / open stages)
+            # the wedge (last_ok / wedged_at_stage / open stages) plus the
+            # lane guard's fallback/retry/breaker totals
             detail["watchdog"] = _LANE_STATE["wedge_status"]
+            if _LANE_STATE["wedge_status"].get("lane") is not None:
+                detail["lane"] = _LANE_STATE["wedge_status"]["lane"]
         _emit(_degraded(n_total, n_runs, value_size, reason, detail=detail))
         return
 
@@ -580,6 +620,10 @@ def main():
         "tpu_records_per_s": int(n_in / tpu_s),
         "byte_equal": True,
         "platform": lane_result["platform"],
+        # fallbacks/retries/breaker trips recorded by the child's lane
+        # guard — BENCH_r06+ readers must check these before trusting the
+        # speedup as a true device number
+        "lane": lane_result.get("lane"),
     })
     _emit({
         "metric": _metric_name(n_total, n_runs, value_size),

@@ -427,10 +427,18 @@ class LsmEngine:
         OOMing the write path."""
         if self.opts.backend != "tpu":
             return None
+        from ..runtime.lane_guard import LANE_GUARD
+
         want_values = self.opts.device_values
         cached = sst._device_run
         if cached is not None and (not want_values
                                    or cached.val2d is not None):
+            return cached
+        if LANE_GUARD.breaker_open(probe=False):
+            # the breaker routes all compaction to cpu; priming HBM for a
+            # device the guard has declared dead would only re-wedge.
+            # probe=False: the write path must never block on a half-open
+            # device probe — the next guarded compaction does that
             return cached
         with self._lock:
             if self._device_cache_used >= self.opts.device_cache_bytes:
@@ -439,7 +447,12 @@ class LsmEngine:
         try:
             dr = sst.device_run(self.opts.prefix_u32,
                                 with_values=want_values)
-        except Exception as e:  # device OOM / backend failure: degrade
+        except Exception as e:  # device OOM / backend failure: one policy
+            # breaker=False: an oversized sst OOMing its prime is
+            # capacity-local, not device death — it must not flap every
+            # compaction onto cpu
+            LANE_GUARD.record_device_failure("device_run_prime", repr(e),
+                                             breaker=False)
             print(f"[engine] device-run prime failed for {sst.path}: {e!r}",
                   flush=True)
             sst._device_uncacheable = True
@@ -534,6 +547,12 @@ class LsmEngine:
                 self._resolved_mesh = (make_mesh(len(jax.devices()))
                                        if len(jax.devices()) > 1 else None)
             except Exception as e:  # no backend: stay single-chip
+                from ..runtime.lane_guard import LANE_GUARD
+
+                # breaker=False: a missing/misconfigured mesh is an
+                # environment condition, not evidence the device died
+                LANE_GUARD.record_device_failure("mesh_resolve", repr(e),
+                                                 breaker=False)
                 print(f"[engine] sharded compaction unavailable: {e!r}",
                       flush=True)
                 self._resolved_mesh = None

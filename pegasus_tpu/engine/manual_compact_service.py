@@ -70,6 +70,8 @@ class ManualCompactService:
             "pegasus_last_manual_compact_finish_time", 0)) * 1000
         self._last_used_ms = 0
         self._last_trace = None  # per-stage breakdown of the last run
+        self._last_error = None  # repr of the last FAILED run's exception
+        self._last_fail_ms = 0
 
     # ------------------------------------------------------------------ time
 
@@ -168,6 +170,7 @@ class ManualCompactService:
             # wedged_at_stage while query_compact_state reports 'running'
             self._watchdog().start()
             self._watchdog().probe()
+        error = None
         try:
             stats = self.server.engine.manual_compact(
                 bottommost=opts["bottommost"],
@@ -176,16 +179,34 @@ class ManualCompactService:
             )
             with self._lock:
                 self._last_trace = stats.get("trace")
+        except BaseException as e:
+            # a FAILED run must not record finish state: persisting
+            # `pegasus_last_manual_compact_finish_time` here would dedup
+            # the once-trigger as "finished" and the compaction would
+            # never be retried. BaseException, not Exception — an
+            # interrupt (shutdown SIGINT/SystemExit) mid-compaction must
+            # not be recorded as finished either. The failure is recorded
+            # for query_compact_state and re-raised to the caller.
+            error = e
+            if isinstance(e, Exception):
+                counters.rate("manual_compact.failure_count").increment()
+            raise
         finally:
             if is_device:
                 self._watchdog().probe()
             finish = self.now_ms()
             with self._lock:
                 self._last_used_ms = finish - self._start_ms
-                self._last_finish_ms = finish
                 self._state = _IDLE
-            self.server.engine.meta_store[
-                "pegasus_last_manual_compact_finish_time"] = finish // 1000
+                if error is None:
+                    self._last_finish_ms = finish
+                    self._last_error = None
+                else:
+                    self._last_fail_ms = finish
+                    self._last_error = repr(error)
+            if error is None:
+                self.server.engine.meta_store[
+                    "pegasus_last_manual_compact_finish_time"] = finish // 1000
 
     @staticmethod
     def _watchdog():
@@ -193,12 +214,20 @@ class ManualCompactService:
 
         return WATCHDOG
 
+    @staticmethod
+    def _lane_guard():
+        from ..runtime.lane_guard import LANE_GUARD
+
+        return LANE_GUARD
+
     # ----------------------------------------------------------------- state
 
     def query_compact_state(self) -> str:
         """Human string like the reference's query_compact_state — plus the
-        watchdog's wedge attribution, so a stuck compaction reports WHERE
-        it wedged instead of just 'running' forever."""
+        watchdog's wedge attribution and the lane guard's breaker/fallback
+        state, so a stuck or degraded compaction reports WHERE it wedged
+        (and that it survived via the cpu lane) instead of just 'running'
+        forever."""
         with self._lock:
             if self._state == _RUNNING:
                 out = (f"running; started at {self._start_ms} "
@@ -210,9 +239,22 @@ class ManualCompactService:
                        f"used {self._last_used_ms} ms")
             else:
                 out = "idle; never compacted"
+            if self._last_error is not None:
+                out += (f"; last attempt FAILED at {self._last_fail_ms}: "
+                        f"{self._last_error}")
         wedged = self._watchdog().wedged_at_stage
         if wedged is not None:
             out += f"; device wedged at stage {wedged}"
+        lane = self._lane_guard().state()
+        if lane["breaker_open"]:
+            out += (f"; device lane breaker OPEN "
+                    f"({lane['breaker_consecutive_failures']} consecutive "
+                    f"failures, cooldown "
+                    f"{lane['breaker_cooldown_remaining_s']}s)")
+        if lane["fallbacks"]:
+            out += (f"; cpu fallbacks: {lane['fallbacks']} "
+                    f"(retries {lane['retries']}, deadline abandons "
+                    f"{lane['deadline_abandons']})")
         return out
 
     @property

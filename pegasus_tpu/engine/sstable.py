@@ -65,12 +65,14 @@ def write_sst(path: str, block: KVBlock, meta: dict = None,
     auto-detect from the header, so tables can mix files."""
     import time as _time
 
+    from ..runtime.fail_points import inject
     from ..runtime.perf_counters import counters
     from ..runtime.tracing import COMPACT_TRACER
 
     t0 = _time.perf_counter()
     nbytes = block.key_bytes_total + block.val_bytes_total
     with COMPACT_TRACER.span("sst_write", records=block.n, nbytes=nbytes):
+        inject("engine.sst_write")
         header = _write_sst_impl(path, block, meta, compression)
     counters.rate("engine.sst_write_count").increment()
     counters.rate("engine.sst_write_bytes").increment(nbytes)

@@ -25,6 +25,8 @@ import functools
 
 import numpy as np
 
+from ..runtime.fail_points import inject as _inject
+from ..runtime.lane_guard import LANE_GUARD
 from ..runtime.tracing import COMPACT_TRACER as _TRACE
 from .compact import (CompactOptions, _make_cached_fn, apply_post_filters,
                       gather_device_survivors)
@@ -129,43 +131,71 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None,
 
 def _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts=None):
     """One dispatch: stack the group's cached runs, run jit(vmap), gather
-    + post-filter each row's survivors into outs[job]."""
-    import jax
-    import jax.numpy as jnp
+    + post-filter each row's survivors into outs[job]. The whole dispatch
+    runs under the lane guard: a wedge/failure falls back to per-job cpu
+    compactions (byte-identical by contract)."""
 
-    from ..engine.block import KVBlock
+    def _device_group() -> dict:
+        import jax
+        import jax.numpy as jnp
 
-    padded_lens, run_ws, w = sig
-    fn = _compiled_batched_pipeline(padded_lens, run_ws, w)
-    # "h2d" here is HBM-to-HBM batch stacking (+ the dp re-placement): the
-    # PCIe upload already happened when the DeviceRuns were born
-    with _TRACE.span("h2d", records=len(idxs) * sum(padded_lens)):
-        cached, aux, real_lens, pidx_arr = _stack_group(
-            [(jobs[j][1], jobs[j][2]) for j in idxs])
-        if mesh is not None and len(idxs) % mesh.size == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
+        from ..engine.block import KVBlock
 
-            axis = mesh.axis_names[0]
+        padded_lens, run_ws, w = sig
+        fn = _compiled_batched_pipeline(padded_lens, run_ws, w)
+        # "h2d" here is HBM-to-HBM batch stacking (+ the dp re-placement):
+        # the PCIe upload already happened when the DeviceRuns were born
+        with _TRACE.span("h2d", records=len(idxs) * sum(padded_lens)):
+            _inject("compact.h2d")
+            cached, aux, real_lens, pidx_arr = _stack_group(
+                [(jobs[j][1], jobs[j][2]) for j in idxs])
+            if mesh is not None and len(idxs) % mesh.size == 0:
+                from jax.sharding import NamedSharding, PartitionSpec
 
-            def shard_batch(x):
-                spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
-                return jax.device_put(x, NamedSharding(mesh, spec))
+                axis = mesh.axis_names[0]
 
-            cached = jax.tree_util.tree_map(shard_batch, cached)
-            aux = jax.tree_util.tree_map(shard_batch, aux)
-            real_lens = shard_batch(real_lens)
-            pidx_arr = shard_batch(pidx_arr)
-    # np.asarray(counts) syncs on the whole batched dispatch
-    with _TRACE.span("device", records=len(idxs) * sum(padded_lens)):
-        out_idx, counts = fn(cached, aux, real_lens, jnp.uint32(now),
-                             pidx_arr, jnp.uint32(opts.partition_mask),
-                             jnp.asarray(bool(opts.bottommost)),
-                             jnp.asarray(bool(opts.filter)))
-        counts = np.asarray(counts)
-    for row, j in enumerate(idxs):
-        runs = jobs[j][0]
-        concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        out = gather_device_survivors(concat, out_idx[row],
-                                      int(counts[row]))
-        outs[j] = apply_post_filters(
-            out, post_opts[j] if post_opts else opts, now)
+                def shard_batch(x):
+                    spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+                    return jax.device_put(x, NamedSharding(mesh, spec))
+
+                cached = jax.tree_util.tree_map(shard_batch, cached)
+                aux = jax.tree_util.tree_map(shard_batch, aux)
+                real_lens = shard_batch(real_lens)
+                pidx_arr = shard_batch(pidx_arr)
+        # np.asarray(counts) syncs on the whole batched dispatch
+        with _TRACE.span("device", records=len(idxs) * sum(padded_lens)):
+            _inject("compact.device")
+            out_idx, counts = fn(cached, aux, real_lens, jnp.uint32(now),
+                                 pidx_arr, jnp.uint32(opts.partition_mask),
+                                 jnp.asarray(bool(opts.bottommost)),
+                                 jnp.asarray(bool(opts.filter)))
+            counts = np.asarray(counts)
+        group_outs = {}
+        for row, j in enumerate(idxs):
+            runs = jobs[j][0]
+            concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
+            out = gather_device_survivors(concat, out_idx[row],
+                                          int(counts[row]))
+            group_outs[j] = apply_post_filters(
+                out, post_opts[j] if post_opts else opts, now)
+        return group_outs
+
+    def _cpu_group() -> dict:
+        from dataclasses import replace
+
+        from .compact import compact_blocks
+
+        group_outs = {}
+        for j in idxs:
+            runs, _, pidx = jobs[j]
+            job_opts = replace(
+                post_opts[j] if post_opts else opts,
+                pidx=pidx, backend="cpu", runs_sorted=True, now=now,
+                partition_mask=opts.partition_mask,
+                bottommost=opts.bottommost, filter=opts.filter)
+            group_outs[j] = compact_blocks(runs, job_opts).block
+        return group_outs
+
+    results = LANE_GUARD.run(_device_group, _cpu_group, op="batched_compact")
+    for j, block in results.items():
+        outs[j] = block

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..base.utils import epoch_now
 from ..engine.block import KVBlock
+from ..runtime.fail_points import inject as _inject
 from ..runtime.tracing import COMPACT_TRACER as _TRACE
 from .packing import DEFAULT_PREFIX_U32, compute_suffix_ranks, pack_key_prefixes, pack_sbytes
 
@@ -132,6 +133,7 @@ def pack_runs(runs, opts: CompactOptions, need_sbytes: bool) -> PackedRuns:
     with _TRACE.span("pack", records=sum(b.n for b in runs),
                      nbytes=sum(b.key_bytes_total + b.val_bytes_total
                                 for b in runs)):
+        _inject("compact.pack")
         return _pack_runs_impl(runs, opts, need_sbytes)
 
 
@@ -388,6 +390,7 @@ class TpuBackend:
         # the int(count) below syncs on the kernel, so the span's wall time
         # covers dispatch + device execution
         with _TRACE.span("device", records=sum(r.n for r in device_runs)):
+            _inject("compact.device")
             out = fn(cached, aux, real_lens,
                      jnp.uint32(now), jnp.uint32(pidx),
                      jnp.uint32(pmask), jnp.asarray(bool(bottommost)),
@@ -402,6 +405,7 @@ class TpuBackend:
 
     def prepare(self, packed: PackedRuns) -> DevicePacked:
         with _TRACE.span("h2d", records=sum(packed.lens)) as sp:
+            _inject("compact.h2d")
             prep = self._prepare(packed)
             sp["bytes"] = sum(
                 sum(int(a.size) * a.dtype.itemsize for a in rc)
@@ -442,6 +446,7 @@ class TpuBackend:
         fn = _compiled_pipeline(prep.padded_lens, prep.w, prep.has_rank)
         # int(count) syncs on the kernel: the span covers dispatch + device
         with _TRACE.span("device", records=sum(prep.padded_lens)):
+            _inject("compact.device")
             out_idx, count = fn(
                 prep.run_cols, prep.aux,
                 jnp.uint32(now), jnp.uint32(pidx), jnp.uint32(pmask),
@@ -506,6 +511,7 @@ def _finish_overlapped(concat: KVBlock, out_dev, real_idx, count: int,
     fused loop, numpy fallback), assemble the uniform output block."""
     with _TRACE.span("gather", records=count,
                      nbytes=count * (kl0 + vl0)):
+        _inject("compact.gather")
         return _finish_overlapped_impl(concat, out_dev, real_idx, count,
                                        kl0, vl0)
 
@@ -582,6 +588,7 @@ def gather_device_survivors(concat: KVBlock, dev_idx, count: int,
     if count == 0:
         return KVBlock.empty()
     with _TRACE.span("gather", records=count):
+        _inject("compact.gather")
         return _gather_device_survivors_impl(concat, dev_idx, count, chunks)
 
 
@@ -919,36 +926,46 @@ def _compact_blocks_impl(blocks, opts: CompactOptions,
     now = opts.resolved_now()
     fargs = (now, opts.pidx, opts.partition_mask,
              bool(opts.bottommost), bool(opts.filter))
-    if (device_runs is not None and backend.name == "tpu"
-            and len(device_runs) == len(runs)
-            and all(d is not None for d in device_runs)):
+
+    def _cpu_lane() -> KVBlock:
+        packed = pack_runs(runs, opts, need_sbytes=True)
+        survivors = get_backend("cpu").survivors(packed, *fargs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        # cheap checks first: uniform_layout() is four O(n) reductions,
-        # wasted work whenever value residency is off (the default)
-        vl0s = {d.vl0 for d in device_runs} \
-            if all(d.val2d is not None for d in device_runs) else set()
-        uni = concat.uniform_layout() if len(vl0s) == 1 else None
-        if uni is not None and uni[1] == next(iter(vl0s)):
-            # value residency: output values materialize on device
-            mapped, padded, count = backend.survivors_cached_device(
-                device_runs, *fargs, want_padded=True)
-            out = materialize_cached_survivors(concat, device_runs, mapped,
-                                               padded, count)
-        else:
+        with _TRACE.span("gather", records=len(survivors)):
+            return concat.gather(survivors)
+
+    def _device_lane() -> KVBlock:
+        if (device_runs is not None and len(device_runs) == len(runs)
+                and all(d is not None for d in device_runs)):
+            concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
+            # cheap checks first: uniform_layout() is four O(n) reductions,
+            # wasted work whenever value residency is off (the default)
+            vl0s = {d.vl0 for d in device_runs} \
+                if all(d.val2d is not None for d in device_runs) else set()
+            uni = concat.uniform_layout() if len(vl0s) == 1 else None
+            if uni is not None and uni[1] == next(iter(vl0s)):
+                # value residency: output values materialize on device
+                mapped, padded, count = backend.survivors_cached_device(
+                    device_runs, *fargs, want_padded=True)
+                return materialize_cached_survivors(concat, device_runs,
+                                                    mapped, padded, count)
             dev_idx, count = backend.survivors_cached_device(device_runs,
                                                              *fargs)
-            out = gather_device_survivors(concat, dev_idx, count)
-    elif backend.name == "tpu":
+            return gather_device_survivors(concat, dev_idx, count)
         packed = pack_runs(runs, opts, need_sbytes=False)
         dev_idx, count = backend.survivors_device(packed, *fargs)
         concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        out = gather_device_survivors(concat, dev_idx, count)
+        return gather_device_survivors(concat, dev_idx, count)
+
+    if backend.name == "tpu":
+        # the lane guard owns every device failure mode: deadline-abandoned
+        # wedges, bounded retry on transient errors, byte-identical cpu
+        # fallback, and the breaker that routes around a dead device
+        from ..runtime.lane_guard import LANE_GUARD
+
+        out = LANE_GUARD.run(_device_lane, _cpu_lane, op="compact")
     else:
-        packed = pack_runs(runs, opts, need_sbytes=True)
-        survivors = backend.survivors(packed, *fargs)
-        concat = runs[0] if len(runs) == 1 else KVBlock.concat(runs)
-        with _TRACE.span("gather", records=len(survivors)):
-            out = concat.gather(survivors)
+        out = _cpu_lane()
     out = apply_post_filters(out, opts, now)
     # stats count RAW input rows (pre any pack-time intra-run dedup) so
     # every path — cpu, device, cached, sharded, blockwise — reports the
@@ -1104,9 +1121,20 @@ def _apply_default_ttl(block: KVBlock, new_expire: int) -> None:
     if len(targets) == 0:
         return
     off = block.val_off[targets]
-    has_hdr = block.val_len[targets] > 0
+    vlen = block.val_len[targets]
+    has_hdr = vlen > 0
     first = np.where(has_hdr, block.val_arena[np.minimum(off, len(block.val_arena) - 1)], 0)
-    off = off + np.where((first & 0x80) != 0, 1, 0)
+    hdr = (first & 0x80) != 0
+    # the 4-byte BE field must fit inside THIS record's value bytes: a
+    # value shorter than its own expire_ts field (truncated ingest, raw
+    # test fixtures) is skipped outright — rewriting it would scribble
+    # into the neighboring record's arena bytes (or off the arena end)
+    fits = vlen >= np.where(hdr, 5, 4)
+    if not bool(fits.all()):
+        targets, off, hdr = targets[fits], off[fits], hdr[fits]
+        if len(targets) == 0:
+            return
+    off = off + np.where(hdr, 1, 0)
     be = np.array(
         [(new_expire >> 24) & 0xFF, (new_expire >> 16) & 0xFF,
          (new_expire >> 8) & 0xFF, new_expire & 0xFF],

@@ -157,6 +157,13 @@ class DeviceHealthWatchdog:
                    "wedged_at_stage": self.wedged_at_stage}
         out["open_stages"] = {str(tid): stages for tid, stages
                               in self.tracer.open_stages().items()}
+        # the lane guard's breaker/fallback totals ride in every health
+        # surface this state feeds: the device-health remote command,
+        # /compact/trace, and bench's status-file heartbeat (so a degraded
+        # bench line shows whether the run fell back to cpu)
+        from ..runtime.lane_guard import LANE_GUARD
+
+        out["lane"] = LANE_GUARD.state()
         return out
 
     def write_status(self) -> None:

@@ -28,6 +28,8 @@ from ..ops.compact import (CompactOptions, CompactResult, _apply_default_ttl,
                            _pow2ceil, _stats, apply_post_filters, merge_body,
                            sort_block)
 from ..ops.packing import compute_suffix_ranks, pack_key_prefixes
+from ..runtime.fail_points import inject as _inject
+from ..runtime.lane_guard import LANE_GUARD
 from ..runtime.tracing import COMPACT_TRACER as _TRACE
 
 
@@ -176,6 +178,7 @@ def sharded_compact(blocks, mesh, opts: CompactOptions, axis: str = "shard",
     # np.asarray calls sync); a capacity-overflow retry re-enters the span
     while True:
         with _TRACE.span("device", records=n):
+            _inject("compact.device")
             fn = _sharded_kernel(mesh_key, w, n_loc, cap, axis)
             gid_sorted, keep, overflow = fn(cols, *args, *scalars)
             gid_sorted = np.asarray(gid_sorted)
@@ -224,13 +227,26 @@ def sharded_compact_block(blocks, mesh, opts: CompactOptions,
     # agree on the clock or the output can differ from the single-chip
     # result for records expiring between two resolved_now() calls
     opts = replace(opts, now=opts.resolved_now())
-    kernel_opts = replace(opts, default_ttl=0, user_ops=())
-    shards, stats = sharded_compact(blocks, mesh, kernel_opts, axis=axis)
-    live = [s for s in shards if s.n]
-    if not live:
-        return CompactResult(KVBlock.empty(), _stats(stats["input_records"], 0))
-    merged = live[0] if len(live) == 1 else KVBlock.concat(live)
-    out = sort_block(merged, CompactOptions(prefix_u32=opts.prefix_u32,
-                                            backend=opts.backend))
-    out = apply_post_filters(out, opts, opts.now)
-    return CompactResult(out, _stats(stats["input_records"], out.n))
+
+    def _device_lane() -> CompactResult:
+        kernel_opts = replace(opts, default_ttl=0, user_ops=())
+        shards, stats = sharded_compact(blocks, mesh, kernel_opts, axis=axis)
+        live = [s for s in shards if s.n]
+        if not live:
+            return CompactResult(KVBlock.empty(),
+                                 _stats(stats["input_records"], 0))
+        merged = live[0] if len(live) == 1 else KVBlock.concat(live)
+        out = sort_block(merged, CompactOptions(prefix_u32=opts.prefix_u32,
+                                                backend=opts.backend))
+        out = apply_post_filters(out, opts, opts.now)
+        return CompactResult(out, _stats(stats["input_records"], out.n))
+
+    def _cpu_lane() -> CompactResult:
+        from ..ops.compact import compact_blocks
+
+        return compact_blocks(blocks, replace(opts, backend="cpu"))
+
+    # the lane guard makes the multi-chip path safe to prefer: a wedged
+    # collective / dead chip degrades to the single-node cpu merge, whose
+    # output this function is byte-equal to by construction
+    return LANE_GUARD.run(_device_lane, _cpu_lane, op="sharded_compact")

@@ -1,5 +1,8 @@
 from .config import Config, define_flag, get_flag
-from .fail_points import fail_point, setup as failpoint_setup, cfg as failpoint_cfg, teardown as failpoint_teardown
+from .fail_points import (FailPointError, fail_point,
+                          setup as failpoint_setup, cfg as failpoint_cfg,
+                          teardown as failpoint_teardown)
+from .lane_guard import LANE_GUARD, LaneError, LaneGuard, LaneGuardConfig
 from .perf_counters import PerfCounters, counters
 from .tasking import TaskPools, ThreadPool, Timer
 
@@ -7,10 +10,15 @@ __all__ = [
     "Config",
     "define_flag",
     "get_flag",
+    "FailPointError",
     "fail_point",
     "failpoint_setup",
     "failpoint_cfg",
     "failpoint_teardown",
+    "LANE_GUARD",
+    "LaneError",
+    "LaneGuard",
+    "LaneGuardConfig",
     "PerfCounters",
     "counters",
     "TaskPools",

@@ -4,7 +4,9 @@ The reference arms points like ``dsn::fail::cfg("db_write_batch_put",
 "10%return()")`` in tests against hooks compiled into the write path
 (src/server/rocksdb_wrapper.cpp:49,90,143,164;
 src/server/test/pegasus_server_write_test.cpp:45-49). Actions support the
-same mini-language subset the tests use:
+same mini-language subset the tests use, plus the two chaos verbs the
+compaction lane guard needs (a wedged device call is a SLEEP, a transient
+device error is a RAISE):
 
     "return()"     -> hook returns the given (or default) injected value
     "return(v)"    -> hook returns v (string)
@@ -12,15 +14,22 @@ same mini-language subset the tests use:
     "3*return()"   -> only first 3 hits
     "off()"        -> disabled
     "print()"      -> log and continue
+    "sleep(ms)"    -> block the calling thread ms milliseconds, continue
+    "raise(msg)"   -> raise FailPointError(msg) from the hook
 """
 
 import random
 import re
 import threading
+import time
 
 _ACTION_RE = re.compile(
-    r"^\s*(?:(?P<pct>\d+(?:\.\d+)?)%)?\s*(?:(?P<cnt>\d+)\*)?\s*(?P<verb>return|off|print)\((?P<arg>[^)]*)\)\s*$"
+    r"^\s*(?:(?P<pct>\d+(?:\.\d+)?)%)?\s*(?:(?P<cnt>\d+)\*)?\s*(?P<verb>return|off|print|sleep|raise)\((?P<arg>[^)]*)\)\s*$"
 )
+
+
+class FailPointError(RuntimeError):
+    """Raised by a fail point armed with the 'raise(msg)' verb."""
 
 
 class _FailPointRegistry:
@@ -53,7 +62,9 @@ class _FailPointRegistry:
             }
 
     def evaluate(self, name: str):
-        """None = not triggered; otherwise ("return", arg) or ("print", arg)."""
+        """None = not triggered; otherwise the (verb, arg) tuple. Pure:
+        side-effectful verbs (sleep/raise) act in fail_point(), OUTSIDE the
+        registry lock — a sleeping hook must not block cfg()/teardown()."""
         if not self._enabled:
             return None
         with self._lock:
@@ -78,8 +89,37 @@ cfg = _REGISTRY.cfg
 def fail_point(name: str):
     """FAIL_POINT_INJECT_F analogue.
 
-    Returns None when not armed/triggered, else the ("return"|"print", arg)
-    tuple; call sites decide what an injected return means (typically an
-    error status short-circuiting the operation).
+    Returns None when not armed/triggered. The chaos verbs act here:
+    'sleep(ms)' blocks the calling thread then continues (simulated device
+    wedge — the lane guard's deadline must abandon it), 'raise(msg)'
+    raises FailPointError (simulated transient device error). Otherwise
+    the ("return"|"print", arg) tuple is returned and call sites decide
+    what an injected return means (typically an error status
+    short-circuiting the operation).
     """
-    return _REGISTRY.evaluate(name)
+    fp = _REGISTRY.evaluate(name)
+    if fp is None:
+        return None
+    verb, arg = fp
+    if verb == "sleep":
+        time.sleep(float(arg or 0) / 1000.0)
+        return None
+    if verb == "raise":
+        raise FailPointError(arg or f"injected failure at {name}")
+    return fp
+
+
+def inject(name: str) -> None:
+    """Stage-boundary hook for the compaction pipeline (compact.pack,
+    compact.h2d, compact.device, compact.gather, engine.sst_write):
+    sleep()/raise() act inside fail_point(); a 'return' arming is treated
+    as an injected error too (stage hooks have no value to return), and
+    'print' logs and continues."""
+    fp = fail_point(name)
+    if fp is None:
+        return
+    verb, arg = fp
+    if verb == "print":
+        print(f"[fail_point] {name}: print({arg})", flush=True)
+        return
+    raise FailPointError(arg or f"injected failure at {name}")

@@ -1,0 +1,343 @@
+"""Lane guard: the ONE failure policy for every device-backed compaction.
+
+Every benched wedge so far was survived only by bench.py's out-of-process
+360 s lane kill; PR 1's watchdog can name the wedged stage, but in-process
+the server still hung forever, and the engine handled device failure with
+scattered ad-hoc ``except Exception: degrade`` branches. Both compaction
+backends guarantee byte-identical output (tests/test_compact_ops.py, bench
+digest handshake), so the TPU lane is an *optimization* that must never be
+an availability risk — LUDA (PAPERS.md) makes the same argument for GPU
+compaction offload. This module centralizes that contract:
+
+  1. DEADLINE — a device call runs in a worker thread under an in-process
+     deadline derived from the watchdog heartbeat; exceeding it abandons
+     the worker (never killed: a TPU-attached thread must not be killed,
+     the same rule bench.py applies to its lane child) and reports the
+     wedged stage from the worker's open span stack.
+  2. RETRY — transient device errors retry with bounded exponential
+     backoff (deterministic, no jitter). A deadline abandon does NOT
+     retry: the lane is wedged, and retrying would stack more abandoned
+     device threads against one wedged tunnel.
+  3. FALLBACK — exhausted retries (or a wedge) rerun the compaction on
+     the cpu backend, byte-identical by contract.
+  4. CIRCUIT BREAKER — after `breaker_threshold` CONSECUTIVE device
+     failures/wedges every guarded compaction routes straight to cpu for
+     `breaker_cooldown_s`; when the cooldown lapses the breaker re-probes
+     the device via the watchdog (half-open) and only a passing probe
+     closes it.
+
+Call sites: ops/compact.py (single merge), ops/batched_compact.py (one
+vmapped dispatch per shape group), parallel/sharded_compact.py (multi-chip
+all_to_all merge), bench.py's timed lane (fallback disabled there — a
+bench must report the device number or fail loudly, never silently time
+the cpu path as "tpu").
+
+Counters (process registry -> /metrics, perf-counters*, collector):
+  compact.lane.fallback_count / retry_count /
+  compact.lane.deadline_abandon_count / breaker_trip_count     rate
+  compact.lane.breaker_open                                    gauge (0/1)
+
+Monotonic totals (rate counters reset on read) live in state(), which
+rides in the device-health remote command, /compact/trace, the watchdog
+status-file heartbeat, query_compact_state, and bench's detail.lane.
+
+Env knobs (read once at import for the process-wide LANE_GUARD):
+  PEGASUS_LANE_DEADLINE_S / PEGASUS_LANE_MAX_RETRIES /
+  PEGASUS_LANE_BREAKER_THRESHOLD / PEGASUS_LANE_BREAKER_COOLDOWN_S
+"""
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from .perf_counters import counters
+from .tracing import COMPACT_TRACER
+
+
+class LaneError(RuntimeError):
+    """Device lane failed and no fallback was provided."""
+
+
+class LaneDeadlineExceeded(LaneError):
+    """The device call outlived its deadline and was abandoned."""
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
+@dataclass
+class LaneGuardConfig:
+    # None = derive from the watchdog heartbeat at call time (see
+    # LaneGuard.effective_deadline_s); <= 0 disables the deadline (the
+    # device call runs inline in the caller's thread)
+    deadline_s: float = None
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+
+    @classmethod
+    def from_env(cls) -> "LaneGuardConfig":
+        return cls(
+            deadline_s=_env_float("PEGASUS_LANE_DEADLINE_S", None),
+            max_retries=_env_int("PEGASUS_LANE_MAX_RETRIES", 2),
+            breaker_threshold=_env_int("PEGASUS_LANE_BREAKER_THRESHOLD", 3),
+            breaker_cooldown_s=_env_float("PEGASUS_LANE_BREAKER_COOLDOWN_S",
+                                          30.0),
+        )
+
+
+class LaneGuard:
+    def __init__(self, config: LaneGuardConfig = None, tracer=COMPACT_TRACER,
+                 probe_fn=None):
+        self.config = config or LaneGuardConfig()
+        self.tracer = tracer
+        # injectable half-open probe (tests); default = the watchdog's
+        # liveness round-trip, lazily bound to avoid a runtime->ops import
+        # at module load
+        self.probe_fn = probe_fn
+        self._lock = threading.Lock()
+        # serializes the half-open re-probe: exactly one thread pays the
+        # probe timeout against a possibly-wedged device; concurrent
+        # callers keep routing to cpu meanwhile
+        self._half_open_lock = threading.Lock()
+        self.fallback_count = 0
+        self.retry_count = 0
+        self.deadline_abandon_count = 0
+        self.breaker_trip_count = 0
+        self.device_failure_count = 0
+        self._consec_failures = 0
+        self._breaker_open_until = 0.0  # monotonic
+        self.last_failure = None   # {"op", "error", "stage", "ts"}
+        self.last_fallback = None  # {"op", "reason", "ts"}
+
+    # ------------------------------------------------------------ plumbing
+
+    @staticmethod
+    def _watchdog():
+        from ..ops.device_watchdog import WATCHDOG
+
+        return WATCHDOG
+
+    def _probe(self) -> bool:
+        if self.probe_fn is not None:
+            return bool(self.probe_fn())
+        return self._watchdog().probe()
+
+    def effective_deadline_s(self) -> float:
+        """The in-process deadline, derived from the watchdog heartbeat
+        when not configured: long enough that `fail_threshold` heartbeat
+        cycles can independently flip wedged_at_stage first (attribution
+        beats abandonment), floored generously so a cold jit compile over
+        a slow tunnel is never mistaken for a wedge."""
+        if self.config.deadline_s is not None:
+            return self.config.deadline_s
+        wd = self._watchdog()
+        return max(120.0, (wd.probe_timeout_s + wd.interval_s)
+                   * (wd.fail_threshold + 2))
+
+    # ------------------------------------------------------------- breaker
+
+    def breaker_open(self, probe: bool = True) -> bool:
+        """True while device work must be skipped. When the cooldown has
+        lapsed this HALF-OPENS: one watchdog probe decides — pass closes
+        the breaker, fail re-arms the full cooldown. Only ONE thread
+        probes at a time (a probe against a wedged device blocks for its
+        timeout); everyone else keeps routing to cpu meanwhile.
+
+        probe=False is the passive check for paths that must never block
+        on a device probe (the engine's HBM prime): an open breaker stays
+        open to them until a guarded compaction's half-open probe passes.
+        """
+        with self._lock:
+            if self._consec_failures < self.config.breaker_threshold:
+                return False
+            cooling = time.monotonic() < self._breaker_open_until
+        if cooling or not probe:
+            return True
+        if not self._half_open_lock.acquire(blocking=False):
+            return True  # someone else is probing right now
+        try:
+            with self._lock:  # re-check: the prior prober may have closed it
+                if self._consec_failures < self.config.breaker_threshold:
+                    return False
+                if time.monotonic() < self._breaker_open_until:
+                    return True
+            if self._probe():
+                with self._lock:
+                    self._consec_failures = 0
+                    self._breaker_open_until = 0.0
+                counters.number("compact.lane.breaker_open").set(0)
+                return False
+            with self._lock:
+                self._breaker_open_until = (time.monotonic()
+                                            + self.config.breaker_cooldown_s)
+            return True
+        finally:
+            self._half_open_lock.release()
+
+    def record_device_failure(self, op: str, error: str, stage: str = None,
+                              breaker: bool = True) -> None:
+        """Count one device failure — the single policy the engine's
+        former ad-hoc degrade branches now feed. breaker=False records
+        the failure (totals, last_failure) WITHOUT advancing the breaker:
+        capacity-local conditions (one oversized sst OOMing its HBM
+        prime) are not evidence the device is dead, and must not flap all
+        compactions onto cpu."""
+        tripped = False
+        with self._lock:
+            self.device_failure_count += 1
+            self.last_failure = {"op": op, "error": str(error)[:400],
+                                 "stage": stage, "ts": time.time()}
+            if breaker:
+                self._consec_failures += 1
+                tripped = (self._consec_failures
+                           == self.config.breaker_threshold)
+                if tripped:
+                    self.breaker_trip_count += 1
+                    self._breaker_open_until = (
+                        time.monotonic() + self.config.breaker_cooldown_s)
+        if tripped:
+            counters.rate("compact.lane.breaker_trip_count").increment()
+            counters.number("compact.lane.breaker_open").set(1)
+
+    def record_device_ok(self) -> None:
+        with self._lock:
+            was_open = self._consec_failures >= self.config.breaker_threshold
+            self._consec_failures = 0
+            self._breaker_open_until = 0.0
+        if was_open:
+            counters.number("compact.lane.breaker_open").set(0)
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, device_fn, fallback_fn=None, op: str = "compact",
+            deadline_s: float = None):
+        """Run `device_fn` under the policy; on failure run `fallback_fn`
+        (the cpu path, byte-identical by contract). fallback_fn=None means
+        the caller wants the device result or the error (bench)."""
+        if fallback_fn is not None and self.breaker_open():
+            return self._fallback(fallback_fn, op, "breaker open")
+        deadline = (self.effective_deadline_s() if deadline_s is None
+                    else deadline_s)
+        attempts = max(1, self.config.max_retries + 1)
+        delay = self.config.backoff_base_s
+        last_err = None
+        for attempt in range(attempts):
+            failures_before = self.device_failure_count
+            try:
+                result = self._attempt(device_fn, deadline, op)
+            except LaneDeadlineExceeded as e:
+                last_err = e
+                break  # wedged: never stack retries onto a wedged tunnel
+            except Exception as e:  # noqa: BLE001 - every device error is policy input
+                last_err = e
+                self.record_device_failure(op, repr(e))
+                if attempt + 1 < attempts:
+                    with self._lock:
+                        self.retry_count += 1
+                    counters.rate("compact.lane.retry_count").increment()
+                    time.sleep(min(delay, self.config.backoff_max_s))
+                    delay *= 2
+                    continue
+                break
+            else:
+                # only a CLEAN attempt resets the breaker: a nested
+                # guarded call (sharded reassembly sorts re-enter
+                # compact_blocks) may have "succeeded" via its own cpu
+                # fallback, and crediting that as device health would
+                # keep a dead device's breaker from ever accumulating
+                if self.device_failure_count == failures_before:
+                    self.record_device_ok()
+                return result
+        if fallback_fn is None:
+            raise last_err
+        return self._fallback(fallback_fn, op,
+                              f"device lane failed: {last_err!r}")
+
+    def _attempt(self, fn, deadline_s: float, op: str):
+        if not deadline_s or deadline_s <= 0:
+            return fn()
+        box = {}
+        sessions = self.tracer.propagate_sessions()
+
+        def work():
+            self.tracer.adopt_sessions(sessions)
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 - crosses the thread boundary
+                box["error"] = e
+
+        t = threading.Thread(target=work, daemon=True, name=f"lane-{op}")
+        t.start()
+        t.join(deadline_s)
+        if t.is_alive():
+            # abandoned in its thread, never killed; its span stays open so
+            # the watchdog keeps attributing the wedge after we move on
+            stages = self.tracer.open_stages().get(t.ident)
+            stage = stages[-1] if stages else "unknown"
+            with self._lock:
+                self.deadline_abandon_count += 1
+            counters.rate("compact.lane.deadline_abandon_count").increment()
+            err = LaneDeadlineExceeded(
+                f"{op}: device call exceeded {deadline_s:.1f}s deadline "
+                f"(wedged at stage {stage}); worker abandoned")
+            self.record_device_failure(op, str(err), stage=stage)
+            raise err
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _fallback(self, fallback_fn, op: str, reason: str):
+        with self._lock:
+            self.fallback_count += 1
+            self.last_fallback = {"op": op, "reason": reason,
+                                  "ts": time.time()}
+        counters.rate("compact.lane.fallback_count").increment()
+        print(f"[lane-guard] {op}: falling back to cpu backend ({reason})",
+              flush=True)
+        return fallback_fn()
+
+    # --------------------------------------------------------------- state
+
+    def state(self) -> dict:
+        with self._lock:
+            open_now = self._consec_failures >= self.config.breaker_threshold
+            return {
+                "breaker_open": open_now,
+                "breaker_consecutive_failures": self._consec_failures,
+                "breaker_cooldown_remaining_s": round(
+                    max(0.0, self._breaker_open_until - time.monotonic()), 3)
+                    if open_now else 0.0,
+                "fallbacks": self.fallback_count,
+                "retries": self.retry_count,
+                "deadline_abandons": self.deadline_abandon_count,
+                "breaker_trips": self.breaker_trip_count,
+                "device_failures": self.device_failure_count,
+                "last_failure": self.last_failure,
+                "last_fallback": self.last_fallback,
+            }
+
+    def reset(self) -> None:
+        """Test hook: zero every total and close the breaker."""
+        with self._lock:
+            self.fallback_count = self.retry_count = 0
+            self.deadline_abandon_count = self.breaker_trip_count = 0
+            self.device_failure_count = self._consec_failures = 0
+            self._breaker_open_until = 0.0
+            self.last_failure = self.last_fallback = None
+        counters.number("compact.lane.breaker_open").set(0)
+
+
+# process-wide instance: every device-backed merge in this process shares
+# one breaker (one device/tunnel per process is the deployment shape)
+LANE_GUARD = LaneGuard(LaneGuardConfig.from_env())

@@ -137,6 +137,24 @@ class StageTracer:
         finally:
             sessions.remove(sess)
 
+    # ------------------------------------------- cross-thread session hand-off
+
+    def propagate_sessions(self) -> list:
+        """Snapshot this thread's active session list so a WORKER thread
+        (the lane guard runs device calls under a deadline in one) can
+        adopt it — spans the worker closes then still aggregate into the
+        caller's sessions (manual_compact's per-stage trace must survive
+        the guard's thread hop). The caller normally blocks on the worker;
+        an ABANDONED (deadline-exceeded) worker may close spans late and
+        race the caller's own adds — TraceSession increments are
+        GIL-atomic, so a wedge can at worst slightly inflate a summary,
+        never corrupt it."""
+        return list(self._session_list())
+
+    def adopt_sessions(self, sessions: list) -> None:
+        """Install a propagated session snapshot in THIS thread."""
+        self._local.sessions = list(sessions)
+
     # ----------------------------------------------- live-state inspection
 
     def open_stages(self) -> dict:

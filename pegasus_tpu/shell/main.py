@@ -85,8 +85,9 @@ class Shell:
                               "compaction stage spans (pack/h2d/device/"
                               "gather) from the tracing ring buffer"),
             "device_health": (self.cmd_device_health,
-                              "device-health watchdog state on every node "
-                              "(last_ok / wedged_at_stage)"),
+                              "device-health watchdog + lane-guard state on "
+                              "every node (last_ok / wedged_at_stage / "
+                              "breaker / cpu-fallback totals)"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
             "propose": (self.cmd_propose,

@@ -93,6 +93,40 @@ def test_default_ttl_rewrite():
     assert res.block.expire_ts[ib] == 500
 
 
+def test_default_ttl_short_value_guarded():
+    """Regression: the 4-byte BE TTL rewrite must SKIP records whose value
+    is shorter than the expire field itself (has_hdr only guarded the
+    READ) — rewriting them scribbled into the neighboring record's arena
+    bytes, or past the arena end for the last record."""
+    from pegasus_tpu.ops.compact import _apply_default_ttl
+
+    good_val = SCHEMAS[2].generate_value(0, 0, b"payload")
+    blk = KVBlock.from_records([
+        (b"\x00\x01a", b"\x01\x02", 0, False),   # 2B value: can't hold a TTL
+        (b"\x00\x01b", good_val, 0, False),
+    ])
+    neighbor_before = bytes(blk.val_arena[blk.val_off[1]:
+                                          blk.val_off[1] + blk.val_len[1]])
+    _apply_default_ttl(blk, 777)
+    # the short record was skipped entirely: bytes AND column untouched
+    assert bytes(blk.val_arena[blk.val_off[0]:
+                               blk.val_off[0] + blk.val_len[0]]) == b"\x01\x02"
+    assert blk.expire_ts[0] == 0
+    # the neighbor got its own rewrite, not the short record's overflow
+    assert blk.expire_ts[1] == 777
+    assert SCHEMAS[2].extract_expire_ts(
+        bytes(blk.val_arena[blk.val_off[1]:
+                            blk.val_off[1] + blk.val_len[1]])) == 777
+    assert neighbor_before != bytes(
+        blk.val_arena[blk.val_off[1]:blk.val_off[1] + blk.val_len[1]])
+    # last-record overflow: a lone short value must not crash or write
+    # past the arena end
+    solo = KVBlock.from_records([(b"\x00\x01c", b"\x01", 0, False)])
+    _apply_default_ttl(solo, 777)
+    assert solo.expire_ts[0] == 0 and bytes(solo.val_arena[
+        solo.val_off[0]:solo.val_off[0] + solo.val_len[0]]) == b"\x01"
+
+
 def _adversarial_records(rng, n):
     """Keys engineered to stress prefix windows: shared 32+ byte prefixes,
     trailing zeros, strict-prefix pairs, empty hash/sort keys."""

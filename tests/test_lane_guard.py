@@ -1,0 +1,404 @@
+"""Chaos suite for the compaction lane guard (runtime/lane_guard.py).
+
+Every fail point threaded through the pipeline is driven here with the
+sleep()/raise() verbs: an injected device hang must be abandoned at the
+deadline and fall back to the cpu backend with BYTE-EQUAL output; injected
+transient errors must retry, then fall back; N consecutive failures must
+open the circuit breaker, which re-probes via the watchdog before closing.
+Everything is seeded-RNG deterministic and runs in tier-1 (not slow).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base import consts
+from pegasus_tpu.ops.compact import CompactOptions, compact_blocks
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.lane_guard import (LANE_GUARD, LaneDeadlineExceeded,
+                                            LaneGuardConfig)
+from pegasus_tpu.runtime.perf_counters import counters
+from tests.test_compact_ops import _adversarial_records, make_block
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def guard():
+    """Deterministic small-knob config; fail points armed; everything
+    restored afterwards (LANE_GUARD is process-wide)."""
+    saved_cfg, saved_probe = LANE_GUARD.config, LANE_GUARD.probe_fn
+    LANE_GUARD.config = LaneGuardConfig(
+        deadline_s=60.0, max_retries=1, backoff_base_s=0.001,
+        backoff_max_s=0.002, breaker_threshold=2, breaker_cooldown_s=60.0)
+    LANE_GUARD.probe_fn = lambda: True
+    LANE_GUARD.reset()
+    fp.setup()
+    yield LANE_GUARD
+    fp.teardown()
+    LANE_GUARD.config, LANE_GUARD.probe_fn = saved_cfg, saved_probe
+    LANE_GUARD.reset()
+
+
+def _runs(seed=3, n=220, k=2):
+    rng = np.random.default_rng(seed)
+    return [make_block(_adversarial_records(rng, n)) for _ in range(k)]
+
+
+def _assert_byte_equal(a, b):
+    assert a.n == b.n
+    np.testing.assert_array_equal(a.key_arena, b.key_arena)
+    np.testing.assert_array_equal(a.val_arena, b.val_arena)
+    np.testing.assert_array_equal(a.expire_ts, b.expire_ts)
+    np.testing.assert_array_equal(a.deleted, b.deleted)
+
+
+# ------------------------------------------------------- fail-point verbs
+
+
+def test_sleep_and_raise_verbs():
+    import time
+
+    fp.setup()
+    try:
+        fp.cfg("chaos.sleep", "sleep(40)")
+        t0 = time.perf_counter()
+        assert fp.fail_point("chaos.sleep") is None  # sleeps, then continues
+        assert time.perf_counter() - t0 >= 0.035
+        fp.cfg("chaos.raise", "raise(boom)")
+        with pytest.raises(fp.FailPointError, match="boom"):
+            fp.fail_point("chaos.raise")
+        # count modifier applies to the new verbs too
+        fp.cfg("chaos.once", "1*raise(once)")
+        with pytest.raises(fp.FailPointError):
+            fp.fail_point("chaos.once")
+        assert fp.fail_point("chaos.once") is None
+    finally:
+        fp.teardown()
+
+
+# --------------------------------------------------- deadline + fallback
+
+
+def test_injected_hang_deadline_abandons_and_falls_back(guard):
+    """Acceptance: a fail-point-injected device hang completes via cpu
+    fallback within deadline + backoff (no external kill), byte-identical
+    to a clean cpu compaction, and the incident is visible in /metrics."""
+    guard.config.deadline_s = 0.25
+    runs = _runs(seed=5)
+    opts = dict(now=100, bottommost=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    fp.cfg("compact.device", "1*sleep(1500)")
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    _assert_byte_equal(want.block, got.block)
+    st = guard.state()
+    assert st["deadline_abandons"] == 1
+    assert st["fallbacks"] == 1
+    assert st["retries"] == 0  # a wedge must NOT retry
+    assert "device" in st["last_failure"]["error"]  # stage attribution
+    # the incident is scrape-visible on /metrics
+    from pegasus_tpu.collector.reporter import prometheus_text
+
+    text = prometheus_text()
+    assert "compact_lane_fallback_count" in text
+    assert "compact_lane_deadline_abandon_count" in text
+
+
+def test_transient_raise_retries_then_succeeds(guard):
+    """One transient device error: bounded retry recovers ON DEVICE (no
+    fallback), and the breaker's consecutive count resets."""
+    runs = _runs(seed=7)
+    opts = dict(now=100, bottommost=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    fp.cfg("compact.device", "1*raise(transient h2d glitch)")
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    _assert_byte_equal(want.block, got.block)
+    st = guard.state()
+    assert st["retries"] == 1
+    assert st["fallbacks"] == 0
+    assert st["breaker_consecutive_failures"] == 0  # success reset it
+
+
+def test_raise_exhausts_retries_then_falls_back(guard):
+    guard.config.breaker_threshold = 99  # isolate the retry/fallback path
+    runs = _runs(seed=9)
+    opts = dict(now=100, bottommost=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    fp.cfg("compact.device", "raise(device dead)")
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    _assert_byte_equal(want.block, got.block)
+    st = guard.state()
+    assert st["retries"] == 1  # max_retries=1 -> two attempts
+    assert st["fallbacks"] == 1
+    assert st["device_failures"] == 2
+
+
+@pytest.mark.parametrize("point", ["compact.pack", "compact.h2d",
+                                   "compact.gather"])
+def test_every_stage_fail_point_falls_back_byte_equal(guard, point):
+    """Chaos at every instrumented stage boundary: the guard's fallback
+    contract holds no matter WHERE the device lane dies. Count-limited
+    arming (2*) means both device attempts die and the cpu rerun is clean
+    even for stages shared with the cpu lane (pack)."""
+    runs = _runs(seed=11)
+    opts = dict(now=100, bottommost=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    fp.cfg(point, "2*raise(chaos)")
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    _assert_byte_equal(want.block, got.block)
+    assert guard.state()["fallbacks"] == 1
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_opens_cooldown_and_reprobes_before_closing(guard):
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return probe_result[0]
+
+    probe_result = [False]
+    guard.probe_fn = probe
+    runs = _runs(seed=13)
+    opts = dict(now=100, bottommost=True)
+    fp.cfg("compact.device", "raise(hard down)")
+    # one guarded compaction = 2 attempts = 2 consecutive failures ->
+    # threshold 2 trips the breaker
+    compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    st = guard.state()
+    assert st["breaker_open"] and st["breaker_trips"] == 1
+    assert counters.number("compact.lane.breaker_open").value() == 1
+    # cooldown active: routed straight to cpu, device NOT attempted
+    failures_before = st["device_failures"]
+    got = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    assert guard.state()["device_failures"] == failures_before
+    assert guard.state()["fallbacks"] == 2
+    assert not probes  # no re-probe while the cooldown is running
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **opts))
+    _assert_byte_equal(want.block, got.block)
+    # cooldown lapses -> half-open: a FAILING probe keeps it open
+    guard._breaker_open_until = 0.0
+    assert guard.breaker_open() is True
+    assert len(probes) == 1
+    assert guard.state()["breaker_cooldown_remaining_s"] > 0  # re-armed
+    # a PASSING probe closes it and the device lane runs again
+    guard._breaker_open_until = 0.0
+    probe_result[0] = True
+    assert guard.breaker_open() is False
+    assert counters.number("compact.lane.breaker_open").value() == 0
+    fp.cfg("compact.device", "off()")
+    got2 = compact_blocks(runs, CompactOptions(backend="tpu", **opts))
+    _assert_byte_equal(want.block, got2.block)
+    assert guard.state()["breaker_consecutive_failures"] == 0
+
+
+def test_nested_fallback_does_not_reset_breaker(guard):
+    """A device_fn that 'succeeds' only because a NESTED guarded call fell
+    back to cpu (sharded reassembly sorts re-enter compact_blocks) must
+    not be credited as device health — the breaker still accumulates."""
+    guard.config.breaker_threshold = 3
+
+    def device_with_nested_degrade():
+        guard.record_device_failure("nested", "inner lane died")
+        return "ok"
+
+    for _ in range(3):
+        assert guard.run(device_with_nested_degrade, lambda: "cpu") == "ok"
+    st = guard.state()
+    assert st["breaker_open"] and st["breaker_trips"] == 1
+
+
+def test_passive_breaker_check_never_probes(guard):
+    """breaker_open(probe=False) — the engine write path's check — must
+    stay open without running a half-open device probe, even after the
+    cooldown lapsed; only a probing caller may close the breaker."""
+    probes = []
+    guard.probe_fn = lambda: probes.append(1) or True
+    guard.record_device_failure("compact", "down")
+    guard.record_device_failure("compact", "down")  # threshold 2: open
+    guard._breaker_open_until = 0.0  # cooldown already lapsed
+    assert guard.breaker_open(probe=False) is True
+    assert not probes
+    assert guard.breaker_open() is False  # the probing caller closes it
+    assert len(probes) == 1
+
+
+def test_capacity_local_failures_do_not_advance_breaker(guard):
+    """Per-sst HBM prime OOMs are capacity-local, not device death: they
+    are recorded but must never flap the breaker open."""
+    for _ in range(5):
+        guard.record_device_failure("device_run_prime", "RESOURCE_EXHAUSTED",
+                                    breaker=False)
+    st = guard.state()
+    assert not st["breaker_open"]
+    assert st["breaker_consecutive_failures"] == 0
+    assert st["device_failures"] == 5
+
+
+# ------------------------------------------- batched + sharded call sites
+
+
+def test_batched_compact_falls_back_byte_equal(guard):
+    from dataclasses import replace
+
+    from pegasus_tpu.ops.batched_compact import compact_partition_batch
+    from tests.test_batched_compact import make_partition
+
+    opts = CompactOptions(backend="tpu", now=60, bottommost=True,
+                          runs_sorted=True)
+    jobs = []
+    for pidx in range(3):
+        runs, drs = make_partition(50 + pidx, 300)
+        jobs.append((runs, drs, pidx))
+    fp.cfg("compact.device", "raise(vmap lane down)")
+    outs = compact_partition_batch(jobs, opts)
+    assert guard.state()["fallbacks"] >= 1
+    fp.cfg("compact.device", "off()")
+    for (runs, _, pidx), got in zip(jobs, outs):
+        want = compact_blocks(runs, replace(opts, pidx=pidx, backend="cpu"))
+        _assert_byte_equal(want.block, got)
+
+
+def test_sharded_compact_block_falls_back_byte_equal(guard):
+    from dataclasses import replace
+
+    from pegasus_tpu.parallel import make_mesh, sharded_compact_block
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(17)
+    blocks = [make_block(_adversarial_records(rng, 250)) for _ in range(2)]
+    opts = CompactOptions(backend="tpu", now=100, bottommost=True)
+    fp.cfg("compact.device", "raise(collective wedged)")
+    got = sharded_compact_block(blocks, mesh, opts)
+    assert guard.state()["fallbacks"] >= 1
+    fp.cfg("compact.device", "off()")
+    want = compact_blocks(blocks, replace(opts, backend="cpu"))
+    _assert_byte_equal(want.block, got.block)
+
+
+# --------------------------------------------------- engine/service level
+
+
+@pytest.fixture
+def srv(tmp_path):
+    from pegasus_tpu.engine import EngineOptions
+    from pegasus_tpu.engine.server_impl import PegasusServer
+
+    s = PegasusServer(str(tmp_path / "db"),
+                      options=EngineOptions(backend="tpu"))
+    yield s
+    s.close()
+
+
+def _fill(srv, n=40):
+    from pegasus_tpu.base import key_schema
+
+    for i in range(n):
+        srv.engine.put(key_schema.generate_key(b"h", b"s%03d" % i),
+                       b"\x82" + b"\0" * 12 + b"v%d" % i)
+
+
+def test_manual_compact_survives_device_hang_and_reports(guard, srv):
+    """Acceptance end-to-end: a device hang during manual compaction is
+    abandoned at the deadline, the compaction completes via cpu fallback,
+    and the incident is visible in query_compact_state, device-health,
+    and /metrics."""
+    from pegasus_tpu.engine.manual_compact_service import ManualCompactService
+    from pegasus_tpu.ops.device_watchdog import WATCHDOG
+
+    guard.config.deadline_s = 0.25
+    guard.config.breaker_threshold = 99
+    _fill(srv)
+    svc = ManualCompactService(srv, mock_now=1000)
+    fp.cfg("compact.device", "sleep(1200)")
+    assert svc.start_manual_compact_if_needed(
+        {consts.MANUAL_COMPACT_ONCE_TRIGGER_TIME_KEY: "900"})
+    # the data survived, served identically
+    from pegasus_tpu.base import key_schema
+
+    assert srv.engine.get(key_schema.generate_key(b"h", b"s000"),
+                          now=50) is not None
+    fp.cfg("compact.device", "off()")
+    state = svc.query_compact_state()
+    assert "idle; last finish" in state
+    assert "cpu fallbacks:" in state
+    assert guard.state()["deadline_abandons"] >= 1
+    # device-health surfaces the lane guard state
+    health = WATCHDOG.state()
+    assert health["lane"]["fallbacks"] >= 1
+    # the trace session survived the guard's worker-thread hop: the run
+    # still records a per-stage breakdown
+    assert svc.last_trace and "sst_write" in svc.last_trace
+
+
+def test_failed_manual_compact_is_not_deduped_as_finished(guard, tmp_path):
+    """Satellite: a raising compaction must NOT persist finish state (the
+    once-trigger would be deduped as 'finished' and never retried); the
+    failure surfaces in query_compact_state, and re-delivering the same
+    trigger retries."""
+    from pegasus_tpu.engine import EngineOptions
+    from pegasus_tpu.engine.manual_compact_service import ManualCompactService
+    from pegasus_tpu.engine.server_impl import PegasusServer
+
+    s = PegasusServer(str(tmp_path / "db"),
+                      options=EngineOptions(backend="cpu"))
+    try:
+        _fill(s)
+        svc = ManualCompactService(s, mock_now=1000)
+        envs = {consts.MANUAL_COMPACT_ONCE_TRIGGER_TIME_KEY: "900"}
+        fp.cfg("engine.sst_write", "1*raise(injected disk failure)")
+        with pytest.raises(fp.FailPointError):
+            svc.start_manual_compact_if_needed(envs)
+        # finish state NOT recorded
+        assert "pegasus_last_manual_compact_finish_time" \
+            not in s.engine.meta_store
+        assert svc.last_finish_time_ms == 0
+        state = svc.query_compact_state()
+        assert "FAILED" in state and "disk failure" in state
+        # the SAME trigger retries now that the fault cleared
+        svc.set_mock_now(1100)
+        assert svc.start_manual_compact_if_needed(envs)
+        assert s.engine.meta_store[
+            "pegasus_last_manual_compact_finish_time"] == 1100
+        assert "FAILED" not in svc.query_compact_state()
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------------- CI wiring
+
+
+def test_fail_point_lint_clean():
+    """tools/check_fail_points.py wired into the test run: every
+    test-armed fail point exists in source, every source point is
+    documented in README."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_fail_points.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_bench_degraded_line_carries_lane_state():
+    """bench.py JSON: the degraded line's watchdog heartbeat includes the
+    lane guard state, so BENCH_r06+ can't report a cpu-fallback run as a
+    tpu number without the counters showing it."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PEGASUS_BENCH_N": "20000",
+                "PEGASUS_BENCH_REPS": "1",
+                "PEGASUS_BENCH_FAKE_LANE": "wedge",
+                "PEGASUS_BENCH_LANE_S": "4"})
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert proc.returncode == 0 and lines, proc.stderr[-500:]
+    line = json.loads(lines[-1])
+    assert line["value"] is None
+    assert line["detail"]["watchdog"]["wedged_at_stage"] == "device"

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fail-point cross-check lint (wired into the test run via
+tests/test_lane_guard.py):
+
+  1. every fail-point name ARMED in tests (``cfg("name", ...)``) must
+     exist as a hook in source (``fail_point("name")`` / ``inject(...)``/
+     ``_fail(...)`` / ``_inject(...)``) — a test arming a point that no
+     code evaluates silently tests nothing;
+  2. every fail-point hook in source must be DOCUMENTED in README.md
+     (the Robustness section's fail-point table) — chaos hooks nobody can
+     discover rot.
+
+Dynamic names (``fail_point(f"rpc.{code}")``) become prefix wildcards
+(``rpc.*``): a test may arm any name under the prefix, and the README
+must mention the prefix.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_CALL_RE = re.compile(
+    r"\b(?:fail_point|_fail|inject|_inject|_stage_fail)\(\s*(f?)\"([^\"]+)\"")
+_CFG_RE = re.compile(r"\bcfg\(\s*\"([^\"]+)\"")
+
+
+def _points_in(files) -> set:
+    names = set()
+    for p in files:
+        text = p.read_text()
+        for m in _CALL_RE.finditer(text):
+            name = m.group(2)
+            if m.group(1):  # f-string: every {expr} hole becomes a wildcard
+                name = re.sub(r"\{[^}]*\}", "*", name)
+            names.add(name)
+    return names
+
+
+def source_points() -> set:
+    return _points_in(list((REPO / "pegasus_tpu").rglob("*.py"))
+                      + [REPO / "bench.py"])
+
+
+def test_local_points() -> set:
+    """Hooks evaluated INSIDE tests (the fail-point mini-language unit
+    tests arm and evaluate throwaway names like 'p1' in the same file) —
+    legitimate, but they need no README documentation."""
+    return _points_in((REPO / "tests").rglob("*.py"))
+
+
+def test_armed_points() -> set:
+    names = set()
+    for p in (REPO / "tests").rglob("*.py"):
+        names.update(_CFG_RE.findall(p.read_text()))
+    return names
+
+
+def _matches(name: str, source: set) -> bool:
+    if name in source:
+        return True
+    return any(s.endswith("*") and name.startswith(s[:-1])
+               for s in source)
+
+
+def run_lint() -> list:
+    """-> list of error strings (empty = clean)."""
+    src = source_points()
+    armed = test_armed_points()
+    hooks = src | test_local_points()
+    readme = (REPO / "README.md").read_text()
+    errors = []
+    for name in sorted(armed):
+        if not _matches(name, hooks):
+            errors.append(
+                f"tests arm fail point {name!r} but no source hook "
+                f"evaluates it (known: {sorted(hooks)})")
+    for name in sorted(src):
+        probe = name.split("*")[0] if "*" in name else name
+        if probe not in readme:
+            errors.append(
+                f"source fail point {name!r} is undocumented — add it to "
+                f"README.md's Robustness fail-point table")
+    return errors
+
+
+def main() -> int:
+    errors = run_lint()
+    for e in errors:
+        print(f"check_fail_points: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_fail_points: OK "
+              f"({len(source_points())} source hooks, "
+              f"{len(test_armed_points())} test-armed names)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
