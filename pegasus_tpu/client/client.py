@@ -85,6 +85,15 @@ class PegasusClient:
         return pidx, h
 
     def _call(self, code: str, pidx: int, phash: int, req_obj, resp_cls):
+        # every client op opens (or joins) a request trace: the context
+        # rides the RPC header from here down through replication and the
+        # engine (runtime/tracing.py RequestTracer)
+        from ..runtime.tracing import REQUEST_TRACER
+
+        with REQUEST_TRACER.root(code):
+            return self._call_traced(code, pidx, phash, req_obj, resp_cls)
+
+    def _call_traced(self, code, pidx, phash, req_obj, resp_cls):
         body = codec.encode(req_obj)
         last = None
         for attempt in range(3):

@@ -7,11 +7,16 @@ scrapes every replica node's perf counters via the `perf-counters` remote
 command, aggregates per-app row stats, republishes them as
 `collector.app.<name>.*` counters, and runs the sigma-based hotspot
 analysis over per-partition QPS — partitions more than 3 standard
-deviations above the mean are flagged (and can be fed to detect_hotkey).
+deviations above the mean are flagged, and a partition that stays flagged
+for `hotkey_rounds` consecutive rounds automatically gets the
+detect_hotkey start/query/stop sequence driven against its primary, the
+verdict republished as `collector.app.<name>.hotkey.*` counters (the
+closed hotspot loop).
 """
 
 import json
 import threading
+import time
 
 from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG
@@ -22,7 +27,8 @@ from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
 
 
 class InfoCollector:
-    def __init__(self, meta_addrs, interval_seconds: float = 10.0):
+    def __init__(self, meta_addrs, interval_seconds: float = 10.0,
+                 hotkey_rounds: int = 3, hotkey_query_limit: int = 8):
         self.meta_addrs = list(meta_addrs)
         self.interval = interval_seconds
         self.pool = ConnectionPool()
@@ -32,6 +38,15 @@ class InfoCollector:
         self.app_stats = {}  # app_name -> aggregated dict
         self.compact_stats = {}  # cluster-summed compact.*/engine.* counters
         self._cluster_published = set()  # gauge names set last round
+        # closed hotspot loop: a partition flagged hotkey_rounds CONSECUTIVE
+        # rounds gets an automatic detect_hotkey start/query/stop sequence
+        # against its primary; the verdict republishes as
+        # collector.app.<name>.hotkey.* counters + self.hotkey_results
+        self.hotkey_rounds = hotkey_rounds
+        self.hotkey_query_limit = hotkey_query_limit
+        self._hot_streak = {}      # (app_name, pidx) -> consecutive rounds
+        self._detections = {}      # (app_name, pidx) -> in-flight state
+        self.hotkey_results = {}   # app_name -> {pidx: {"kind","key","ts"}}
 
     def start(self):
         self._thread.start()
@@ -65,11 +80,15 @@ class InfoCollector:
                 last = e
         raise last
 
-    def scrape_node(self, addr: str, prefix: str = "") -> dict:
-        req = RemoteCommandRequest("perf-counters-by-prefix", [prefix])
+    def remote_command(self, addr: str, command: str, args) -> str:
+        """Raw remote-command invocation against one node."""
+        req = RemoteCommandRequest(command, list(args))
         body = self._call(addr, "RPC_CLI_CLI_CALL", req)
-        out = codec.decode(RemoteCommandResponse, body)
-        return json.loads(out.output)
+        return codec.decode(RemoteCommandResponse, body).output
+
+    def scrape_node(self, addr: str, prefix: str = "") -> dict:
+        return json.loads(self.remote_command(
+            addr, "perf-counters-by-prefix", [prefix]))
 
     def collect_compact_stats(self, nodes) -> dict:
         """Sum every node's compaction-pipeline telemetry (compact.* stage
@@ -85,7 +104,15 @@ class InfoCollector:
                 except (RpcError, OSError, ValueError):
                     continue
                 for name, v in snap.items():
-                    agg[name] = agg.get(name, 0.0) + float(v)
+                    if isinstance(v, dict):
+                        # percentile counters export {p50..p999}: flatten
+                        # to <name>.<q>; MAX across nodes — a cluster-wide
+                        # latency quantile is "the worst node", never a sum
+                        for q, qv in v.items():
+                            key = f"{name}.{q}"
+                            agg[key] = max(agg.get(key, 0.0), float(qv))
+                    else:
+                        agg[name] = agg.get(name, 0.0) + float(v)
         for name, v in agg.items():
             counters.number(f"collector.cluster.{name}").set(v)
         # a counter that stops being reported (node restarted, scrape
@@ -107,6 +134,7 @@ class InfoCollector:
                                   mm.QueryConfigRequest(app.app_name),
                                   mm.QueryConfigResponse)
             per_partition_qps = {}
+            read_qps, write_qps = {}, {}  # pidx splits for the hotkey kind
             agg = {"get_qps": 0.0, "put_qps": 0.0, "multi_get_qps": 0.0,
                    "scan_qps": 0.0, "recent_read_cu": 0.0,
                    "recent_write_cu": 0.0,
@@ -114,7 +142,9 @@ class InfoCollector:
                    # recent_*_throttling_*_count, info_collector.h:73-81)
                    "recent_write_throttling_delay_count": 0.0,
                    "recent_write_throttling_reject_count": 0.0}
-            nodes = {pc.primary for pc in cfg.partitions if pc.primary}
+            primaries = {pc.pidx: pc.primary for pc in cfg.partitions
+                         if pc.primary}
+            nodes = set(primaries.values())
             all_nodes |= nodes
             for node in nodes:
                 try:
@@ -122,6 +152,8 @@ class InfoCollector:
                 except (RpcError, OSError, ValueError):
                     continue
                 for name, v in snap.items():
+                    if isinstance(v, dict):  # percentile counters: not qps
+                        continue
                     # app.<id>.<pidx>.<counter>
                     parts = name.split(".")
                     if len(parts) < 4:
@@ -131,13 +163,119 @@ class InfoCollector:
                         agg[cname] += v
                     if cname in ("get_qps", "put_qps", "multi_get_qps"):
                         per_partition_qps[pidx] = per_partition_qps.get(pidx, 0.0) + v
+                        split = write_qps if cname == "put_qps" else read_qps
+                        split[pidx] = split.get(pidx, 0.0) + v
             for cname, v in agg.items():
                 counters.number(f"collector.app.{app.app_name}.{cname}").set(v)
-            self.hotspots[app.app_name] = hotspot_partitions(per_partition_qps)
+            flagged = hotspot_partitions(per_partition_qps)
+            self.hotspots[app.app_name] = flagged
+            self.drive_hotkey_loop(app.app_name, app.app_id, flagged,
+                                   primaries, read_qps, write_qps)
             summary[app.app_name] = agg
         self.collect_compact_stats(all_nodes)
         self.app_stats = summary
         return summary
+
+
+    # ------------------------------------------------- closed hotspot loop
+
+    def drive_hotkey_loop(self, app_name: str, app_id: int, flagged: list,
+                          primaries: dict, read_qps: dict = None,
+                          write_qps: dict = None) -> None:
+        """The hotspot verdict used to dead-end in a docstring ("can be fed
+        to detect_hotkey"); now it IS fed: a partition flagged
+        `hotkey_rounds` consecutive rounds gets detect_hotkey started on
+        its primary (read or write kind by whichever QPS dominates), every
+        later round queries it, and a FINISHED verdict is republished as
+        collector.app.<name>.hotkey.* counters + self.hotkey_results
+        before the detection is stopped. Scrape failures skip a round, the
+        detection survives."""
+        read_qps, write_qps = read_qps or {}, write_qps or {}
+        flagged_set = set(flagged)
+        # streak bookkeeping: consecutive rounds flagged, reset when calm
+        for pidx in flagged_set:
+            self._hot_streak[(app_name, pidx)] = \
+                self._hot_streak.get((app_name, pidx), 0) + 1
+        for key in [k for k in self._hot_streak
+                    if k[0] == app_name and k[1] not in flagged_set]:
+            del self._hot_streak[key]
+        # a published verdict gauge must clear once the partition calms
+        # (the streak entry is gone by then — key off the verdicts, or a
+        # fixed hot key would page as hot forever)
+        for pidx in self.hotkey_results.get(app_name, {}):
+            if pidx not in flagged_set and (app_name, pidx) not in self._detections:
+                counters.number(
+                    f"collector.app.{app_name}.hotkey.{pidx}.hot").set(0)
+        # start a detection once the streak proves the hotspot persistent
+        for pidx in sorted(flagged_set):
+            key = (app_name, pidx)
+            if (self._hot_streak.get(key, 0) < self.hotkey_rounds
+                    or key in self._detections or pidx not in primaries):
+                continue
+            kind = ("write" if write_qps.get(pidx, 0.0)
+                    > read_qps.get(pidx, 0.0) else "read")
+            gpid = f"{app_id}.{pidx}"
+            try:
+                out = self.remote_command(primaries[pidx], "detect_hotkey",
+                                          [gpid, kind, "start"])
+            except (RpcError, OSError):
+                continue
+            if "started" in out:
+                self._detections[key] = {"node": primaries[pidx],
+                                         "gpid": gpid, "kind": kind,
+                                         "queries": 0}
+                counters.rate(
+                    f"collector.app.{app_name}.hotkey.detections_started"
+                ).increment()
+        # query in-flight detections; republish + stop on a verdict
+        for key, det in [(k, d) for k, d in self._detections.items()
+                         if k[0] == app_name]:
+            pidx = key[1]
+            if primaries.get(pidx, det["node"]) != det["node"]:
+                # primary moved: the detector state died with the old
+                # node — abandon so a fresh streak can restart detection
+                # against the new primary
+                self._finish_detection(key, det)
+                continue
+            try:
+                out = self.remote_command(det["node"], "detect_hotkey",
+                                          [det["gpid"], det["kind"], "query"])
+            except (RpcError, OSError):
+                # an unreachable node must not pin the detection forever:
+                # failed rounds count against the same query budget
+                det["queries"] += 1
+                if det["queries"] > self.hotkey_query_limit:
+                    self._finish_detection(key, det)
+                continue
+            if "hotkey:" in out:
+                hotkey = out.split("hotkey:", 1)[1].strip()
+                self.hotkey_results.setdefault(app_name, {})[pidx] = {
+                    "kind": det["kind"], "key": hotkey,
+                    "ts": time.time()}
+                counters.rate(
+                    f"collector.app.{app_name}.hotkey.found_count").increment()
+                counters.number(
+                    f"collector.app.{app_name}.hotkey.{pidx}.hot").set(1)
+                self._finish_detection(key, det)
+            elif "STOPPED" in out:    # detector timed out without an outlier
+                self._finish_detection(key, det, stop=False)
+            else:
+                det["queries"] += 1
+                if det["queries"] > self.hotkey_query_limit:
+                    self._finish_detection(key, det)
+        counters.number(
+            f"collector.app.{app_name}.hotkey.active_detections").set(
+            sum(1 for k in self._detections if k[0] == app_name))
+
+    def _finish_detection(self, key, det, stop: bool = True) -> None:
+        self._detections.pop(key, None)
+        self._hot_streak.pop(key, None)
+        if stop:
+            try:
+                self.remote_command(det["node"], "detect_hotkey",
+                                    [det["gpid"], det["kind"], "stop"])
+            except (RpcError, OSError):
+                pass
 
 
 def hotspot_partitions(per_partition_qps: dict, sigmas: float = 3.0) -> list:

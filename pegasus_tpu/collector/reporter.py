@@ -17,13 +17,24 @@ from ..runtime.perf_counters import counters
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _flatten(snap: dict):
+    """Yield (name, float) pairs; percentile counters snapshot as a
+    {p50..p999} dict and flatten to `<name>.<quantile>` series."""
+    for name, value in sorted(snap.items()):
+        if isinstance(value, dict):
+            for q, v in value.items():
+                yield f"{name}.{q}", float(v)
+        else:
+            yield name, float(value)
+
+
 def prometheus_text(snapshot: dict = None) -> str:
     snap = counters.snapshot() if snapshot is None else snapshot
     lines = []
-    for name, value in sorted(snap.items()):
+    for name, value in _flatten(snap):
         metric = _NAME_RE.sub("_", name)
         lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {float(value)}")
+        lines.append(f"{metric} {value}")
     return "\n".join(lines) + "\n"
 
 
@@ -31,9 +42,9 @@ def falcon_payload(endpoint: str, snapshot: dict = None) -> str:
     """Falcon push body (list of metric dicts), reference
     pegasus_counter_reporter.cpp falcon_gauge JSON shape."""
     snap = counters.snapshot() if snapshot is None else snapshot
-    out = [{"endpoint": endpoint, "metric": name, "value": float(v),
+    out = [{"endpoint": endpoint, "metric": name, "value": v,
             "step": 60, "counterType": "GAUGE", "tags": ""}
-           for name, v in sorted(snap.items())]
+           for name, v in _flatten(snap)]
     return json.dumps(out)
 
 

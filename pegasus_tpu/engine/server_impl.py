@@ -17,6 +17,7 @@ from ..base import consts, key_schema
 from ..base.utils import epoch_now
 from ..base.value_schema import SCHEMAS
 from ..runtime.perf_counters import counters
+from ..runtime.tracing import REQUEST_TRACER
 from ..rpc import messages as msg
 from ..rpc.messages import FilterType, Status, match_filter
 from .db import EngineOptions, LsmEngine
@@ -29,6 +30,15 @@ from ..rpc.task_codes import (BATCHABLE, RPC_BULK_LOAD_INGEST,  # noqa: F401
                               RPC_CHECK_AND_MUTATE, RPC_CHECK_AND_SET,
                               RPC_DUPLICATE, RPC_INCR, RPC_MULTI_PUT,
                               RPC_MULTI_REMOVE, RPC_PUT, RPC_REMOVE)
+
+# short op names for the per-partition qps + latency counter pairs
+# (app.<id>.<pidx>.<op>_qps / <op>_latency_us — write-path latency parity
+# with the read handlers' get/multi_get percentiles)
+_OP_NAMES = {RPC_PUT: "put", RPC_REMOVE: "remove",
+             RPC_MULTI_PUT: "multi_put", RPC_MULTI_REMOVE: "multi_remove",
+             RPC_INCR: "incr", RPC_CHECK_AND_SET: "check_and_set",
+             RPC_CHECK_AND_MUTATE: "check_and_mutate",
+             RPC_DUPLICATE: "duplicate", RPC_BULK_LOAD_INGEST: "bulk_load"}
 
 
 def _hk_hash32(hash_key: bytes):
@@ -231,54 +241,62 @@ class PegasusServer:
             return [self._dispatch_single(decree, timestamp_us, code, req, now)]
         # batch path: only batchable codes may be grouped (the reference
         # asserts non-batchable codes never arrive in a multi-request batch)
+        t0 = time.perf_counter()
         responses = []
         ws = self.write_service
-        ws.batch_prepare()
-        for code, req in requests:
-            if code == RPC_PUT:
-                ws.batch_put(req, timestamp_us)
-                responses.append(ws._fill(msg.UpdateResponse(), decree))
-                counters.rate(self._pfx + "put_qps").increment()
-            elif code == RPC_REMOVE:
-                ws.batch_remove(req.key)
-                responses.append(ws._fill(msg.UpdateResponse(), decree))
-                counters.rate(self._pfx + "remove_qps").increment()
-            else:
-                ws.batch_abort()
-                raise ValueError(f"non-batchable code {code} in batched request")
-        ws.batch_commit(decree)
+        with REQUEST_TRACER.span("engine.apply", decree=decree,
+                                 batch=len(requests)):
+            ws.batch_prepare()
+            for code, req in requests:
+                if code == RPC_PUT:
+                    ws.batch_put(req, timestamp_us)
+                    responses.append(ws._fill(msg.UpdateResponse(), decree))
+                    counters.rate(self._pfx + "put_qps").increment()
+                elif code == RPC_REMOVE:
+                    ws.batch_remove(req.key)
+                    responses.append(ws._fill(msg.UpdateResponse(), decree))
+                    counters.rate(self._pfx + "remove_qps").increment()
+                else:
+                    ws.batch_abort()
+                    raise ValueError(
+                        f"non-batchable code {code} in batched request")
+            ws.batch_commit(decree)
+        # group-committed put/remove share the batch's engine latency:
+        # they hit the engine as ONE write, so that is their apply cost
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        for op in {_OP_NAMES[code] for code, _ in requests}:
+            counters.percentile(self._pfx + f"{op}_latency_us").set(elapsed_us)
         return responses
 
     def _dispatch_single(self, decree, timestamp_us, code, req, now=None):
+        op = _OP_NAMES.get(code)
+        if op is None:
+            raise ValueError(f"unknown write code {code}")
+        counters.rate(self._pfx + f"{op}_qps").increment()
         ws = self.write_service
-        if code == RPC_PUT:
-            counters.rate(self._pfx + "put_qps").increment()
-            return ws.put(decree, req, timestamp_us)
-        if code == RPC_REMOVE:
-            counters.rate(self._pfx + "remove_qps").increment()
-            return ws.remove(decree, req.key)
-        if code == RPC_MULTI_PUT:
-            counters.rate(self._pfx + "multi_put_qps").increment()
-            return ws.multi_put(decree, req, timestamp_us)
-        if code == RPC_MULTI_REMOVE:
-            counters.rate(self._pfx + "multi_remove_qps").increment()
-            return ws.multi_remove(decree, req)
-        if code == RPC_INCR:
-            counters.rate(self._pfx + "incr_qps").increment()
-            return ws.incr(decree, req, now=now)
-        if code == RPC_CHECK_AND_SET:
-            counters.rate(self._pfx + "check_and_set_qps").increment()
-            return ws.check_and_set(decree, req, now=now)
-        if code == RPC_CHECK_AND_MUTATE:
-            counters.rate(self._pfx + "check_and_mutate_qps").increment()
-            return ws.check_and_mutate(decree, req, now=now)
-        if code == RPC_DUPLICATE:
-            counters.rate(self._pfx + "duplicate_qps").increment()
-            return ws.duplicate(decree, req, now=now)
-        if code == RPC_BULK_LOAD_INGEST:
-            counters.rate(self._pfx + "bulk_load_qps").increment()
-            return ws.ingestion_files(decree, req)
-        raise ValueError(f"unknown write code {code}")
+        t0 = time.perf_counter()
+        with REQUEST_TRACER.span("engine.apply", decree=decree, op=op):
+            if code == RPC_PUT:
+                resp = ws.put(decree, req, timestamp_us)
+            elif code == RPC_REMOVE:
+                resp = ws.remove(decree, req.key)
+            elif code == RPC_MULTI_PUT:
+                resp = ws.multi_put(decree, req, timestamp_us)
+            elif code == RPC_MULTI_REMOVE:
+                resp = ws.multi_remove(decree, req)
+            elif code == RPC_INCR:
+                resp = ws.incr(decree, req, now=now)
+            elif code == RPC_CHECK_AND_SET:
+                resp = ws.check_and_set(decree, req, now=now)
+            elif code == RPC_CHECK_AND_MUTATE:
+                resp = ws.check_and_mutate(decree, req, now=now)
+            elif code == RPC_DUPLICATE:
+                resp = ws.duplicate(decree, req, now=now)
+            else:
+                resp = ws.ingestion_files(decree, req)
+        counters.percentile(self._pfx + f"{op}_latency_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+        return resp
 
     # ------------------------------------------------------------- read path
 

@@ -12,6 +12,7 @@ rejected mutations (empty_put), preserving the last_flushed_decree invariant.
 from ..base import key_schema
 from ..base.utils import epoch_now
 from ..base.value_schema import SCHEMAS, check_if_ts_expired, generate_timetag
+from ..runtime.tracing import REQUEST_TRACER
 from ..rpc import messages as msg, task_codes
 from ..rpc.messages import CasCheckType, MutateOperation, Status
 from .db import LsmEngine, WriteBatch
@@ -60,6 +61,13 @@ class WriteService:
     def _hk(self, key: bytes) -> bytes:
         return key_schema.restore_key(key)[0]
 
+    def _engine_write(self, batch, decree: int) -> None:
+        """All mutations reach the engine through here so the request
+        trace separates engine-write time from the surrounding
+        read-modify-write (incr/CAS read the old value first)."""
+        with REQUEST_TRACER.span("engine.write", decree=decree):
+            self.engine.write(batch, decree)
+
     # ----------------------------------------------------------- helpers
 
     def _fill(self, resp, decree):
@@ -88,7 +96,7 @@ class WriteService:
     def empty_put(self, decree: int):
         """Advance last_flushed_decree with no data mutation
         (src/server/pegasus_write_service.cpp empty_put)."""
-        self.engine.write(WriteBatch(), decree)
+        self._engine_write(WriteBatch(), decree)
         return Status.OK
 
     # ------------------------------------------------------------ writes
@@ -96,14 +104,14 @@ class WriteService:
     def put(self, decree: int, req: msg.UpdateRequest, timestamp_us: int = 0):
         resp = self._fill(msg.UpdateResponse(), decree)
         value = self._encode(req.value, req.expire_ts_seconds, timestamp_us)
-        self.engine.write(WriteBatch().put(req.key, value, req.expire_ts_seconds), decree)
+        self._engine_write(WriteBatch().put(req.key, value, req.expire_ts_seconds), decree)
         if self.cu_calculator:
             self.cu_calculator.add_put_cu(self._hk(req.key), req.key, req.value)
         return resp
 
     def remove(self, decree: int, key: bytes):
         resp = self._fill(msg.UpdateResponse(), decree)
-        self.engine.write(WriteBatch().delete(key), decree)
+        self._engine_write(WriteBatch().delete(key), decree)
         if self.cu_calculator:
             self.cu_calculator.add_remove_cu(self._hk(key), key)
         return resp
@@ -121,7 +129,7 @@ class WriteService:
             value = self._encode(kv.value, req.expire_ts_seconds, timestamp_us)
             batch.put(key, value, req.expire_ts_seconds)
             total += len(key) + len(kv.value)
-        self.engine.write(batch, decree)
+        self._engine_write(batch, decree)
         if self.cu_calculator:
             self.cu_calculator.add_multi_put_cu(req.hash_key, req.kvs)
         return resp
@@ -137,7 +145,7 @@ class WriteService:
         for sk in req.sort_keys:
             batch.delete(key_schema.generate_key(req.hash_key, sk))
             total += len(req.hash_key) + len(sk)
-        self.engine.write(batch, decree)
+        self._engine_write(batch, decree)
         if self.cu_calculator:
             self.cu_calculator.add_multi_remove_cu(req.hash_key, req.sort_keys)
         resp.count = len(req.sort_keys)
@@ -175,7 +183,7 @@ class WriteService:
             else:
                 new_expire = req.expire_ts_seconds
         value = self._encode(str(new_value).encode(), new_expire)
-        self.engine.write(WriteBatch().put(req.key, value, new_expire), decree)
+        self._engine_write(WriteBatch().put(req.key, value, new_expire), decree)
         if self.cu_calculator:  # RMW: read CU for the old value + write CU
             self.cu_calculator.add_incr_cu(self._hk(req.key), req.key)
         resp.new_value = new_value
@@ -209,7 +217,7 @@ class WriteService:
         set_sk = req.set_sort_key if req.set_diff_sort_key else req.check_sort_key
         set_key = key_schema.generate_key(req.hash_key, set_sk)
         value = self._encode(req.set_value, req.set_expire_ts_seconds)
-        self.engine.write(
+        self._engine_write(
             WriteBatch().put(set_key, value, req.set_expire_ts_seconds), decree
         )
         if self.cu_calculator:  # RMW: the check read charges read CU too
@@ -257,7 +265,7 @@ class WriteService:
             else:
                 batch.delete(key)
                 total += len(key)
-        self.engine.write(batch, decree)
+        self._engine_write(batch, decree)
         if self.cu_calculator:  # RMW: the check read charges read CU too
             self.cu_calculator.add_check_and_mutate_cu(
                 req.hash_key, req.check_sort_key, total, len(req.mutate_list))
@@ -313,10 +321,11 @@ class WriteService:
         if req.task_code == task_codes.RPC_PUT:
             value = self._encode_with_origin(inner.value, inner.expire_ts_seconds,
                                              req.timestamp, req.cluster_id, False)
-            self.engine.write(WriteBatch().put(inner.key, value,
-                                               inner.expire_ts_seconds), decree)
+            self._engine_write(WriteBatch().put(inner.key, value,
+                                                inner.expire_ts_seconds),
+                               decree)
         elif req.task_code == task_codes.RPC_REMOVE:
-            self.engine.write(WriteBatch().delete(inner.key), decree)
+            self._engine_write(WriteBatch().delete(inner.key), decree)
         elif req.task_code == task_codes.RPC_MULTI_PUT:
             batch = WriteBatch()
             for kv in inner.kvs:
@@ -325,12 +334,12 @@ class WriteService:
                                                  req.timestamp, req.cluster_id,
                                                  False)
                 batch.put(key, value, inner.expire_ts_seconds)
-            self.engine.write(batch, decree)
+            self._engine_write(batch, decree)
         elif req.task_code == task_codes.RPC_MULTI_REMOVE:
             batch = WriteBatch()
             for sk in inner.sort_keys:
                 batch.delete(key_schema.generate_key(inner.hash_key, sk))
-            self.engine.write(batch, decree)
+            self._engine_write(batch, decree)
         else:
             # read-modify-write codes re-run locally (incr/CAS duplicate as
             # their effect is deterministic given the shipped arguments)
@@ -361,7 +370,7 @@ class WriteService:
 
     def batch_commit(self, decree: int):
         batch, self._batch = self._batch, None
-        self.engine.write(batch, decree)
+        self._engine_write(batch, decree)
         return Status.OK
 
     def batch_abort(self):

@@ -43,11 +43,14 @@ the recovery story needs it.
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import List
 
 from ..rpc import codec
+from ..runtime.perf_counters import counters
+from ..runtime.tracing import REQUEST_TRACER
 
 _FRAME = struct.Struct("<II")
 
@@ -87,7 +90,9 @@ class MutationLog:
     def append(self, m: LogMutation) -> None:
         payload = codec.encode(m)
         frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
+        t0 = time.perf_counter()
+        with REQUEST_TRACER.span("plog.append", decree=m.decree,
+                                 bytes=len(frame)), self._lock:
             if self._file is None or self._file_bytes >= self.segment_bytes:
                 self._roll_locked(m.decree)
             self._file.write(frame)
@@ -96,6 +101,10 @@ class MutationLog:
                 os.fsync(self._file.fileno())
             self._file_bytes += len(frame)
             self.last_decree = max(self.last_decree, m.decree)
+        counters.rate("plog.append.count").increment()
+        counters.rate("plog.append.bytes").increment(len(frame))
+        counters.percentile("plog.append.duration_us").set(
+            int((time.perf_counter() - t0) * 1e6))
 
     def _roll_locked(self, start_decree: int) -> None:
         if self._file:

@@ -30,6 +30,8 @@ from ..engine import EngineOptions
 from ..engine.replica_service import WRITE_CODES
 from ..engine.server_impl import PegasusServer
 from ..rpc import codec
+from ..runtime.perf_counters import counters
+from ..runtime.tracing import REQUEST_TRACER
 from .mutation_log import LogMutation, MutationLog
 
 def _parallel_prepare() -> bool:
@@ -209,25 +211,53 @@ class Replica:
                         timestamp_us=int(time.time() * 1e6),
                         codes=[c for c, _ in reqs],
                         bodies=[codec.encode(r) for _, r in reqs])
-        self.plog.append(m)
-        self.last_prepared = decree
-        self._uncommitted[decree] = m
-        acks = 1
-        secs = list(self.view.secondaries)
-        if len(secs) > 1 and _parallel_prepare():
-            # prepares fan out concurrently: commit latency is max(peer RTT),
-            # not the sum (the reference's parallel RPC_PREPARE sends).
-            # Wait for ALL so per-peer prepare order stays monotonic.
-            futs = [self._prepare_pool().submit(self._send_prepare, s, m)
-                    for s in secs]
-            acks += sum(1 for f in futs if f.result())
-        else:
-            acks += sum(1 for s in secs if self._send_prepare(s, m))
+        t0 = time.perf_counter()
+        with REQUEST_TRACER.span("replica.prepare", decree=decree,
+                                 batch=len(reqs)):
+            self.plog.append(m)
+            self.last_prepared = decree
+            self._uncommitted[decree] = m
+            acks = 1
+            secs = list(self.view.secondaries)
+            if len(secs) > 1 and _parallel_prepare():
+                # prepares fan out concurrently: commit latency is
+                # max(peer RTT), not the sum (the reference's parallel
+                # RPC_PREPARE sends). Wait for ALL so per-peer prepare
+                # order stays monotonic. The trace context is thread-local
+                # — each worker adopts it so the peers' prepare spans (and
+                # the trace_id on the wire) survive the pool hop.
+                ctx = REQUEST_TRACER.current()
+
+                def send(s):
+                    with REQUEST_TRACER.adopt(ctx):
+                        return self._send_prepare(s, m)
+
+                futs = [self._prepare_pool().submit(send, s) for s in secs]
+                acks += sum(1 for f in futs if f.result())
+            else:
+                acks += sum(1 for s in secs if self._send_prepare(s, m))
+        counters.percentile("replica.prepare_latency_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+        self._export_gauges()
         if acks < self.quorum:
             # cannot commit; leave prepared (a later view change decides)
             raise ReplicaError(
                 f"quorum lost: {acks}/{self.quorum} for decree {decree}")
-        return self._apply_up_to(decree, now=now)
+        t1 = time.perf_counter()
+        with REQUEST_TRACER.span("replica.commit", decree=decree):
+            resps = self._apply_up_to(decree, now=now)
+        counters.percentile("replica.commit_latency_us").set(
+            int((time.perf_counter() - t1) * 1e6))
+        self._export_gauges()
+        return resps
+
+    def _export_gauges(self):
+        """Per-partition write-path pressure: slots queued for the next
+        group commit (inflight) and prepared-but-uncommitted decrees
+        (backlog) — the two queues a slow quorum round backs up into."""
+        pfx = f"replica.{self.app_id}.{self.pidx}."
+        counters.number(pfx + "inflight").set(len(self._batch_pending))
+        counters.number(pfx + "backlog").set(len(self._uncommitted))
 
     def _send_prepare(self, peer_name: str, m: LogMutation) -> bool:
         try:
@@ -257,7 +287,8 @@ class Replica:
     # ------------------------------------------------------------ secondary
 
     def on_prepare(self, ballot: int, m: LogMutation, committed_decree: int):
-        with self._lock:
+        with REQUEST_TRACER.span("replica.on_prepare", decree=m.decree), \
+                self._lock:
             if ballot < self.ballot:
                 raise PrepareRejected("stale_ballot", self.last_prepared)
             self.ballot = ballot

@@ -19,9 +19,13 @@ import socket
 import socketserver
 import struct
 import threading
+import time
+from contextlib import nullcontext
 from dataclasses import dataclass
 
 from . import codec
+from ..runtime.perf_counters import counters
+from ..runtime.tracing import REQUEST_TRACER, TraceContext
 
 
 # rDSN-style error codes carried at the RPC layer (engine-level status stays
@@ -48,6 +52,12 @@ class RpcHeader:
     error: int = 0          # response-only: rpc-level error
     error_text: str = ""
     is_response: bool = False
+    # request tracing (runtime/tracing.py RequestTracer): the caller's
+    # trace context rides every request frame; 0 = untraced. Appended
+    # last per the codec's append-only evolution rule, so frames from an
+    # older encoder still decode (the fields default).
+    trace_id: int = 0
+    trace_sampled: bool = False
 
 
 class RpcError(Exception):
@@ -203,21 +213,33 @@ class RpcServer:
     def _serve_one(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
         resp = RpcHeader(seq=header.seq, code=header.code, is_response=True)
         out = b""
-        try:
-            fn = self._handlers.get(header.code)
-            if fn is None:
-                resp.error = ERR_HANDLER_NOT_FOUND
-                resp.error_text = header.code
-            else:
-                call = fn
-                for mw in reversed(self._middlewares):
-                    call = (lambda h, b, _mw=mw, _next=call:
-                            _mw(h.code, h, b, _next))
-                out = call(header, body)
-        except RpcError as e:
-            resp.error, resp.error_text = e.err, e.text
-        except Exception as e:  # handler bug -> error, not a dead connection
-            resp.error, resp.error_text = ERR_INVALID_DATA, repr(e)
+        t0 = time.perf_counter()
+        # adopt the caller's trace context for the handler's whole stack
+        # (replication, plog, engine spans all land in the same trace)
+        scope = (REQUEST_TRACER.serve(
+            TraceContext(header.trace_id, header.trace_sampled, remote=True),
+            header.code) if header.trace_id else nullcontext())
+        with scope:
+            try:
+                fn = self._handlers.get(header.code)
+                if fn is None:
+                    resp.error = ERR_HANDLER_NOT_FOUND
+                    resp.error_text = header.code
+                else:
+                    call = fn
+                    for mw in reversed(self._middlewares):
+                        call = (lambda h, b, _mw=mw, _next=call:
+                                _mw(h.code, h, b, _next))
+                    out = call(header, body)
+            except RpcError as e:
+                resp.error, resp.error_text = e.err, e.text
+            except Exception as e:  # handler bug -> error, not a dead connection
+                resp.error, resp.error_text = ERR_INVALID_DATA, repr(e)
+        counters.rate("rpc.server.qps").increment()
+        counters.percentile("rpc.server.latency_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+        if resp.error:
+            counters.rate("rpc.server.error_count").increment()
         try:
             _send_frame(sock, resp, out, lock=wlock)
         except (ConnectionError, OSError):
@@ -278,20 +300,24 @@ class RpcConnection:
             ev = self._ev_pool.pop() if self._ev_pool else threading.Event()
             slot = []
             self._pending[seq] = (ev, slot)
+        ctx = REQUEST_TRACER.current()
         header = RpcHeader(seq=seq, code=code, app_id=app_id,
                            partition_index=partition_index,
-                           partition_hash=partition_hash)
-        try:
-            _send_frame(self._sock, header, body, lock=self._wlock)
-        except (ConnectionError, OSError) as e:
-            with self._plock:
-                self._pending.pop(seq, None)
-            raise RpcError(ERR_NETWORK_FAILURE, str(e))
-        if not ev.wait(timeout):
-            # do NOT recycle: the reader may still set this event later
-            with self._plock:
-                self._pending.pop(seq, None)
-            raise RpcError(ERR_TIMEOUT, f"{code} after {timeout}s")
+                           partition_hash=partition_hash,
+                           trace_id=ctx.trace_id if ctx else 0,
+                           trace_sampled=bool(ctx and ctx.sampled))
+        with REQUEST_TRACER.span(f"rpc.{code}", bytes=len(body)):
+            try:
+                _send_frame(self._sock, header, body, lock=self._wlock)
+            except (ConnectionError, OSError) as e:
+                with self._plock:
+                    self._pending.pop(seq, None)
+                raise RpcError(ERR_NETWORK_FAILURE, str(e))
+            if not ev.wait(timeout):
+                # do NOT recycle: the reader may still set this event later
+                with self._plock:
+                    self._pending.pop(seq, None)
+                raise RpcError(ERR_TIMEOUT, f"{code} after {timeout}s")
         if not slot or slot[0] is None:
             raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
         rh, rbody = slot[0]

@@ -47,20 +47,41 @@ class VolatileCounter(Counter):
 
 
 class RateCounter(Counter):
-    """Events per second since the last read."""
+    """Events per second over a rolling window. Reads are NON-destructive:
+    the destructive reset-on-read design meant concurrent scrapers
+    (/metrics, remote commands, the info collector) each stole a fraction
+    of the window and all reported a fraction of the true rate. Instead
+    the counter accumulates into a timestamped window; a read rolls the
+    window only once it is at least MIN_WINDOW old and republishes the
+    finished window's rate until the next roll — so any number of
+    concurrent scrapers observe the same value."""
 
     KIND = "rate"
+    MIN_WINDOW = 1.0  # seconds a window must cover before it can roll
 
     def __init__(self, name: str):
         super().__init__(name)
-        self._last_read = time.monotonic()
+        self._window_start = time.monotonic()
+        self._last_rate = 0.0
+        self._rolled = False
 
     def value(self):
         with self._lock:
             now = time.monotonic()
-            dt = max(now - self._last_read, 1e-9)
-            v, self._value, self._last_read = self._value, 0, now
-            return v / dt
+            dt = now - self._window_start
+            if dt >= self.MIN_WINDOW:
+                self._last_rate = self._value / dt
+                self._value = 0
+                self._window_start = now
+                self._rolled = True
+            elif not self._rolled and self._value:
+                # no window ever completed (freshly started process):
+                # report the partial window instead of 0. ONLY then — an
+                # idle-then-burst transition must keep publishing finished
+                # windows, or a scrape 10ms into the burst would divide by
+                # 10ms and report a 100x-inflated spike
+                return self._value / max(dt, 1e-9)
+            return self._last_rate
 
 
 class PercentileCounter(Counter):
@@ -85,6 +106,9 @@ class PercentileCounter(Counter):
     add = set
     increment = set
 
+    PCTS = (("p50", 0.50), ("p90", 0.90), ("p95", 0.95),
+            ("p99", 0.99), ("p999", 0.999))
+
     def percentile(self, p: float):
         with self._lock:
             if not self._samples:
@@ -92,6 +116,16 @@ class PercentileCounter(Counter):
             s = sorted(self._samples)
             k = min(len(s) - 1, int(p * len(s)))
             return s[k]
+
+    def percentiles(self) -> dict:
+        """One sort for the whole p50/p90/p95/p99/p999 dict (snapshot()
+        exports this instead of the bare p99)."""
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
+            return {name: 0 for name, _ in self.PCTS}
+        return {name: s[min(len(s) - 1, int(p * len(s)))]
+                for name, p in self.PCTS}
 
     def value(self):
         return self.percentile(0.99)
@@ -130,7 +164,10 @@ class PerfCounters:
         return self.get(name, "percentile")
 
     def snapshot(self, substr: str = None, prefix: str = None) -> dict:
-        """perf-counters[-by-substr/-by-prefix] scrape."""
+        """perf-counters[-by-substr/-by-prefix] scrape. Percentile
+        counters export their full {p50,p90,p95,p99,p999} dict (a single
+        p99 hid the tail shape every latency investigation starts from);
+        every other kind exports a scalar."""
         with self._lock:
             items = list(self._counters.items())
         out = {}
@@ -139,7 +176,8 @@ class PerfCounters:
                 continue
             if prefix is not None and not name.startswith(prefix):
                 continue
-            out[name] = c.value()
+            out[name] = (c.percentiles() if c.KIND == "percentile"
+                         else c.value())
         return out
 
     def remove(self, name: str):

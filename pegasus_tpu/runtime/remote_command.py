@@ -51,6 +51,8 @@ class RemoteCommandService:
                           lambda n: any(p in n for p in a)))
         self.register("compact-trace-dump", self._cmd_compact_trace_dump)
         self.register("device-health", self._cmd_device_health)
+        self.register("request-trace-dump", self._cmd_request_trace_dump)
+        self.register("slow-requests", self._cmd_slow_requests)
         if describe is not None:
             self.register("describe", lambda a: json.dumps(describe(), indent=1))
 
@@ -68,6 +70,25 @@ class RemoteCommandService:
         from ..ops.device_watchdog import WATCHDOG
 
         return json.dumps(WATCHDOG.state(), indent=1)
+
+    @staticmethod
+    def _cmd_request_trace_dump(args) -> str:
+        """request-trace-dump [last] — recent sampled request traces from
+        the serving-path tracer (runtime/tracing.py RequestTracer)."""
+        from .tracing import REQUEST_TRACER
+
+        return json.dumps(
+            REQUEST_TRACER.trace(int(args[0]) if args else 50), indent=1)
+
+    @staticmethod
+    def _cmd_slow_requests(args) -> str:
+        """slow-requests [last] — the slow-request ledger: full stage
+        timelines of every request over the slow threshold."""
+        from .tracing import REQUEST_TRACER
+
+        return json.dumps(
+            REQUEST_TRACER.slow_requests(int(args[0]) if args else 50),
+            indent=1)
 
     def _cmd_server_stat(self, args) -> str:
         """One-line digest of selected counters (brief_stat.cpp role)."""

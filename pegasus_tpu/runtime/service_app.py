@@ -118,6 +118,30 @@ def _compact_trace_route(path: str) -> dict:
     return {"watchdog": WATCHDOG.state(), "spans": COMPACT_TRACER.trace(last)}
 
 
+def _request_trace_route(path: str) -> dict:
+    """GET /requests/trace[?last=N][&slow=1][&id=<hex>]: the serving-path
+    request tracer (runtime/tracing.py RequestTracer) — sampled completed
+    traces plus the slow-request ledger, the HTTP twin of the
+    `request-trace-dump`/`slow-requests` remote commands. ?id= looks a
+    single trace up by its hex trace_id; ?slow=1 returns the ledger only."""
+    from urllib.parse import parse_qs, urlparse
+
+    from .tracing import REQUEST_TRACER
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        last = int((q.get("last") or ["50"])[0])
+    except ValueError:
+        last = 50
+    trace_id = (q.get("id") or [""])[0]
+    if trace_id:
+        return {"trace": REQUEST_TRACER.find(trace_id)}
+    if (q.get("slow") or ["0"])[0] not in ("0", ""):
+        return {"slow_requests": REQUEST_TRACER.slow_requests(last)}
+    return {"traces": REQUEST_TRACER.trace(last),
+            "slow_requests": REQUEST_TRACER.slow_requests(last)}
+
+
 def _meta_http_routes(meta) -> dict:
     """The meta's rDSN-http_service analogues: /version, /meta/cluster_info,
     /meta/apps, /meta/app?name=<app>."""
@@ -156,7 +180,8 @@ def _meta_http_routes(meta) -> dict:
             "/meta/cluster_info": cluster_info,
             "/meta/apps": apps,
             "/meta/app": app,
-            "/compact/trace": _compact_trace_route}
+            "/compact/trace": _compact_trace_route,
+            "/requests/trace": _request_trace_route}
 
 
 def _replica_http_routes(stub) -> dict:
@@ -174,7 +199,8 @@ def _replica_http_routes(stub) -> dict:
 
     return {"/version": lambda p: _version_info("replica"),
             "/replica/info": info,
-            "/compact/trace": _compact_trace_route}
+            "/compact/trace": _compact_trace_route,
+            "/requests/trace": _request_trace_route}
 
 
 # ---------------------------------------------------------- built-in apps
@@ -403,6 +429,7 @@ class CollectorApp:
             return json.dumps({
                 "availability": self.detector.report(),
                 "hotspots": self.collector.hotspots,
+                "hotkeys": self.collector.hotkey_results,
                 "app_stats": self.collector.app_stats,
                 "compact_stats": self.collector.compact_stats,
             })
@@ -416,7 +443,8 @@ class CollectorApp:
 
             self.reporter = CounterReporter(
                 port=http_port,
-                routes={"/compact/trace": _compact_trace_route}).start()
+                routes={"/compact/trace": _compact_trace_route,
+                        "/requests/trace": _request_trace_route}).start()
 
     @property
     def address(self):

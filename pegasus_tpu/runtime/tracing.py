@@ -31,6 +31,8 @@ it (the `trace` detail in BENCH_*.json).
 """
 
 import collections
+import os
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -200,3 +202,224 @@ class StageTracer:
 # process-wide tracer, like the global counter registry: every pipeline
 # layer (ops, engine, parallel, bench) threads spans through this instance
 COMPACT_TRACER = StageTracer()
+
+
+# ======================================================== request tracing
+#
+# Where the StageTracer above times the compaction pipeline (a background
+# job), the RequestTracer times the SERVING path: one trace per client
+# request, its id carried in the RPC header (rpc/transport.py RpcHeader
+# trace_id/trace_sampled) from client/client.py through the replica
+# serverlet, the PacificA prepare/commit round, the private-log append and
+# the engine apply. Spans are recorded at close time (children before
+# parents, like StageTracer) into one per-trace record.
+#
+# Retention is two-tier:
+#   - a sampled ring buffer of completed traces (every `sample_every`-th
+#     trace; default every trace — this is a Python build, span cost is a
+#     dict append), served by GET /requests/trace and the
+#     `request-trace-dump` remote command;
+#   - a slow-request ledger: ANY trace whose end-to-end duration reaches
+#     `slow_threshold_us` keeps its full stage timeline regardless of
+#     sampling — served by GET /requests/trace?slow=1 and the
+#     `slow-requests` remote command. A slow put is attributable to the
+#     client hop, the RPC layer, the quorum round or the engine without
+#     reproducing it.
+#
+# Cross-process semantics: each process records the spans IT closes. The
+# originating client owns the trace (root_local) and finalizes it; a
+# server process that received the context over the wire finalizes its own
+# partial view when its last concurrently-open handler for that trace
+# returns. In a onebox (everything in one process, one global
+# REQUEST_TRACER) the two sides share one record, so a single client put
+# yields a single trace holding client, rpc, replication, plog and engine
+# spans — the acceptance shape tests/test_request_tracing.py pins.
+
+
+class TraceContext:
+    """What travels in the RPC header: trace identity + sampling flag.
+    `remote` marks a context that arrived over the wire (this process does
+    not own the trace root)."""
+
+    __slots__ = ("trace_id", "sampled", "remote")
+
+    def __init__(self, trace_id: int, sampled: bool = True,
+                 remote: bool = False):
+        self.trace_id = trace_id
+        self.sampled = sampled
+        self.remote = remote
+
+
+class RequestTracer:
+    MAX_ACTIVE = 4096       # leaked/abandoned trace guard
+    MAX_SPANS = 512         # per-trace span cap (runaway scan sessions)
+
+    def __init__(self, capacity: int = 512, slow_capacity: int = 256):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._ring = collections.deque(maxlen=capacity)
+        self._slow = collections.deque(maxlen=slow_capacity)
+        self._active = {}   # trace_id -> open trace record
+        self.slow_threshold_us = int(
+            os.environ.get("PEGASUS_SLOW_REQUEST_US", "50000"))
+        self.sample_every = max(1, int(
+            os.environ.get("PEGASUS_TRACE_SAMPLE_EVERY", "1")))
+        self._seq = 0
+
+    # ------------------------------------------------------------ context
+
+    def current(self):
+        """The TraceContext active in this thread, or None."""
+        return getattr(self._local, "ctx", None)
+
+    def _entry(self, trace_id: int, op: str, root_local: bool) -> dict:
+        with self._lock:
+            e = self._active.get(trace_id)
+            if e is None:
+                while len(self._active) >= self.MAX_ACTIVE:
+                    self._active.pop(next(iter(self._active)))
+                e = {"trace_id": trace_id, "op": op, "started": time.time(),
+                     "spans": [], "root_local": root_local, "refs": 0}
+                self._active[trace_id] = e
+            return e
+
+    @contextmanager
+    def root(self, op: str):
+        """Begin a trace in this thread (the CLIENT side of a request).
+        Records a `client.<op>` span and finalizes the trace at exit.
+        Nested client ops inside an active trace (e.g. copy_data's reads
+        feeding writes) record plain spans instead of new traces."""
+        prev = self.current()
+        if prev is not None:
+            with self.span(f"client.{op}"):
+                yield prev
+            return
+        with self._lock:
+            self._seq += 1
+            sampled = (self._seq % self.sample_every) == 0
+        ctx = TraceContext(random.getrandbits(63) | 1, sampled)
+        e = self._entry(ctx.trace_id, op, root_local=True)
+        self._local.ctx = ctx
+        t0 = time.perf_counter()
+        try:
+            with self.span(f"client.{op}"):
+                yield ctx
+        finally:
+            self._local.ctx = None
+            self._finalize(e, int((time.perf_counter() - t0) * 1e6),
+                           ctx.sampled)
+
+    @contextmanager
+    def serve(self, ctx: TraceContext, op: str):
+        """Install a wire-propagated context for a SERVER-side handler and
+        record the `rpc.server.<op>` span. When this process does not own
+        the trace root, the trace's local view finalizes once its last
+        open handler returns."""
+        prev = self.current()
+        e = self._entry(ctx.trace_id, op, root_local=False)
+        with self._lock:
+            e["refs"] += 1
+        self._local.ctx = ctx
+        t0 = time.perf_counter()
+        try:
+            with self.span(f"rpc.server.{op}"):
+                yield ctx
+        finally:
+            self._local.ctx = prev
+            with self._lock:
+                e["refs"] -= 1
+                done = e["refs"] == 0 and not e["root_local"]
+            if done:
+                self._finalize(e, int((time.perf_counter() - t0) * 1e6),
+                               ctx.sampled)
+
+    @contextmanager
+    def adopt(self, ctx):
+        """Install an existing context in THIS thread for a worker-pool
+        hop (the parallel prepare fan-out runs _send_prepare on pool
+        threads) — spans the worker closes join the owner's trace. No
+        finalize: the owning thread's root/serve does that, and it blocks
+        on the workers before closing, so the trace stays active. ctx
+        may be None (untraced caller) — then this is a no-op."""
+        if ctx is None:
+            yield None
+            return
+        prev = getattr(self._local, "ctx", None)
+        self._local.ctx = ctx
+        try:
+            yield ctx
+        finally:
+            self._local.ctx = prev
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record one stage of the active trace (no-op without a context).
+        Yields the mutable attr dict so counts discovered mid-span can be
+        added before it closes."""
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is None:
+            yield attrs
+            return
+        with self._lock:
+            e = self._active.get(ctx.trace_id)
+        if e is None:
+            yield attrs
+            return
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        ts = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield attrs
+        finally:
+            self._local.depth = depth
+            rec = {"name": name, "ts": ts, "depth": depth,
+                   "duration_us": int((time.perf_counter() - t0) * 1e6)}
+            rec.update(attrs)
+            with self._lock:
+                if len(e["spans"]) < self.MAX_SPANS:
+                    e["spans"].append(rec)
+
+    # ---------------------------------------------------------- retention
+
+    def _finalize(self, e: dict, dur_us: int, sampled: bool) -> None:
+        with self._lock:
+            self._active.pop(e["trace_id"], None)
+        trace = {"trace_id": format(e["trace_id"], "016x"), "op": e["op"],
+                 "ts": e["started"], "duration_us": dur_us,
+                 "spans": e["spans"]}
+        slow = dur_us >= self.slow_threshold_us
+        with self._lock:
+            if slow:
+                self._slow.append(trace)
+            if sampled:
+                self._ring.append(trace)
+        counters.rate("request.trace.completed_count").increment()
+        counters.percentile("request.trace.duration_us").set(dur_us)
+        if slow:
+            counters.rate("request.trace.slow_count").increment()
+
+    def trace(self, last: int = 50) -> list:
+        """The most recent sampled completed traces, JSON-ready."""
+        with self._lock:
+            return list(self._ring)[-last:]
+
+    def slow_requests(self, last: int = 50) -> list:
+        """The slow-request ledger: full stage timelines of every request
+        that crossed slow_threshold_us."""
+        with self._lock:
+            return list(self._slow)[-last:]
+
+    def find(self, trace_id: str):
+        """Look one completed trace up by hex id (ledger first: slow
+        traces are the ones being hunted)."""
+        with self._lock:
+            for t in list(self._slow) + list(self._ring):
+                if t["trace_id"] == trace_id:
+                    return t
+        return None
+
+
+# process-wide request tracer: client, transport, replication and engine
+# all record into this instance (one process = one local trace view)
+REQUEST_TRACER = RequestTracer()

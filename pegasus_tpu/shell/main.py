@@ -88,6 +88,14 @@ class Shell:
                               "device-health watchdog + lane-guard state on "
                               "every node (last_ok / wedged_at_stage / "
                               "breaker / cpu-fallback totals)"),
+            "request_trace": (self.cmd_request_trace,
+                              "request_trace [node] [last] — recent sampled "
+                              "request traces (client/rpc/replication/engine "
+                              "stage timelines)"),
+            "slow_requests": (self.cmd_slow_requests,
+                              "slow_requests [node] [last] — the slow-request "
+                              "ledger: full stage timeline of every request "
+                              "over the slow threshold"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
             "propose": (self.cmd_propose,
@@ -523,6 +531,18 @@ class Shell:
 
     def cmd_device_health(self, args):
         self.cmd_remote_command(["all", "device-health"])
+
+    def cmd_request_trace(self, args):
+        if args:
+            self.p(self._node_command(args[0], "request-trace-dump", args[1:]))
+        else:
+            self.cmd_remote_command(["all", "request-trace-dump"])
+
+    def cmd_slow_requests(self, args):
+        if args:
+            self.p(self._node_command(args[0], "slow-requests", args[1:]))
+        else:
+            self.cmd_remote_command(["all", "slow-requests"])
 
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]

@@ -76,6 +76,31 @@ def test_write_replicates_to_quorum(group):
     assert group.read(K(1)).error == Status.OK
 
 
+def test_write_path_exports_replication_counters(group):
+    """The PacificA write path is no longer counter-blind: a committed
+    write populates replica.prepare/commit latency percentiles, the
+    plog.append.* counters and the per-partition inflight/backlog
+    gauges."""
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    for i in range(5):
+        group.write(RPC_PUT, put_req(100 + i))
+    snap = counters.snapshot(prefix="replica.")
+    prep = snap["replica.prepare_latency_us"]
+    commit = snap["replica.commit_latency_us"]
+    # percentile counters export the full quantile dict with real samples
+    assert set(prep) == {"p50", "p90", "p95", "p99", "p999"}
+    assert prep["p99"] > 0 and commit["p99"] > 0
+    # per-partition pressure gauges exist and drained after commit
+    backlog = {k: v for k, v in snap.items() if k.endswith(".backlog")}
+    assert backlog and all(v == 0 for v in backlog.values())
+    assert any(k.endswith(".inflight") for k in snap)
+    plog = counters.snapshot(prefix="plog.append.")
+    assert plog["plog.append.count"] > 0
+    assert plog["plog.append.bytes"] > 0
+    assert plog["plog.append.duration_us"]["p99"] > 0
+
+
 def test_secondary_commit_lags_until_next_prepare(group):
     group.write(RPC_PUT, put_req(1))
     group.write(RPC_PUT, put_req(2))

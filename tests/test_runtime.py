@@ -146,6 +146,69 @@ def test_stop_discards_pending_and_returns_promptly():
     assert ran == []
 
 
+def test_rate_counter_concurrent_scrapers_see_the_same_value():
+    """Regression for the destructive RateCounter.value(): reading used
+    to reset the window, so concurrent scrapers (/metrics, remote
+    command, info collector) each saw a fraction of the true rate. Reads
+    must be non-destructive: every scraper observes the same, non-zero
+    value."""
+    pc = PerfCounters()
+    r = pc.rate("qps")
+    r.MIN_WINDOW = 0.5
+    for _ in range(100):
+        r.increment()
+    time.sleep(0.55)  # let the window become rollable
+    barrier = threading.Barrier(4)
+    seen = []
+
+    def scrape():
+        barrier.wait()
+        seen.append(r.value())
+
+    threads = [threading.Thread(target=scrape) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == 4
+    assert all(v > 0 for v in seen), seen
+    assert len(set(seen)) == 1, f"scrapers disagree: {seen}"
+    # and the value survives yet another read (non-destructive)
+    assert r.value() == seen[0]
+
+
+def test_rate_counter_rolls_windows():
+    pc = PerfCounters()
+    r = pc.rate("roll")
+    r.MIN_WINDOW = 0.05
+    for _ in range(10):
+        r.increment()
+    time.sleep(0.06)
+    first = r.value()
+    assert first > 0
+    # a later idle window decays the published rate toward 0
+    time.sleep(0.06)
+    assert r.value() == 0.0
+    # idle-then-burst: a scrape milliseconds into the fresh window keeps
+    # publishing the finished window (0), never _value/10ms spikes
+    r.increment(5)
+    assert r.value() == 0.0
+    time.sleep(0.06)
+    assert r.value() > 0
+
+
+def test_percentile_snapshot_exports_full_quantile_dict():
+    pc = PerfCounters()
+    p = pc.percentile("lat_us")
+    for i in range(1000):
+        p.set(i)
+    snap = pc.snapshot(prefix="lat_us")
+    d = snap["lat_us"]
+    assert set(d) == {"p50", "p90", "p95", "p99", "p999"}
+    assert d["p50"] == 500 and d["p99"] == 990 and d["p999"] == 999
+    assert d["p50"] <= d["p90"] <= d["p95"] <= d["p99"] <= d["p999"]
+
+
 def test_counter_kind_collision_raises():
     pc = PerfCounters()
     pc.number("x")

@@ -218,6 +218,156 @@ def test_hotspot_partition_analysis():
     assert hotspot_partitions(qps) == [3]
 
 
+class _FakeHotkeyNode:
+    """Scripted detect_hotkey endpoint for the closed-loop driver."""
+
+    def __init__(self, answers):
+        self.answers = list(answers)
+        self.calls = []
+
+    def remote_command(self, addr, command, args):
+        assert command == "detect_hotkey"
+        self.calls.append((addr, tuple(args)))
+        action = args[2]
+        if action == "start":
+            return "read hotkey detection started (coarse)"
+        if action == "stop":
+            return "read hotkey detection stopped"
+        return self.answers.pop(0)
+
+
+def test_hotkey_loop_state_machine():
+    """A partition flagged hotkey_rounds consecutive rounds gets the
+    automatic detect_hotkey start/query/stop sequence; the verdict is
+    republished as collector.app.<name>.hotkey.* counters."""
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    coll = InfoCollector(["x:1"], hotkey_rounds=2)
+    fake = _FakeHotkeyNode(["read detection state: FINE_DETECTING",
+                            "read hotkey: b'HOT'"])
+    coll.remote_command = fake.remote_command
+    primaries = {3: "node-a:34801"}
+    # round 1: flagged, streak below threshold -> nothing issued
+    coll.drive_hotkey_loop("happ", 9, [3], primaries, {3: 100.0}, {3: 1.0})
+    assert fake.calls == []
+    # round 2: streak reaches 2 -> start (read kind: read qps dominates),
+    # the first query follows in the same round and is unconverged
+    coll.drive_hotkey_loop("happ", 9, [3], primaries, {3: 100.0}, {3: 1.0})
+    assert fake.calls[0] == ("node-a:34801", ("9.3", "read", "start"))
+    assert fake.calls[-1][1] == ("9.3", "read", "query")
+    assert ("happ", 3) in coll._detections
+    # round 3: verdict -> republished, detection stopped, streak cleared
+    coll.drive_hotkey_loop("happ", 9, [3], primaries, {3: 100.0}, {3: 1.0})
+    assert fake.calls[-1][1] == ("9.3", "read", "stop")
+    assert ("happ", 3) not in coll._detections
+    assert coll.hotkey_results["happ"][3]["key"] == "b'HOT'"
+    assert coll.hotkey_results["happ"][3]["kind"] == "read"
+    snap = counters.snapshot(prefix="collector.app.happ.hotkey.")
+    assert snap["collector.app.happ.hotkey.3.hot"] == 1
+    assert snap["collector.app.happ.hotkey.active_detections"] == 0
+    assert snap["collector.app.happ.hotkey.found_count"] > 0
+    # the partition calms: the verdict gauge must clear, not page forever
+    coll.drive_hotkey_loop("happ", 9, [], primaries)
+    snap = counters.snapshot(prefix="collector.app.happ.hotkey.")
+    assert snap["collector.app.happ.hotkey.3.hot"] == 0
+    coll.stop()
+
+
+def test_hotkey_loop_survives_dead_or_moved_primary():
+    """An unreachable node must not pin a detection forever (failed query
+    rounds burn the query budget), and a moved primary abandons the
+    detection so a fresh streak can restart it on the new node."""
+    from pegasus_tpu.rpc.transport import RpcError
+
+    coll = InfoCollector(["x:1"], hotkey_rounds=1, hotkey_query_limit=2)
+
+    calls = []
+
+    def unreachable(addr, command, args):
+        calls.append(tuple(args))
+        if args[2] == "start":
+            return "read hotkey detection started (coarse)"
+        raise RpcError(7, "connection refused")
+
+    coll.remote_command = unreachable
+    primaries = {0: "dead-node:1"}
+    coll.drive_hotkey_loop("dapp", 4, [0], primaries)   # start + failed query
+    assert ("dapp", 0) in coll._detections
+    coll.drive_hotkey_loop("dapp", 4, [0], primaries)   # failed query 2
+    coll.drive_hotkey_loop("dapp", 4, [0], primaries)   # over budget: expire
+    assert ("dapp", 0) not in coll._detections
+
+    # primary move: detection abandoned (stop goes to the OLD node)
+    coll2 = InfoCollector(["x:1"], hotkey_rounds=1)
+    fake = _FakeHotkeyNode(["read detection state: COARSE_DETECTING"])
+    coll2.remote_command = fake.remote_command
+    coll2.drive_hotkey_loop("mapp", 6, [0], {0: "node-a:1"})
+    assert ("mapp", 0) in coll2._detections
+    coll2.drive_hotkey_loop("mapp", 6, [0], {0: "node-b:1"})
+    assert ("mapp", 0) not in coll2._detections
+    assert fake.calls[-1] == ("node-a:1", ("6.0", "read", "stop"))
+    coll.stop()
+    coll2.stop()
+
+
+def test_hotkey_loop_streak_resets_when_calm():
+    coll = InfoCollector(["x:1"], hotkey_rounds=3)
+    fake = _FakeHotkeyNode(["write detection state: COARSE_DETECTING"])
+    coll.remote_command = fake.remote_command
+    primaries = {0: "n:1"}
+    coll.drive_hotkey_loop("capp", 5, [0], primaries)
+    coll.drive_hotkey_loop("capp", 5, [0], primaries)
+    coll.drive_hotkey_loop("capp", 5, [], primaries)   # calm round resets
+    coll.drive_hotkey_loop("capp", 5, [0], primaries)
+    coll.drive_hotkey_loop("capp", 5, [0], primaries)
+    assert fake.calls == []  # never reached 3 consecutive rounds
+    # write-dominant partitions get a write-kind detection
+    coll.drive_hotkey_loop("capp", 5, [0], primaries, {0: 1.0}, {0: 50.0})
+    assert fake.calls[0][1] == ("5.0", "write", "start")
+    coll.stop()
+
+
+def test_hotkey_loop_closed_against_live_node(shell):
+    """End to end: the driver starts a REAL detection on the node serving
+    the partition, hot traffic converges it, the next round publishes the
+    verdict."""
+    sh, out = shell
+    sh.run_line("create hotloop -p 1 -r 3")
+    sh.run_line("use hotloop")
+    import pegasus_tpu.meta.messages as mm
+    from pegasus_tpu.meta.meta_server import RPC_CM_QUERY_CONFIG
+
+    qc = sh._meta_call(RPC_CM_QUERY_CONFIG, mm.QueryConfigRequest("hotloop"),
+                       mm.QueryConfigResponse)
+    node, app_id = qc.partitions[0].primary, qc.app.app_id
+    coll = InfoCollector(sh.meta_addrs, hotkey_rounds=1)
+    try:
+        coll.drive_hotkey_loop("hotloop", app_id, [0], {0: node},
+                               {0: 500.0}, {0: 1.0})
+        assert ("hotloop", 0) in coll._detections
+        for i in range(300):  # one dominant key among noise
+            sh.run_line("get hotkey1 s" if i % 2 == 0 else f"get cold{i} s")
+        coll.drive_hotkey_loop("hotloop", app_id, [0], {0: node},
+                               {0: 500.0}, {0: 1.0})
+        assert coll.hotkey_results["hotloop"][0]["key"].startswith("b'hotkey1")
+    finally:
+        coll.stop()
+
+
+def test_metric_names_lint_clean():
+    """tools/check_metric_names.py wired into the test run: every counter
+    name registered in source is documented in README.md's metric table."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tools" / "check_metric_names.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
 def test_counter_reporter_prometheus(onebox):
     from pegasus_tpu.runtime.perf_counters import counters
 

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Metric-name cross-check lint (wired into the test run via
+tests/test_tools.py), the counter-registry twin of check_fail_points.py:
+
+every perf-counter name registered in source
+(``counters.rate/percentile/number/volatile_number("name")``) must be
+DOCUMENTED in README.md's Observability metric tables — counters nobody
+can discover rot, and a renamed counter silently breaks every dashboard
+scraping the old name.
+
+Dynamic names become wildcards: f-string holes
+(``f"profiler.{code}.qps"`` -> ``profiler.*.qps``) and concatenated
+prefixes (``self._pfx + "put_qps"`` -> ``*.put_qps``). For each name the
+longest literal segment (dots trimmed) is probed against README.md, so
+``*.put_qps`` requires ``put_qps`` to appear and
+``collector.app.*.hotkey.*`` requires ``collector.app.`` or ``hotkey``
+(whichever is longer) to appear.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# literal / f-string first argument
+_LIT_RE = re.compile(
+    r"counters\.(?:rate|percentile|number|volatile_number)\(\s*\n?\s*(f?)\"([^\"]+)\"")
+# <prefix-expr> + "literal" first argument (e.g. self._pfx + "put_qps")
+_CAT_RE = re.compile(
+    r"counters\.(?:rate|percentile|number|volatile_number)\(\s*\n?\s*"
+    r"[A-Za-z_][\w.]*\s*\+\s*(f?)\"([^\"]+)\"")
+
+
+def _wildcard(is_fstring: str, name: str) -> str:
+    if is_fstring:
+        name = re.sub(r"\{[^}]*\}", "*", name)
+    return name
+
+
+def source_metric_names() -> set:
+    names = set()
+    files = list((REPO / "pegasus_tpu").rglob("*.py")) + [REPO / "bench.py"]
+    for p in files:
+        text = p.read_text()
+        for m in _LIT_RE.finditer(text):
+            names.add(_wildcard(m.group(1), m.group(2)))
+        for m in _CAT_RE.finditer(text):
+            names.add("*" + _wildcard(m.group(1), m.group(2)))
+    return names
+
+
+def _probe(name: str) -> str:
+    """Longest wildcard-free segment of the name (dots trimmed) — what
+    must literally appear in the README's metric tables."""
+    segments = [s.strip(".") for s in name.split("*")]
+    segments = [s for s in segments if s]
+    return max(segments, key=len, default="")
+
+
+def run_lint() -> list:
+    """-> list of error strings (empty = clean)."""
+    readme = (REPO / "README.md").read_text()
+    errors = []
+    for name in sorted(source_metric_names()):
+        probe = _probe(name)
+        if probe and probe not in readme:
+            errors.append(
+                f"source counter {name!r} is undocumented — add it to "
+                f"README.md's Observability metric tables "
+                f"(probe segment {probe!r} not found)")
+    return errors
+
+
+def main() -> int:
+    errors = run_lint()
+    for e in errors:
+        print(f"check_metric_names: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_metric_names: OK "
+              f"({len(source_metric_names())} counter names)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
