@@ -75,6 +75,20 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+def _host_info() -> dict:
+    """Host-contention attribution (VERDICT-r5: a 2.9s -> 7.8s CPU-lane
+    regression was only guessable as host contention): loadavg + core
+    count recorded in every BENCH detail; the per-stage process_time vs
+    wall split rides in the trace summaries (runtime/tracing.py cpu_s —
+    cpu_s >> s means parallel threads worked under the span, s >> cpu_s
+    with high loadavg means the host starved the stage)."""
+    try:
+        la = [round(x, 2) for x in os.getloadavg()]
+    except (AttributeError, OSError):
+        la = None
+    return {"cpu_count": os.cpu_count(), "loadavg": la}
+
+
 def _bench_params():
     """(n_total, n_runs, value_size, reps) — single source for main(), the
     child lane, the watchdog, and the crash handler so the degraded line's
@@ -384,8 +398,10 @@ def tpu_lane_main():
     from pegasus_tpu.engine.block import KVBlock
     from pegasus_tpu.ops.compact import TpuBackend, pack_runs
 
+    host_start = _host_info()
     runs, fill_s = _fill(n_total, n_runs, value_size)
     opts, fargs = _compact_opts()
+    proc_t0 = time.process_time()
     with COMPACT_TRACER.session() as sess:
         packed = pack_runs(runs, opts, need_sbytes=False)
         concat = KVBlock.concat(runs)
@@ -398,6 +414,8 @@ def tpu_lane_main():
     result = {"ok": True, "tpu_s": tpu_s, "split": split,
               "platform": platform, "init_s": round(init_s, 1),
               "fill_s": round(fill_s, 3), "trace": sess.summary(),
+              "process_s": round(time.process_time() - proc_t0, 3),
+              "host": {"start": host_start, "end": _host_info()},
               # lane-guard totals: a run with fallbacks/abandons > 0 can
               # never silently masquerade as a clean tpu number
               "lane": LANE_GUARD.state()}
@@ -544,17 +562,20 @@ def main():
 
     from pegasus_tpu.runtime.tracing import COMPACT_TRACER
 
+    host_start = _host_info()
     runs, fill_s = _fill(n_total, n_runs, value_size)
     opts, fargs = _compact_opts()
     # the session turns the instrumented pipeline spans (pack / device /
     # gather) into the per-stage `trace` breakdown of the JSON detail —
     # summed over all reps (see `calls`), present even on degraded lines
+    proc_t0 = time.process_time()
     with COMPACT_TRACER.session() as cpu_sess:
         packed = pack_runs(runs, opts, need_sbytes=True)
         concat = KVBlock.concat(runs)
         n_in = sum(packed.lens)
         cpu_s, cpu_out, cpu_split = _lane(CpuBackend(), packed, concat,
                                           fargs, reps)
+    cpu_process_s = time.process_time() - proc_t0
     cpu_digest = _out_digest(cpu_out)
     global _CPU_DETAIL
     cpu_detail = _CPU_DETAIL = {
@@ -562,9 +583,13 @@ def main():
         "cpu_compact_s": round(cpu_s, 3),
         "cpu_split": cpu_split,
         "cpu_records_per_s": int(n_in / cpu_s),
+        # process cpu-seconds across pack+lane vs their wall time: the
+        # contention tell for an unexplained cpu-lane regression
+        "cpu_process_s": round(cpu_process_s, 3),
         "input_records": n_in,
         "output_records": cpu_digest["n_out"],
         "trace": cpu_sess.summary(),
+        "host": {"start": host_start, "end": _host_info()},
     }
 
     # 2) TPU lane

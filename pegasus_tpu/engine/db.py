@@ -163,6 +163,15 @@ class LsmEngine:
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
         self._compaction_lock = threading.RLock()
         self._device_cache_used = 0  # bytes of HBM pinned by resident runs
+        # same-SST prime coordination (see _device_run_budgeted): waiters
+        # block on this until the in-flight prime finishes and notifies
+        self._prime_cv = threading.Condition(self._lock)
+        # deferred (pipelined) installs: futures for in-flight pool work,
+        # consumed-input files awaiting unlink, and the manifest-write
+        # debt (see _install_merge_deferred for the durability invariant)
+        self._pending_installs = []
+        self._pending_unlinks = []
+        self._manifest_dirty = False
         self._resolved_mesh = _UNRESOLVED  # lazy sharded-compaction mesh
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
@@ -362,10 +371,13 @@ class LsmEngine:
     def flush(self) -> None:
         """Rotate the memtable and flush every immutable to an L0 SST
         (device-sorted). Synchronous; oldest-first keeps both L0 recency
-        order and the durable-decree invariant."""
+        order and the durable-decree invariant. Settles the currently
+        queued deferred installs (light: no compaction-lock exclusion, so
+        a flush never stalls behind a whole in-flight cascade)."""
         with self._lock:
             self._rotate_memtable_locked()
         self._drain_imms()
+        self._settle_installs()
 
     def _drain_imms(self) -> None:
         """Flush pending immutables oldest-first. The flush lock serializes
@@ -406,9 +418,10 @@ class LsmEngine:
         sst = SSTable(path)
         sst._block = sorted_block  # already in memory: skip the disk re-read
         # flush-time residency prime: upload the newborn run's packed
-        # columns NOW, off the compaction critical path, so its first
-        # compaction already reads HBM
-        self._device_run_budgeted(sst)
+        # columns off the WRITE PATH (pipeline pool) so its first
+        # compaction already reads HBM without the flush paying the
+        # upload; a compaction that wins the race simply host-packs once
+        self._prime_async(sst)
         with self._lock:
             self._l0.insert(0, sst)
             self._imm.remove(imm)
@@ -420,52 +433,102 @@ class LsmEngine:
         if len(self._l0) >= self.opts.l0_compaction_trigger:
             self.compact()
 
+    def _prime_async(self, sst):
+        """Fire-and-forget device-residency prime on the pipeline pool.
+        No future is tracked: a wedged device prime must never hang a
+        drain/flush/close (the per-SST in-flight marker keeps later
+        callers from stacking behind it — they simply host-pack)."""
+        if self.opts.backend != "tpu":
+            return
+        from ..ops.pipeline import submit
+
+        submit(self._device_run_budgeted, sst)
+
     def _device_run_budgeted(self, sst):
         """Prime/fetch an SST's device-resident run under the HBM budget:
         past the budget (or on a device allocation failure) the file simply
         stays host-packed — compaction falls back gracefully instead of
-        OOMing the write path."""
+        OOMing the write path. Concurrency: a per-SST in-flight marker
+        (under the engine lock) keeps an async prime and an inline caller
+        from double-uploading one file, without serializing primes of
+        DIFFERENT files or holding any lock across the device upload;
+        budget accounting is settled under the lock against the retired
+        flag, so a release can never subtract bytes that were not added."""
         if self.opts.backend != "tpu":
             return None
         from ..runtime.lane_guard import LANE_GUARD
 
         want_values = self.opts.device_values
-        cached = sst._device_run
-        if cached is not None and (not want_values
-                                   or cached.val2d is not None):
-            return cached
-        if LANE_GUARD.breaker_open(probe=False):
-            # the breaker routes all compaction to cpu; priming HBM for a
-            # device the guard has declared dead would only re-wedge.
-            # probe=False: the write path must never block on a half-open
-            # device probe — the next guarded compaction does that
-            return cached
         with self._lock:
-            if self._device_cache_used >= self.opts.device_cache_bytes:
-                return cached  # a value-less cached run still serves
-        old_bytes = cached.nbytes() if cached is not None else 0
+            # same-SST coordination: if another thread is mid-prime on
+            # THIS file, wait for its result instead of double-uploading
+            # or returning a spurious None (a compaction racing the async
+            # flush prime must still get the HBM run). Bounded: a wedged
+            # prime is abandoned at the lane deadline, never stacked on.
+            deadline = None
+            while sst._prime_inflight:
+                if deadline is None:
+                    eff = LANE_GUARD.effective_deadline_s()
+                    # deadline <= 0 means "deadline disabled", not "give
+                    # up immediately" — wait as long as the lane would
+                    bound = eff if eff and eff > 0 else 3600.0
+                    deadline = time.monotonic() + bound
+                self._prime_cv.wait(timeout=0.05)
+                if time.monotonic() > deadline:
+                    return sst._device_run
+            cached = sst._device_run
+            if sst._device_retired:
+                return None
+            if cached is not None and (not want_values
+                                       or cached.val2d is not None):
+                return cached
+            sst._prime_inflight = True
         try:
-            dr = sst.device_run(self.opts.prefix_u32,
-                                with_values=want_values)
-        except Exception as e:  # device OOM / backend failure: one policy
-            # breaker=False: an oversized sst OOMing its prime is
-            # capacity-local, not device death — it must not flap every
-            # compaction onto cpu
-            LANE_GUARD.record_device_failure("device_run_prime", repr(e),
-                                             breaker=False)
-            print(f"[engine] device-run prime failed for {sst.path}: {e!r}",
-                  flush=True)
-            sst._device_uncacheable = True
-            return None
-        if dr is not None:
+            if LANE_GUARD.breaker_open(probe=False):
+                # the breaker routes all compaction to cpu; priming HBM
+                # for a device the guard has declared dead would only
+                # re-wedge. probe=False: the write path must never block
+                # on a half-open device probe — the next guarded
+                # compaction does that
+                return cached
             with self._lock:
-                self._device_cache_used += dr.nbytes() - old_bytes
-        return dr
+                if self._device_cache_used >= self.opts.device_cache_bytes:
+                    return cached  # a value-less cached run still serves
+            old_bytes = cached.nbytes() if cached is not None else 0
+            try:
+                dr = sst.device_run(self.opts.prefix_u32,
+                                    with_values=want_values)
+            except Exception as e:  # device OOM / backend failure: one policy
+                # breaker=False: an oversized sst OOMing its prime is
+                # capacity-local, not device death — it must not flap every
+                # compaction onto cpu
+                LANE_GUARD.record_device_failure("device_run_prime", repr(e),
+                                                 breaker=False)
+                print(f"[engine] device-run prime failed for {sst.path}: "
+                      f"{e!r}", flush=True)
+                sst._device_uncacheable = True
+                return None
+            with self._lock:
+                if sst._device_retired:
+                    # an async prime lost the race against the merge that
+                    # consumed this file: drop the upload, never the budget
+                    sst._device_run = None
+                    return None
+                if dr is not None:
+                    self._device_cache_used += dr.nbytes() - old_bytes
+                    sst._device_budgeted = True
+            return dr
+        finally:
+            with self._lock:
+                sst._prime_inflight = False
+                self._prime_cv.notify_all()
 
     def _release_device_run(self, sst):
-        if sst._device_run is not None:
-            with self._lock:
+        with self._lock:
+            sst._device_retired = True
+            if sst._device_run is not None and sst._device_budgeted:
                 self._device_cache_used -= sst._device_run.nbytes()
+            sst._device_budgeted = False
             sst._device_run = None
 
     def _bottommost(self, target_level: int) -> bool:
@@ -491,8 +554,10 @@ class LsmEngine:
                 overlap = self._overlapping_locked(1, lo, hi)
             bm = self._bottommost(1) if bottommost is None else bottommost
             stats = self._merge_to_level(inputs, overlap, target_level=1,
-                                         bottommost=bm, now=now)
+                                         bottommost=bm, now=now,
+                                         deferred=True)
             self._maybe_cascade(now)
+            self._drain_pending_installs()
             return stats
 
     def _overlapping_locked(self, level: int, lo: bytes, hi: bytes):
@@ -506,7 +571,11 @@ class LsmEngine:
 
     def _maybe_cascade(self, now=None):
         """While a level exceeds its byte budget, push one file (plus the
-        next level's overlap) down — bounded-input leveled compaction."""
+        next level's overlap) down — bounded-input leveled compaction.
+        Installs are DEFERRED (pipelined): the in-memory level swap is
+        immediate (so the next victim selection sees the updated sizes)
+        while output k's SST write + manifest + input unlinks ride the
+        pipeline pool under the merge of k+1."""
         with self._compaction_lock:
             for lv in range(1, self.opts.max_levels):
                 while True:
@@ -522,7 +591,8 @@ class LsmEngine:
                             lv + 1, victim.min_key, victim.max_key)
                     self._merge_to_level([victim], overlap, target_level=lv + 1,
                                          bottommost=self._bottommost(lv + 1),
-                                         now=now)
+                                         now=now, deferred=True)
+            self._drain_pending_installs()
 
     def _level_bytes(self, lv: int) -> int:
         return sum(s.data_bytes for s in self._levels.get(lv, []))
@@ -559,11 +629,14 @@ class LsmEngine:
         return self._resolved_mesh
 
     def _merge_to_level(self, newer_files, older_files, target_level: int,
-                        bottommost: bool, now=None, sharded: bool = False) -> dict:
+                        bottommost: bool, now=None, sharded: bool = False,
+                        deferred: bool = False) -> dict:
         """Merge newer_files (recency order) over older_files into
         target_level, splitting output at target_file_size_bytes.
         sharded=True (manual_compact only) routes through the multi-chip
-        hash-sharded kernel when a >1-device mesh is available."""
+        hash-sharded kernel when a >1-device mesh is available.
+        deferred=True moves the install's disk work onto the pipeline
+        pool (see _install_merge_deferred)."""
         inputs = list(newer_files) + list(older_files)
         input_blocks = [s.block() for s in inputs]
         mesh = self._sharded_mesh() if sharded else None
@@ -598,16 +671,24 @@ class LsmEngine:
         counters.rate("engine.compaction_completed_count").increment()
         counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
         self._install_merge_output(newer_files, older_files, result.block,
-                                   target_level)
+                                   target_level, deferred=deferred)
         return result.stats
 
     def _install_merge_output(self, newer_files, older_files, out_block,
-                              target_level: int) -> None:
+                              target_level: int,
+                              deferred: bool = False) -> None:
         """Write + atomically swap a merge's output over its inputs —
         shared by _merge_to_level and the node-level batched compaction
         (replica_stub.batched_manual_compact). Caller holds the engine's
-        compaction lock."""
+        compaction lock. deferred=True swaps in memory immediately and
+        moves the disk work onto the pipeline pool."""
+        from ..ops.pipeline import pipeline_depth
+
         out_blocks = _split_block(out_block, self.opts.target_file_size_bytes)
+        inputs = list(newer_files) + list(older_files)
+        if deferred and pipeline_depth() > 1:
+            self._install_merge_deferred(inputs, out_blocks, target_level)
+            return
         new_ssts = []
         for ob in out_blocks:
             with self._lock:
@@ -621,22 +702,9 @@ class LsmEngine:
             self._device_run_budgeted(sst)
             new_ssts.append(sst)
         with self._lock:
-            # swap the new files in and every input file out atomically —
-            # inputs may come from L0 and any level (manual compact); readers
-            # that snapshotted before this keep their (cached) SSTables
-            gone = set(id(f) for f in list(newer_files) + list(older_files))
-            level = [f for f in self._levels.get(target_level, [])
-                     if id(f) not in gone]
-            level.extend(new_ssts)
-            level.sort(key=lambda s: s.min_key or b"")
-            self._levels[target_level] = level
-            self._l0 = [f for f in self._l0 if id(f) not in gone]
-            for lv in list(self._levels):
-                if lv != target_level:
-                    self._levels[lv] = [f for f in self._levels[lv]
-                                        if id(f) not in gone]
+            self._swap_levels_locked(inputs, new_ssts, target_level)
             self._write_manifest_locked()
-        for s in list(newer_files) + list(older_files):
+        for s in inputs:
             # keep the loaded block cached: a reader that snapshotted this
             # SSTable before we unlink must not re-read the dead path
             # (ADVICE r1 medium); the object drops with its last reference.
@@ -647,6 +715,163 @@ class LsmEngine:
                 os.unlink(s.path)
             except OSError:
                 pass
+
+    def _swap_levels_locked(self, inputs, new_ssts, target_level: int):
+        """Swap the new files in and every input file out atomically —
+        inputs may come from L0 and any level (manual compact); readers
+        that snapshotted before this keep their (cached) SSTables."""
+        gone = set(id(f) for f in inputs)
+        level = [f for f in self._levels.get(target_level, [])
+                 if id(f) not in gone]
+        level.extend(new_ssts)
+        level.sort(key=lambda s: s.min_key or b"")
+        self._levels[target_level] = level
+        self._l0 = [f for f in self._l0 if id(f) not in gone]
+        for lv in list(self._levels):
+            if lv != target_level:
+                self._levels[lv] = [f for f in self._levels[lv]
+                                    if id(f) not in gone]
+
+    def _install_merge_deferred(self, inputs, out_blocks,
+                                target_level: int) -> None:
+        """Pipelined install: swap the outputs into the level structure
+        NOW (in-memory SSTables serving reads from their cached blocks)
+        and move the disk work — write_sst, the device-residency prime,
+        the manifest write and the input unlinks — onto the pipeline
+        pool, so the NEXT level's merge overlaps this output's write-out.
+
+        Durability invariant: the on-disk manifest only ever references
+        fully-written files (_write_manifest_locked defers while any live
+        SST is off disk), and inputs are unlinked only after a manifest
+        that no longer references them has landed. A crash inside the
+        window recovers to the exact pre-merge on-disk state."""
+        from ..ops.pipeline import submit_install
+
+        meta = {"level": target_level,
+                "last_flushed_decree": self._durable_decree}
+        new_ssts = []
+        for ob in out_blocks:
+            with self._lock:
+                path = os.path.join(self.path, self._alloc_file_locked())
+            new_ssts.append(SSTable.from_block(path, ob, meta))
+        with self._lock:
+            self._swap_levels_locked(inputs, new_ssts, target_level)
+            self._manifest_dirty = True
+            self._pending_unlinks.extend(inputs)
+        for s in inputs:
+            # HBM back under the budget before the next merge wants it
+            self._release_device_run(s)
+        fut = submit_install(self._deferred_install_job, new_ssts)
+        with self._lock:
+            self._pending_installs = [
+                f for f in self._pending_installs if not f.done()]
+            self._pending_installs.append(fut)
+
+    def _deferred_install_job(self, new_ssts) -> None:
+        """Pool side of a deferred install: land the output files, then
+        (when every live SST is on disk) write the manifest and unlink
+        the consumed inputs. Device-residency primes go back through
+        _prime_async (fire-and-forget): this job must only ever block on
+        DISK, so a wedged device can never hang the install drain."""
+        try:
+            for sst in new_ssts:
+                with self._lock:
+                    if sst._device_retired:
+                        # already consumed as a LATER merge's input before
+                        # ever landing: its data is superseded and nothing
+                        # references the path — writing it now would only
+                        # recreate a file after its queued unlink ran,
+                        # leaking an orphan SST forever
+                        sst._on_disk = True
+                        continue
+                write_sst(sst.path, sst.block(), sst.meta,
+                          compression=self.opts.compression,
+                          bloom=(sst.header["bloom"],
+                                 sst.header["bloom_log2m"]))
+                with self._lock:
+                    sst._on_disk = True
+                self._prime_async(sst)
+        finally:
+            self._flush_deferred_state()
+
+    def _flush_deferred_state(self) -> None:
+        """Write the deferred manifest once every live SST is on disk,
+        then unlink consumed inputs it no longer references. Only inputs
+        whose own install job has settled (_on_disk) unlink now — a
+        consumed-before-landing output stays queued until its job marks
+        it, so an in-flight write_sst can never recreate the path after
+        the unlink (the job's finally re-runs this to finish the queue)."""
+        unlinks = []
+        with self._lock:
+            if self._manifest_dirty:
+                self._write_manifest_locked()
+            if not self._manifest_dirty:
+                unlinks = [s for s in self._pending_unlinks if s._on_disk]
+                self._pending_unlinks = [
+                    s for s in self._pending_unlinks if not s._on_disk]
+        for s in unlinks:
+            try:
+                os.unlink(s.path)
+            except OSError:
+                pass
+
+    def _settle_installs(self) -> None:
+        """Light install settle: wait for the CURRENTLY queued install
+        futures and flush the deferred manifest, without taking the
+        compaction lock (no repair pass — a failed worker's rewrite
+        happens in the next full drain). Used by flush(), which must not
+        serialize behind an entire in-flight compaction cascade."""
+        with self._lock:
+            futures = list(self._pending_installs)
+        for f in futures:
+            f.wait()
+        self._flush_deferred_state()
+
+    def _drain_pending_installs(self) -> None:
+        """Synchronize with the pipeline pool: wait for in-flight install
+        jobs, synchronously rewrite any file a failed worker left
+        unwritten (the manifest never referenced it — see the invariant
+        in _install_merge_deferred), and flush the deferred manifest +
+        unlinks. Public entry points call this so the engine's on-disk
+        state is settled when they return. Runs under the compaction
+        lock: install jobs are only submitted while it is held, so after
+        the waits below no worker can be writing a file the repair pass
+        would also write."""
+        with self._compaction_lock:
+            with self._lock:
+                futures, self._pending_installs = self._pending_installs, []
+            for f in futures:
+                f.wait()
+            with self._lock:
+                missing = [s for s in self._all_ssts_locked()
+                           if not s._on_disk]
+            for s in missing:
+                # repair pass: a failed deferred write retries once
+                # inline; a second failure raises to the caller like a
+                # synchronous install would, with the on-disk state
+                # still pre-merge
+                write_sst(s.path, s.block(), s.meta,
+                          compression=self.opts.compression,
+                          bloom=(s.header["bloom"],
+                                 s.header["bloom_log2m"]))
+                with self._lock:
+                    s._on_disk = True
+            self._flush_deferred_state()
+            with self._lock:
+                # no install job is in flight any more, so whatever is
+                # still queued (dead consumed-before-landing outputs
+                # whose job died before marking them) can go now
+                leftover, self._pending_unlinks = self._pending_unlinks, []
+                settled = not self._manifest_dirty
+            if settled:
+                for s in leftover:
+                    try:
+                        os.unlink(s.path)
+                    except OSError:
+                        pass
+            else:
+                with self._lock:
+                    self._pending_unlinks = leftover + self._pending_unlinks
 
     def manual_compact(self, bottommost: bool = True, now: int = None,
                        target_level: int = None) -> dict:
@@ -713,10 +938,22 @@ class LsmEngine:
             os.makedirs(dest_dir, exist_ok=True)
             for sst in self._all_ssts_locked():
                 dst = os.path.join(dest_dir, os.path.basename(sst.path))
-                if not os.path.exists(dst):
-                    try:
-                        os.link(sst.path, dst)
-                    except OSError:
+                if os.path.exists(dst):
+                    continue
+                try:
+                    os.link(sst.path, dst)
+                except OSError:
+                    if sst._block is not None:
+                        # a deferred install's output that has not landed
+                        # yet (or is mid-write): materialize it into the
+                        # checkpoint from its cached block — the snapshot
+                        # is self-contained without waiting on (or
+                        # excluding) in-flight compactions
+                        write_sst(dst, sst._block, sst.meta,
+                                  compression=self.opts.compression,
+                                  bloom=(sst.header.get("bloom", ""),
+                                         sst.header.get("bloom_log2m", 0)))
+                    else:
                         shutil.copy2(sst.path, dst)
             with open(os.path.join(dest_dir, MANIFEST), "w") as f:
                 json.dump(self._manifest_dict_locked(), f)
@@ -835,6 +1072,12 @@ class LsmEngine:
         }
 
     def _write_manifest_locked(self):
+        if any(not s._on_disk for s in self._all_ssts_locked()):
+            # deferred installs in flight: the manifest must never
+            # reference a file that has not fully landed — the last
+            # completing install job (or a drain) writes it
+            self._manifest_dirty = True
+            return
         data = self._manifest_dict_locked()
         tmp = os.path.join(self.path, MANIFEST + ".tmp")
         with open(tmp, "w") as f:
@@ -842,6 +1085,7 @@ class LsmEngine:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.path, MANIFEST))
+        self._manifest_dirty = False  # only after the replace landed
         self._durable_meta = dict(data["meta"])
 
     def _load_manifest(self):
@@ -893,7 +1137,7 @@ class LsmEngine:
         self._mem.last_decree = self._last_committed_decree
 
     def close(self):
-        pass
+        self._drain_pending_installs()
 
     # ------------------------------------------------------------- statistics
 

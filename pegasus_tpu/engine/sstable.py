@@ -57,12 +57,15 @@ def _bloom_build(hash32: np.ndarray) -> tuple:
 
 
 def write_sst(path: str, block: KVBlock, meta: dict = None,
-              compression: str = "none") -> dict:
+              compression: str = "none", bloom: tuple = None) -> dict:
     """Write atomically (tmp+rename). Returns the header dict.
 
     compression="zlib" deflates each section (the per-table rocksdb
     compression knob, reference value-compression options); readers
-    auto-detect from the header, so tables can mix files."""
+    auto-detect from the header, so tables can mix files.
+    bloom=(hex, log2m) reuses a precomputed bloom for this exact block
+    (deferred installs already built one in SSTable.from_block — the
+    multi-hash O(n) pass must not run twice per file)."""
     import time as _time
 
     from ..runtime.fail_points import inject
@@ -73,7 +76,7 @@ def write_sst(path: str, block: KVBlock, meta: dict = None,
     nbytes = block.key_bytes_total + block.val_bytes_total
     with COMPACT_TRACER.span("sst_write", records=block.n, nbytes=nbytes):
         inject("engine.sst_write")
-        header = _write_sst_impl(path, block, meta, compression)
+        header = _write_sst_impl(path, block, meta, compression, bloom)
     counters.rate("engine.sst_write_count").increment()
     counters.rate("engine.sst_write_bytes").increment(nbytes)
     counters.percentile("engine.sst_write_s").set(
@@ -82,7 +85,7 @@ def write_sst(path: str, block: KVBlock, meta: dict = None,
 
 
 def _write_sst_impl(path: str, block: KVBlock, meta: dict,
-                    compression: str) -> dict:
+                    compression: str, bloom: tuple = None) -> dict:
     import zlib
 
     sections = {}
@@ -99,10 +102,13 @@ def _write_sst_impl(path: str, block: KVBlock, meta: dict,
                           "compression": compression}
         payload.append(stored)
         offset += len(stored)
-    bloom_hex, bloom_log2m = "", 0
-    if block.n:
-        bloom_bits, bloom_log2m = _bloom_build(block.hash32)
-        bloom_hex = bloom_bits.hex()
+    if bloom is not None:
+        bloom_hex, bloom_log2m = bloom
+    else:
+        bloom_hex, bloom_log2m = "", 0
+        if block.n:
+            bloom_bits, bloom_log2m = _bloom_build(block.hash32)
+            bloom_hex = bloom_bits.hex()
     header = {
         "sections": sections,
         "meta": dict(meta or {}),
@@ -168,15 +174,63 @@ class SSTable:
     def __init__(self, path: str):
         self.path = path
         self.header = read_header(path)
+        self._init_runtime_state()
+
+    def _init_runtime_state(self):
         self._block = None
         self._device_run = None
         self._device_uncacheable = False
         self._values_uncacheable = False
+        # deferred write-out (engine pipelined installs): False while the
+        # file has not landed on disk yet — the manifest writer must not
+        # reference it until it has
+        self._on_disk = True
+        # set when the engine released this file's device columns for
+        # good (inputs consumed by a merge): a late async residency prime
+        # must not re-pin HBM for a dead file
+        self._device_retired = False
+        # engine-side prime coordination: _prime_inflight keeps an async
+        # prime and an inline caller from double-uploading one file;
+        # _device_budgeted records whether _device_run's bytes were added
+        # to the engine's HBM budget (a release only subtracts then)
+        self._prime_inflight = False
+        self._device_budgeted = False
         self._bloom = None
         if self.header.get("bloom"):
             self._bloom = np.frombuffer(
                 bytes.fromhex(self.header["bloom"]), dtype=np.uint8)
         self._bloom_log2m = int(self.header.get("bloom_log2m", 0))
+
+    @classmethod
+    def from_block(cls, path: str, block: KVBlock,
+                   meta: dict = None) -> "SSTable":
+        """In-memory SSTable over a not-yet-written block, for the
+        engine's deferred (pipelined) installs: the header is synthesized
+        from the block so reads/blooms/level bookkeeping work immediately,
+        while write_sst lands the file on a pool worker. _on_disk stays
+        False until it does; `sections` is empty because the cached block
+        makes the disk read path unreachable (and the real header is
+        written by write_sst)."""
+        self = cls.__new__(cls)
+        self.path = path
+        bloom_hex, bloom_log2m = "", 0
+        if block.n:
+            bloom_bits, bloom_log2m = _bloom_build(block.hash32)
+            bloom_hex = bloom_bits.hex()
+        self.header = {
+            "sections": {},
+            "meta": dict(meta or {}),
+            "n": block.n,
+            "min_key": block.key(0).hex() if block.n else None,
+            "max_key": block.key(block.n - 1).hex() if block.n else None,
+            "data_bytes": block.key_bytes_total + block.val_bytes_total,
+            "bloom": bloom_hex,
+            "bloom_log2m": bloom_log2m,
+        }
+        self._init_runtime_state()
+        self._block = block
+        self._on_disk = False
+        return self
 
     @property
     def n(self) -> int:
@@ -184,10 +238,11 @@ class SSTable:
 
     @property
     def data_bytes(self) -> int:
-        return int(self.header.get(
-            "data_bytes",
-            self.header["sections"]["key_arena"]["nbytes"]
-            + self.header["sections"]["val_arena"]["nbytes"]))
+        db = self.header.get("data_bytes")
+        if db is None:  # pre-data_bytes header: derive from the sections
+            db = (self.header["sections"]["key_arena"]["nbytes"]
+                  + self.header["sections"]["val_arena"]["nbytes"])
+        return int(db)
 
     def maybe_contains_hash(self, h32) -> bool:
         """Hashkey bloom probe; False = definitely absent (no disk read)."""

@@ -1,5 +1,6 @@
 from .packing import pack_key_prefixes, compute_suffix_ranks, DEFAULT_PREFIX_U32
 from .compact import CompactOptions, CompactResult, compact_blocks, sort_block, get_backend
+from .pipeline import CompactPipeline, pipeline_depth
 
 __all__ = [
     "pack_key_prefixes",
@@ -10,4 +11,6 @@ __all__ = [
     "compact_blocks",
     "sort_block",
     "get_backend",
+    "CompactPipeline",
+    "pipeline_depth",
 ]

@@ -94,8 +94,13 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None,
     one dispatch never stacks more than opts.max_device_records rows; a
     SINGLE job beyond that budget routes through compact_blocks, whose
     blockwise path range-decomposes it instead of OOMing one dispatch.
+
+    Chunks pipeline (ops/pipeline.py): the next chunk's host stacking
+    prefetches on a pool worker under the current chunk's device
+    dispatch, bounded by PEGASUS_COMPACT_PIPELINE_DEPTH.
     """
     from .compact import compact_blocks
+    from .pipeline import CompactPipeline
 
     now = opts.resolved_now()
     outs = [None] * len(jobs)
@@ -112,6 +117,7 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None,
                                      device_runs=device_runs).block
             continue
         groups.setdefault(_signature(device_runs), []).append(j)
+    chunks = []
     for sig, all_idxs in groups.items():
         padded_lens, run_ws, w = sig
         # device budget: one dispatch stacks B x sum(padded_lens) rows —
@@ -124,44 +130,93 @@ def compact_partition_batch(jobs, opts: CompactOptions, mesh=None,
             # disengages for every chunk
             max_b -= max_b % mesh.size
         for chunk_at in range(0, len(all_idxs), max_b):
-            idxs = all_idxs[chunk_at:chunk_at + max_b]
-            _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts)
+            chunks.append((sig, all_idxs[chunk_at:chunk_at + max_b]))
+
+    def _prefetch(chunk):
+        sig, idxs = chunk
+        if LANE_GUARD.breaker_open(probe=False):
+            # the guard will route this chunk straight to cpu — poking a
+            # device the breaker has declared dead from an unguarded
+            # worker would only wedge pool workers for nothing
+            return RuntimeError("breaker open: prefetch skipped")
+        try:
+            return _stack_and_place(jobs, idxs, sig, mesh)
+        except Exception as e:  # noqa: BLE001 - the guarded dispatch
+            # re-stacks inline, so a stacking failure (device error, armed
+            # fail point) flows into the lane guard's retry/fallback
+            # policy instead of aborting the whole batch
+            return e
+
+    def _dispatch(i, prestacked):
+        sig, idxs = chunks[i]
+        if isinstance(prestacked, Exception):
+            prestacked = None
+        _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts,
+                   prestacked=prestacked)
+
+    # this map runs OUTSIDE any lane guard (each chunk's _run_group has
+    # its own), so prefetch pickup must be bounded: a wedged stacking
+    # worker is abandoned at the lane deadline and the chunk re-stacks
+    # inline under its guard — deadline/fallback/breaker all still apply.
+    # deadline <= 0 means "deadline disabled": wait unbounded like the
+    # guard would, never insta-timeout every prefetch
+    eff = LANE_GUARD.effective_deadline_s()
+    CompactPipeline(
+        prefetch_timeout_s=(eff if eff and eff > 0 else None)
+    ).map(chunks, _prefetch, _dispatch)
     return outs
 
 
-def _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts=None):
-    """One dispatch: stack the group's cached runs, run jit(vmap), gather
-    + post-filter each row's survivors into outs[job]. The whole dispatch
-    runs under the lane guard: a wedge/failure falls back to per-job cpu
+def _stack_and_place(jobs, idxs, sig, mesh):
+    """The chunk's "h2d" stage: stack the group's cached runs on the batch
+    axis (+ the dp re-placement) — HBM-to-HBM copies (the PCIe upload
+    already happened when the DeviceRuns were born), prefetchable on a
+    pipeline worker under the previous chunk's device dispatch."""
+    import jax
+
+    padded_lens, _, _ = sig
+    with _TRACE.span("h2d", records=len(idxs) * sum(padded_lens)):
+        _inject("compact.h2d")
+        cached, aux, real_lens, pidx_arr = _stack_group(
+            [(jobs[j][1], jobs[j][2]) for j in idxs])
+        if mesh is not None and len(idxs) % mesh.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = mesh.axis_names[0]
+
+            def shard_batch(x):
+                spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+                return jax.device_put(x, NamedSharding(mesh, spec))
+
+            cached = jax.tree_util.tree_map(shard_batch, cached)
+            aux = jax.tree_util.tree_map(shard_batch, aux)
+            real_lens = shard_batch(real_lens)
+            pidx_arr = shard_batch(pidx_arr)
+    return cached, aux, real_lens, pidx_arr
+
+
+def _run_group(jobs, idxs, sig, opts, now, mesh, outs, post_opts=None,
+               prestacked=None):
+    """One dispatch: stack the group's cached runs (or consume the
+    pipeline's prefetched stack), run jit(vmap), gather + post-filter
+    each row's survivors into outs[job]. The whole dispatch runs under
+    the lane guard: a wedge/failure falls back to per-job cpu
     compactions (byte-identical by contract)."""
 
     def _device_group() -> dict:
-        import jax
+        nonlocal prestacked
         import jax.numpy as jnp
 
         from ..engine.block import KVBlock
 
         padded_lens, run_ws, w = sig
         fn = _compiled_batched_pipeline(padded_lens, run_ws, w)
-        # "h2d" here is HBM-to-HBM batch stacking (+ the dp re-placement):
-        # the PCIe upload already happened when the DeviceRuns were born
-        with _TRACE.span("h2d", records=len(idxs) * sum(padded_lens)):
-            _inject("compact.h2d")
-            cached, aux, real_lens, pidx_arr = _stack_group(
-                [(jobs[j][1], jobs[j][2]) for j in idxs])
-            if mesh is not None and len(idxs) % mesh.size == 0:
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                axis = mesh.axis_names[0]
-
-                def shard_batch(x):
-                    spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
-                    return jax.device_put(x, NamedSharding(mesh, spec))
-
-                cached = jax.tree_util.tree_map(shard_batch, cached)
-                aux = jax.tree_util.tree_map(shard_batch, aux)
-                real_lens = shard_batch(real_lens)
-                pidx_arr = shard_batch(pidx_arr)
+        if prestacked is not None:
+            cached, aux, real_lens, pidx_arr = prestacked
+            prestacked = None  # a retry re-stacks: the stack may be the fault
+        else:
+            cached, aux, real_lens, pidx_arr = _stack_and_place(
+                jobs, idxs, sig, mesh)
         # np.asarray(counts) syncs on the whole batched dispatch
         with _TRACE.span("device", records=len(idxs) * sum(padded_lens)):
             _inject("compact.device")

@@ -1003,7 +1003,14 @@ def _compact_blockwise(runs, opts: CompactOptions,
     """Range-decomposed compaction for merges too big for device memory:
     boundary keys from the largest run's quantiles cut EVERY run into
     aligned disjoint key ranges; each range merges/dedups/filters
-    independently on the device and outputs concatenate in key order."""
+    independently on the device and outputs concatenate in key order.
+
+    With PEGASUS_COMPACT_PIPELINE_DEPTH > 1 (default 2) the ranges run
+    double-buffered (ops/pipeline.py): range i+1 packs/uploads on a host
+    worker and range i-1 gathers/post-filters while range i runs its
+    device merge — the stages pay max() instead of sum()."""
+    from .pipeline import pipeline_depth
+
     n_ranges = max(2, -(-total_in // opts.max_device_records))
     pivot = max(runs, key=lambda b: b.n)
     boundaries = []
@@ -1020,28 +1027,128 @@ def _compact_blockwise(runs, opts: CompactOptions,
     # every range (n_ranges x total memory, on exactly the bounded-memory
     # path). Compact such slices down to their own rows first.
     long_keys = max(int(b.key_len.max()) for b in runs) > 4 * opts.prefix_u32
-    out_blocks = []
-    n_out = 0
+    jobs = []  # (non-empty range_runs, range_total, direct)
     for lo_cut, hi_cut in zip(cuts, cuts[1:]):
         range_runs = [_slice_block(b, lo, hi)
                       for b, lo, hi in zip(runs, lo_cut, hi_cut)]
         if long_keys:
             range_runs = [rb.gather(np.arange(rb.n, dtype=np.int64))
                           for rb in range_runs]
+        range_runs = [rb for rb in range_runs if rb.n]
         range_total = sum(rb.n for rb in range_runs)
         if range_total == 0:
             continue
-        sub_opts = opts
-        if range_total >= total_in:
-            # degenerate key distribution (e.g. one repeated key): ranges
-            # cannot shrink — merge directly rather than recurse forever
-            from dataclasses import replace
-
-            sub_opts = replace(opts, max_device_records=range_total + 1)
-        res = compact_blocks(range_runs, sub_opts)
+        # direct ranges re-enter compact_blocks whole (with its own lane
+        # guard) instead of the split pack/device/gather stages: degenerate
+        # non-shrinking ranges, ranges still over budget (skewed keys ->
+        # recursive blockwise), and >255-run merges (pre-combine path)
+        direct = (range_total >= total_in
+                  or range_total > opts.max_device_records
+                  or len(range_runs) > 255)
+        jobs.append((range_runs, range_total, direct))
+    if len(jobs) > 1 and pipeline_depth() > 1:
+        return _compact_blockwise_pipelined(jobs, opts, total_in)
+    out_blocks = []
+    n_out = 0
+    for range_runs, range_total, _ in jobs:
+        res = compact_blocks(range_runs,
+                             _range_opts(opts, range_total, total_in))
         if res.block.n:
             out_blocks.append(res.block)
             n_out += res.block.n
+    out = (KVBlock.concat(out_blocks) if len(out_blocks) != 1
+           else out_blocks[0])
+    return CompactResult(out, _stats(total_in, n_out))
+
+
+def _range_opts(opts: CompactOptions, range_total: int,
+                total_in: int) -> CompactOptions:
+    """Per-range CompactOptions: a degenerate key distribution (e.g. one
+    repeated key) cannot shrink its range — merge it directly with a
+    raised budget rather than recurse forever."""
+    if range_total >= total_in:
+        from dataclasses import replace
+
+        return replace(opts, max_device_records=range_total + 1)
+    return opts
+
+
+def _compact_blockwise_pipelined(jobs, opts: CompactOptions,
+                                 total_in: int) -> CompactResult:
+    """Double-buffered range loop. The WHOLE pipelined run executes under
+    one lane guard: the device stages run in the guard's worker thread
+    (so a wedge anywhere — including a wedged prefetch the caller is
+    stalled on — is deadline-abandoned with stage attribution), and the
+    fallback drains the pipeline's in-flight workers before rerunning
+    every range serially on the cpu backend, byte-identical by the
+    backend contract."""
+    from dataclasses import replace
+
+    from .pipeline import CompactPipeline
+
+    # pin `now` once: the device attempt and a cpu rerun must filter
+    # against the same clock or a fallback could drop a different TTL set
+    now = opts.resolved_now()
+    opts = replace(opts, now=now)
+    fargs = (now, opts.pidx, opts.partition_mask,
+             bool(opts.bottommost), bool(opts.filter))
+    backend = get_backend(opts.backend)
+
+    def _device_pipelined() -> list:
+        pipe = CompactPipeline()
+
+        def _prefetch(job):
+            range_runs, _, direct = job
+            if direct:
+                return None
+            packed = pack_runs(range_runs, opts, need_sbytes=False)
+            return backend.prepare(packed)  # h2d upload on the worker
+
+        def _dispatch(i, prep):
+            range_runs, range_total, direct = jobs[i]
+            if direct:
+                return compact_blocks(
+                    range_runs, _range_opts(opts, range_total, total_in)
+                ).block
+            return backend.survivors_device(prep, *fargs)
+
+        def _finish(i, disp):
+            range_runs, _, direct = jobs[i]
+            if direct:
+                return disp
+            dev_idx, count = disp
+            concat = (range_runs[0] if len(range_runs) == 1
+                      else KVBlock.concat(range_runs))
+            out = gather_device_survivors(concat, dev_idx, count)
+            return apply_post_filters(out, opts, now)
+
+        return pipe.map(jobs, _prefetch, _dispatch, _finish)
+
+    def _cpu_serial() -> list:
+        return [
+            compact_blocks(
+                range_runs,
+                replace(_range_opts(opts, range_total, total_in),
+                        backend="cpu")).block
+            for range_runs, range_total, _ in jobs]
+
+    if backend.name == "tpu":
+        from ..runtime.lane_guard import LANE_GUARD
+
+        # the guard covers the WHOLE pipelined run, so its deadline must
+        # scale with the number of ranges — a large healthy compaction's
+        # legitimate device time is ~per-range time x n, and a fixed
+        # per-range deadline would falsely abandon it (and walk the
+        # breaker open). A wedge still aborts within n x deadline.
+        # eff <= 0 = deadline disabled, preserved by the multiply.
+        eff = LANE_GUARD.effective_deadline_s()
+        scaled = eff * len(jobs) if eff and eff > 0 else eff
+        blocks = LANE_GUARD.run(_device_pipelined, _cpu_serial,
+                                op="compact", deadline_s=scaled)
+    else:
+        blocks = _device_pipelined()
+    out_blocks = [b for b in blocks if b.n]
+    n_out = sum(b.n for b in out_blocks)
     out = (KVBlock.concat(out_blocks) if len(out_blocks) != 1
            else out_blocks[0])
     return CompactResult(out, _stats(total_in, n_out))

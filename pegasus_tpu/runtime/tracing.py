@@ -48,18 +48,25 @@ class TraceSession:
         self.stages = {}
         self.started_at = time.time()
 
-    def _add(self, stage: str, dur_s: float, records: int, nbytes: int):
+    def _add(self, stage: str, dur_s: float, records: int, nbytes: int,
+             cpu_s: float = 0.0):
         agg = self.stages.setdefault(
-            stage, {"s": 0.0, "calls": 0, "records": 0, "bytes": 0})
+            stage, {"s": 0.0, "cpu_s": 0.0, "calls": 0, "records": 0,
+                    "bytes": 0})
         agg["s"] += dur_s
+        agg["cpu_s"] += cpu_s
         agg["calls"] += 1
         agg["records"] += records
         agg["bytes"] += nbytes
 
     def summary(self) -> dict:
         """JSON-ready copy with rounded wall times (stage order = first
-        close order, which for a straight-line pipeline is stage order)."""
-        return {k: dict(v, s=round(v["s"], 6))
+        close order, which for a straight-line pipeline is stage order).
+        `cpu_s` is the PROCESS cpu-time delta across the span — host
+        contention is diagnosable from the artifact: cpu_s >> s means
+        other threads worked in parallel under the span; s >> cpu_s with
+        a high loadavg means the host starved the stage."""
+        return {k: dict(v, s=round(v["s"], 6), cpu_s=round(v["cpu_s"], 6))
                 for k, v in self.stages.items()}
 
 
@@ -101,10 +108,15 @@ class StageTracer:
             self._open.setdefault(tid, []).append((stage, time.time()))
         box = {"records": records, "bytes": nbytes}
         t0 = time.perf_counter()
+        c0 = time.process_time()
         try:
             yield box
         finally:
             dur_s = time.perf_counter() - t0
+            # process (not thread) cpu time: includes concurrent threads'
+            # work under the span — exactly what makes host contention
+            # attributable from a recorded trace (see TraceSession.summary)
+            cpu_s = time.process_time() - c0
             stack.pop()
             with self._lock:
                 open_list = self._open.get(tid)
@@ -113,10 +125,23 @@ class StageTracer:
                     if not open_list:
                         self._open.pop(tid, None)
                 self._spans.append((time.time(), depth, stage, dur_s,
-                                    box["records"], box["bytes"]))
+                                    box["records"], box["bytes"], cpu_s))
             self._export(stage, dur_s, box["records"], box["bytes"])
             for sess in self._session_list():
-                sess._add(stage, dur_s, box["records"], box["bytes"])
+                sess._add(stage, dur_s, box["records"], box["bytes"], cpu_s)
+
+    def event(self, stage: str, dur_s: float, records: int = 0,
+              nbytes: int = 0) -> None:
+        """Record a synthetic closed span — a duration computed after the
+        fact rather than timed in a context (the pipeline's per-range
+        overlap intervals). Lands in the ring buffer, the counter
+        registry and this thread's active sessions exactly like a span."""
+        with self._lock:
+            self._spans.append((time.time(), 0, stage, dur_s, records,
+                                nbytes, 0.0))
+        self._export(stage, dur_s, records, nbytes)
+        for sess in self._session_list():
+            sess._add(stage, dur_s, records, nbytes)
 
     def _export(self, stage, dur_s, records, nbytes):
         base = f"{self.prefix}.stage.{stage}"
@@ -188,8 +213,9 @@ class StageTracer:
             spans = list(self._spans)[-last:]
         return [{"ts": ts, "depth": depth, "stage": stage,
                  "duration_us": int(dur_s * 1e6),
+                 "cpu_us": int(cpu_s * 1e6),
                  "records": records, "bytes": nbytes}
-                for ts, depth, stage, dur_s, records, nbytes in spans]
+                for ts, depth, stage, dur_s, records, nbytes, cpu_s in spans]
 
     def dump(self, last: int = 100) -> str:
         rows = self.trace(last)

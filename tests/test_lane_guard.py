@@ -241,7 +241,101 @@ def test_capacity_local_failures_do_not_advance_breaker(guard):
     assert st["device_failures"] == 5
 
 
+# --------------------------------------------------- pipelined blockwise
+
+
+def _blockwise_runs(seed=5, n=400, k=2):
+    from pegasus_tpu.ops.compact import sort_block
+
+    rng = np.random.default_rng(seed)
+    return [sort_block(make_block(_adversarial_records(rng, n)),
+                       CompactOptions(backend="cpu")) for _ in range(k)]
+
+
+def test_wedged_pipeline_prefetch_abandoned_cpu_rerun_byte_equal(guard):
+    """Satellite (ISSUE 4): a wedged PREFETCH worker (armed at the
+    compact.pipeline stage) stalls the pipelined blockwise lane; the lane
+    guard's deadline abandons it WITHOUT deadlocking the drain — the
+    serial cpu rerun completes promptly and byte-identical."""
+    import time
+
+    guard.config.deadline_s = 0.3
+    runs = _blockwise_runs()
+    base = dict(now=100, bottommost=True, runs_sorted=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **base))
+    fp.cfg("compact.pipeline", "sleep(1500)")
+    t0 = time.perf_counter()
+    got = compact_blocks(runs, CompactOptions(
+        backend="tpu", max_device_records=200, **base))
+    elapsed = time.perf_counter() - t0
+    _assert_byte_equal(want.block, got.block)
+    st = guard.state()
+    assert st["deadline_abandons"] == 1
+    assert st["fallbacks"] == 1
+    assert st["retries"] == 0  # a wedge must NOT retry
+    # the cpu rerun did not wait out the 1.5s wedge: abandon + rerun only
+    assert elapsed < 1.2, elapsed
+    # the stall was attributable (open pipeline.stall span in the
+    # abandoned lane thread)
+    assert "pipeline.stall" in st["last_failure"]["error"]
+
+
+def test_pipeline_device_raise_drains_then_falls_back_byte_equal(guard):
+    """A raising device stage inside the pipelined blockwise lane drains
+    the in-flight prefetch workers (no deadlock), retries, then falls
+    back to the serial cpu rerun byte-identically."""
+    runs = _blockwise_runs(seed=21)
+    base = dict(now=100, bottommost=True, runs_sorted=True)
+    want = compact_blocks(runs, CompactOptions(backend="cpu", **base))
+    fp.cfg("compact.device", "raise(pipelined lane down)")
+    drains_before = counters.rate("compact.pipeline.drain_count")._value
+    got = compact_blocks(runs, CompactOptions(
+        backend="tpu", max_device_records=200, **base))
+    _assert_byte_equal(want.block, got.block)
+    st = guard.state()
+    assert st["fallbacks"] == 1
+    assert st["retries"] == 1  # transient-looking: the guard retried
+    # both guarded attempts drained the pipeline before giving it back
+    drained = counters.rate("compact.pipeline.drain_count")._value \
+        - drains_before
+    assert drained == 2, drained
+
+
 # ------------------------------------------- batched + sharded call sites
+
+
+def test_batched_wedged_prefetch_restacks_inline_no_hang(guard):
+    """A wedged stacking prefetch in the batched path (which runs OUTSIDE
+    any lane guard) must not hang compact_partition_batch: the bounded
+    prefetch pickup abandons the worker at the lane deadline and the
+    chunk re-stacks inline under its own guard, byte-equal."""
+    import time
+
+    from dataclasses import replace
+
+    from pegasus_tpu.ops.batched_compact import compact_partition_batch
+    from tests.test_batched_compact import make_partition
+
+    guard.config.deadline_s = 0.3
+    # max_device_records below 2x the per-job padded rows forces ONE job
+    # per chunk -> 2 chunks -> the map actually pipelines (n > 1) and the
+    # prefetch really rides a pool worker where compact.pipeline fires
+    opts = CompactOptions(backend="tpu", now=60, bottommost=True,
+                          runs_sorted=True, max_device_records=600)
+    jobs = []
+    for pidx in range(2):
+        runs, drs = make_partition(70 + pidx, 250)
+        assert sum(d.padded_len for d in drs) <= 600
+        jobs.append((runs, drs, pidx))
+    fp.cfg("compact.pipeline", "sleep(2000)")
+    t0 = time.perf_counter()
+    outs = compact_partition_batch(jobs, opts)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.8, elapsed  # bounded by the deadline, not the wedge
+    fp.cfg("compact.pipeline", "off()")
+    for (runs, _, pidx), got in zip(jobs, outs):
+        want = compact_blocks(runs, replace(opts, pidx=pidx, backend="cpu"))
+        _assert_byte_equal(want.block, got)
 
 
 def test_batched_compact_falls_back_byte_equal(guard):

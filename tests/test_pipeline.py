@@ -1,0 +1,441 @@
+"""Double-buffered compaction pipeline tests (ops/pipeline.py).
+
+Three tiers:
+  1. executor semantics — ordering, bounded depth, stall/overlap
+     accounting, drain-on-error;
+  2. byte equality — pipelined blockwise/engine compaction must be
+     byte-identical to serial on every backend, under adversarial inputs
+     (duplicate keys straddling range boundaries, TTL/tombstones at
+     range edges, degenerate single-repeated-key distributions);
+  3. the acceptance demonstration — with fail-point-delayed stages, the
+     pipelined wall time undercuts the sum of its own serial stage
+     times, and the `compact.pipeline.*` counters land in /metrics.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.base.value_schema import SCHEMAS
+from pegasus_tpu.ops.compact import CompactOptions, compact_blocks, sort_block
+from pegasus_tpu.ops.pipeline import CompactPipeline, pipeline_depth, submit
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.perf_counters import counters
+from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+from tests.test_compact_ops import _adversarial_records, make_block
+
+
+def _assert_blocks_byte_equal(a, b):
+    assert a.n == b.n
+    np.testing.assert_array_equal(a.key_arena, b.key_arena)
+    np.testing.assert_array_equal(a.val_arena, b.val_arena)
+    np.testing.assert_array_equal(a.expire_ts, b.expire_ts)
+    np.testing.assert_array_equal(a.deleted, b.deleted)
+
+
+# ------------------------------------------------------------ executor
+
+
+def test_depth_env_knob(monkeypatch):
+    monkeypatch.delenv("PEGASUS_COMPACT_PIPELINE_DEPTH", raising=False)
+    assert pipeline_depth() == 2
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "4")
+    assert pipeline_depth() == 4
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "0")
+    assert pipeline_depth() == 1  # floored: 0/negative = serial
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "junk")
+    assert pipeline_depth() == 2
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_map_preserves_item_order(depth):
+    items = list(range(7))
+    log = []
+
+    def prefetch(x):
+        return x * 10
+
+    def dispatch(i, p):
+        log.append(i)
+        return p + i
+
+    def finish(i, d):
+        return d + 1
+
+    out = CompactPipeline(depth=depth).map(items, prefetch, dispatch, finish)
+    assert out == [x * 10 + i + 1 for i, x in enumerate(items)]
+    assert log == list(range(7))  # dispatch strictly in item order
+
+
+def test_map_without_finish_returns_dispatch_results():
+    out = CompactPipeline(depth=2).map([3, 4], lambda x: x, lambda i, p: p * p)
+    assert out == [9, 16]
+
+
+def test_dispatch_error_drains_and_raises():
+    def dispatch(i, p):
+        if i == 1:
+            raise RuntimeError("device died")
+        return p
+
+    pipe = CompactPipeline(depth=2)
+    with pytest.raises(RuntimeError, match="device died"):
+        pipe.map(list(range(4)), lambda x: x, dispatch, lambda i, d: d)
+    assert pipe.drains == 1
+
+
+def test_prefetch_error_surfaces_on_its_item():
+    def prefetch(x):
+        if x == 2:
+            raise ValueError("bad pack")
+        return x
+
+    with pytest.raises(ValueError, match="bad pack"):
+        CompactPipeline(depth=2).map(list(range(4)), prefetch,
+                                     lambda i, p: p)
+
+
+def test_overlap_and_stall_accounting():
+    """Sleeping stages on disjoint resources: the pipeline's wall time
+    must undercut the serial stage sum, and the overlap/stall numbers
+    must reflect it."""
+    n = 4
+
+    def prefetch(x):
+        time.sleep(0.05)
+        return x
+
+    def dispatch(i, p):
+        time.sleep(0.05)
+        return p
+
+    pipe = CompactPipeline(depth=2)
+    t0 = time.perf_counter()
+    pipe.map(list(range(n)), prefetch, dispatch)
+    wall = time.perf_counter() - t0
+    serial_sum = n * 0.1
+    assert wall < serial_sum * 0.9, (wall, serial_sum)
+    assert pipe.overlap_s > 0.0
+    # the first prefetch is always a stall (nothing to overlap it with)
+    assert pipe.stall_s >= 0.04
+
+
+def test_prefetch_timeout_dispatches_marker_not_hang():
+    """A guard-less caller (batched compaction) bounds prefetch pickup:
+    a wedged worker is abandoned at the timeout and dispatch receives a
+    TimeoutError marker so it can redo the work inline."""
+    release = __import__("threading").Event()
+    seen = []
+
+    def prefetch(x):
+        if x == 1:
+            release.wait(10)  # wedged worker
+        return x
+
+    def dispatch(i, p):
+        seen.append(type(p).__name__)
+        return p
+
+    try:
+        t0 = time.perf_counter()
+        out = CompactPipeline(depth=2, prefetch_timeout_s=0.2).map(
+            [0, 1, 2], prefetch, dispatch)
+        assert time.perf_counter() - t0 < 5.0
+        assert seen == ["int", "TimeoutError", "int"]
+        assert isinstance(out[1], TimeoutError)
+    finally:
+        release.set()
+
+
+def test_submit_adopts_and_restores_trace_sessions():
+    """A pool worker must aggregate its spans into the SUBMITTER's
+    sessions for the task, then restore — reused workers must not keep
+    feeding a closed session."""
+    with COMPACT_TRACER.session() as sess:
+        fut = submit(lambda: COMPACT_TRACER.event("t_submit_probe", 0.001))
+        fut.result()
+    assert "t_submit_probe" in sess.stages
+    with COMPACT_TRACER.session() as sess2:
+        submit(lambda: None).result()  # same worker, new task, no adoption
+    assert "t_submit_probe" not in sess2.stages
+
+
+# --------------------------------------------- blockwise byte equality
+
+
+def _boundary_straddle_runs(rng, n_runs=3, n=500):
+    """Adversarial blockwise inputs: heavy duplicate keys shared across
+    runs (so every range boundary straddles versions of the same key),
+    TTL-expired and tombstoned records clustered at the key-space edges,
+    plus the generic adversarial key shapes."""
+    runs = []
+    for r in range(n_runs):
+        recs = []
+        for i in range(n):
+            bucket = int(rng.integers(0, 40))  # few hashkeys => many dups
+            hk = b"dup%04d" % bucket
+            sk = b"s%02d" % int(rng.integers(0, 6))
+            expire = int(rng.integers(0, 200)) if bucket % 3 == 0 else 0
+            deleted = bucket in (0, 39) and bool(rng.random() < 0.5)
+            recs.append((hk, sk, b"" if deleted else b"r%dv%d" % (r, i),
+                         expire, deleted))
+        recs += _adversarial_records(rng, 60)
+        runs.append(sort_block(make_block(recs),
+                               CompactOptions(backend="cpu")))
+    return runs
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_pipelined_blockwise_byte_equal_serial_and_cpu(seed, monkeypatch):
+    """Acceptance: pipelined blockwise output is byte-equal both to the
+    serial (depth=1) blockwise run and to the whole-merge cpu result, on
+    boundary-straddling duplicates and TTL/tombstone edge records."""
+    from dataclasses import replace
+
+    rng = np.random.default_rng(seed)
+    runs = _boundary_straddle_runs(rng)
+    base = CompactOptions(backend="tpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    cpu_whole = compact_blocks(runs, replace(base, backend="cpu"))
+    for budget in (300, 700):
+        split = replace(base, max_device_records=budget)
+        monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "1")
+        serial = compact_blocks(runs, split)
+        monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+        pipelined = compact_blocks(runs, split)
+        _assert_blocks_byte_equal(serial.block, pipelined.block)
+        _assert_blocks_byte_equal(cpu_whole.block, pipelined.block)
+        assert pipelined.stats == serial.stats
+
+
+def test_degenerate_repeated_keys_terminate_under_pipeline(monkeypatch):
+    """Non-shrinking-range guard under the pipeline: ranges dominated by
+    a single repeated key (cannot shrink below the budget) route through
+    the direct path and terminate, byte-equal to cpu."""
+    from dataclasses import replace
+
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+    # two hot keys, each repeated far beyond the budget -> every range is
+    # degenerate; plus a cold tail so multiple ranges exist at all
+    hot = [(b"hotA", b"s", b"v%d" % i, 0, False) for i in range(120)] \
+        + [(b"hotB", b"s", b"w%d" % i, 0, False) for i in range(120)]
+    cold = [(b"z%03d" % i, b"s", b"c%d" % i, 0, False) for i in range(40)]
+    one = sort_block(make_block(hot + cold), CompactOptions(backend="cpu"))
+    runs = [one, one]
+    base = CompactOptions(backend="tpu", now=50, runs_sorted=True)
+    want = compact_blocks(runs, replace(base, backend="cpu"))
+    got = compact_blocks(runs, replace(base, max_device_records=50))
+    _assert_blocks_byte_equal(want.block, got.block)
+
+
+def test_single_repeated_key_still_terminates(monkeypatch):
+    """The pure degenerate distribution (ranges can never shrink at all)
+    must terminate and dedup to one survivor, as before the pipeline."""
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+    one = sort_block(make_block([(b"k", b"s", b"v%d" % i, 0, False)
+                                 for i in range(50)]),
+                     CompactOptions(backend="cpu"))
+    res = compact_blocks([one, one], CompactOptions(
+        backend="tpu", now=50, runs_sorted=True, max_device_records=10))
+    assert res.block.n == 1
+
+
+# ------------------------------------------------ overlap demonstration
+
+
+def test_failpoint_delayed_stages_demonstrate_overlap(monkeypatch):
+    """Acceptance: with deterministic fail-point delays on the pack and
+    device stages, the pipelined wall time of a multi-range compaction is
+    LESS than the sum of its own serial stage times — and the per-range
+    overlap surfaces in the trace session, the ring buffer
+    (/compact/trace's source) and the counter registry."""
+    from dataclasses import replace
+
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+    rng = np.random.default_rng(7)
+    runs = _boundary_straddle_runs(rng, n_runs=2, n=120)
+    total = sum(b.n for b in runs)
+    opts = CompactOptions(backend="tpu", now=100, runs_sorted=True,
+                          max_device_records=max(64, total // 3))
+    want = compact_blocks(runs, replace(opts, backend="cpu",
+                                        max_device_records=1 << 40))
+    # warmup: identical shapes once, so jit compiles are cached and the
+    # measured run's stage times are dominated by the injected delays
+    compact_blocks(runs, opts)
+    fp.setup()
+    try:
+        fp.cfg("compact.pack", "sleep(150)")
+        fp.cfg("compact.device", "sleep(150)")
+        with COMPACT_TRACER.session() as sess:
+            t0 = time.perf_counter()
+            got = compact_blocks(runs, opts)
+            wall = time.perf_counter() - t0
+    finally:
+        fp.teardown()
+    _assert_blocks_byte_equal(want.block, got.block)
+    # serial sum: every stage second this compaction actually spent
+    # (pack+h2d on workers, device in the lane thread, gather on workers)
+    stage_sum = sum(v["s"] for k, v in sess.stages.items()
+                    if k in ("pack", "h2d", "device", "gather"))
+    assert wall < stage_sum * 0.9, (wall, sess.summary())
+    assert "pipeline.overlap" in sess.stages
+    assert sess.stages["pipeline.overlap"]["s"] > 0.05
+    assert counters.percentile(
+        "compact.pipeline.overlap_us").percentile(0.99) > 50_000
+    ring_stages = {r["stage"] for r in COMPACT_TRACER.trace(200)}
+    assert "pipeline.overlap" in ring_stages
+
+
+def test_pipeline_counters_reach_metrics_surface():
+    """compact.pipeline.* appears on the Prometheus /metrics rendering
+    after any pipelined run (the counters live in the one process-wide
+    registry every surface reads)."""
+    CompactPipeline(depth=2).map([1, 2, 3], lambda x: x, lambda i, p: p,
+                                 lambda i, d: d)
+    from pegasus_tpu.collector.reporter import prometheus_text
+
+    text = prometheus_text()
+    for name in ("compact_pipeline_depth", "compact_pipeline_prefetch_count",
+                 "compact_pipeline_overlap_us", "compact_pipeline_stall_us"):
+        assert name in text, name
+
+
+# ------------------------------------------------- engine byte equality
+
+
+def _filled_engine(path, backend):
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+
+    eng = LsmEngine(path, EngineOptions(
+        backend=backend, memtable_bytes=16 << 10, l0_compaction_trigger=2,
+        target_file_size_bytes=24 << 10, level_base_bytes=48 << 10,
+        level_size_ratio=4, max_levels=3))
+    rng = np.random.default_rng(3)
+    for i in range(2500):
+        eng.put(generate_key(b"hk%04d" % rng.integers(0, 500), b"s%d" % i),
+                SCHEMAS[2].generate_value(
+                    int(rng.integers(0, 60)) if i % 9 == 0 else 0, 0,
+                    b"v%d" % i))
+        if i % 23 == 0:
+            eng.delete(generate_key(b"hk%04d" % rng.integers(0, 500), b"sX"))
+    eng.flush()
+    eng.compact(now=100)
+    return eng
+
+
+def _engine_digest(eng):
+    import hashlib
+
+    h = hashlib.sha256()
+    for k, v, e in eng.scan(now=100):
+        h.update(k)
+        h.update(v)
+        h.update(str(e).encode())
+    return h.hexdigest()
+
+
+def test_engine_pipelined_installs_byte_equal_serial(tmp_path, monkeypatch):
+    """Acceptance: deferred (pipelined) engine installs serve and persist
+    the same data as serial installs, on both backends — including after
+    a reopen from disk (manifest settled by the drain)."""
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+
+    digests = {}
+    for backend in ("cpu", "tpu"):
+        for depth in ("1", "2"):
+            monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", depth)
+            eng = _filled_engine(str(tmp_path / f"{backend}{depth}"), backend)
+            digests[(backend, depth)] = _engine_digest(eng)
+            # on-disk state is settled: every level file exists
+            for s in eng._all_ssts_locked():
+                assert os.path.exists(s.path), s.path
+                assert s._on_disk
+            eng.close()
+    assert len(set(digests.values())) == 1, digests
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+    reopened = LsmEngine(str(tmp_path / "tpu2"), EngineOptions(backend="cpu"))
+    assert _engine_digest(reopened) == digests[("tpu", "2")]
+    reopened.close()
+
+
+def test_device_budget_accounting_balanced(tmp_path):
+    """The HBM budget must never under- or over-count across the
+    async-prime/release races: releasing an unbudgeted run subtracts
+    nothing, a retired file never primes, and prime->release round-trips
+    return the budget exactly to its starting point."""
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+
+    eng = LsmEngine(str(tmp_path / "db"), EngineOptions(
+        backend="tpu", memtable_bytes=1 << 20))
+    for i in range(100):
+        eng.put(generate_key(b"h%02d" % (i % 7), b"s%03d" % i),
+                SCHEMAS[2].generate_value(0, 0, b"v%d" % i))
+    eng.flush()
+    sst = eng._l0[0]
+    # settle any in-flight async prime, then measure from a known state
+    deadline = time.time() + 10
+    while True:
+        with eng._lock:
+            if not sst._prime_inflight:
+                break
+        assert time.time() < deadline
+        time.sleep(0.01)
+    eng._release_device_run(sst)
+    base = eng._device_cache_used
+    # releasing again (unbudgeted, already retired) subtracts nothing
+    eng._release_device_run(sst)
+    assert eng._device_cache_used == base
+    # a retired file never primes (late async prime loses the race)
+    assert eng._device_run_budgeted(sst) is None
+    assert eng._device_cache_used == base
+    # a fresh file's prime -> release round-trips the budget exactly
+    sst2 = None
+    for s in eng._l0:
+        if not s._device_retired:
+            sst2 = s
+            break
+    if sst2 is not None:
+        dr = eng._device_run_budgeted(sst2)
+        if dr is not None:
+            assert eng._device_cache_used == base + dr.nbytes()
+        eng._release_device_run(sst2)
+        assert eng._device_cache_used == base
+    eng.close()
+
+
+def test_deferred_install_failure_recovers_pre_merge_state(tmp_path,
+                                                          monkeypatch):
+    """A deferred install whose write_sst dies must keep the durability
+    invariant: the old manifest + input files stay on disk until the
+    drain's repair pass lands the outputs; the engine keeps serving the
+    merged view from memory throughout."""
+    monkeypatch.setenv("PEGASUS_COMPACT_PIPELINE_DEPTH", "2")
+    fp.setup()
+    try:
+        eng = _filled_engine(str(tmp_path / "db"), "cpu")
+        # a one-shot failure in the next pool-side install job (the
+        # compact.pipeline point fires in every pipeline-pool task): the
+        # worker dies before writing, the drain repairs synchronously
+        fp.cfg("compact.pipeline", "1*raise(injected install failure)")
+        rng = np.random.default_rng(9)
+        for i in range(2500):
+            eng.put(generate_key(b"qk%04d" % rng.integers(0, 300),
+                                 b"s%d" % i),
+                    SCHEMAS[2].generate_value(0, 0, b"w%d" % i))
+        eng.flush()
+        eng.compact(now=100)
+        for s in eng._all_ssts_locked():
+            assert os.path.exists(s.path) and s._on_disk
+        digest = _engine_digest(eng)
+        eng.close()
+        from pegasus_tpu.engine import EngineOptions, LsmEngine
+
+        reopened = LsmEngine(str(tmp_path / "db"),
+                             EngineOptions(backend="cpu"))
+        assert _engine_digest(reopened) == digest
+        reopened.close()
+    finally:
+        fp.teardown()

@@ -545,3 +545,20 @@ def test_throttling_controller_parse_and_consume():
     assert t.reject_units == 3
     assert t.parse_from_env("")   # empty disables
     assert not t.enabled
+
+
+def test_trace_overhead_bench_smoke():
+    """tools/trace_overhead_bench.py (ROADMAP: quantify tracing overhead
+    before revisiting PEGASUS_TRACE_SAMPLE_EVERY): runs at a tiny N and
+    emits sane per-span costs. The real numbers + guidance live in
+    README's Observability section."""
+    import tools.trace_overhead_bench as tob
+
+    out = tob.run(n=500)
+    assert set(out) == {"n", "stage_span_us", "stage_span_in_session_us",
+                        "stage_event_us", "request_trace_us"}
+    for k, v in out.items():
+        assert v > 0, (k, v)
+    # a stage span must stay far below the stages it wraps (>=10ms each):
+    # even on a loaded CI box, 1ms/span would mean the probe is broken
+    assert out["stage_span_us"] < 1000, out

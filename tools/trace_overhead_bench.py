@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Tracing-overhead microbench (ROADMAP open item: quantify span cost
+before revisiting PEGASUS_TRACE_SAMPLE_EVERY).
+
+Measures, at high event rates:
+  - StageTracer.span close cost (the compaction pipeline's per-stage
+    probe: ring append + 2-4 counter updates + optional session add);
+  - StageTracer.event cost (the pipeline's synthetic overlap records);
+  - RequestTracer root+span cost (the serving path's per-request trace:
+    what PEGASUS_TRACE_SAMPLE_EVERY gates).
+
+Prints ONE json line, e.g.
+  {"stage_span_us": ..., "stage_span_in_session_us": ...,
+   "stage_event_us": ..., "request_trace_us": ..., "n": ...}
+
+Per-span cost is amortized wall time over PEGASUS_TRACE_BENCH_N
+iterations (default 100_000; the RequestTracer loop runs n/10 — each
+iteration is a whole root trace). Interpreting the result: a compaction
+span wraps work in the 10ms..10s range, so ~10us/span is noise (<0.1%);
+a request trace costs ~3 spans on a put whose floor is ~100us of real
+work — raise PEGASUS_TRACE_SAMPLE_EVERY only if profiles show the
+tracer inside the top write-path costs at target QPS.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_stage_span(n: int, in_session: bool) -> float:
+    from pegasus_tpu.runtime.tracing import StageTracer
+
+    tr = StageTracer(prefix="t_overhead")
+
+    def loop():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tr.span("device", records=1, nbytes=64):
+                pass
+        return (time.perf_counter() - t0) / n
+
+    if not in_session:
+        return loop()
+    with tr.session():
+        return loop()
+
+
+def bench_stage_event(n: int) -> float:
+    from pegasus_tpu.runtime.tracing import StageTracer
+
+    tr = StageTracer(prefix="t_overhead_ev")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tr.event("pipeline.overlap", 0.001)
+    return (time.perf_counter() - t0) / n
+
+
+def bench_request_trace(n: int) -> float:
+    from pegasus_tpu.runtime.tracing import RequestTracer
+
+    rt = RequestTracer()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with rt.root("put"):
+            with rt.span("rpc.put"):
+                with rt.span("engine.write"):
+                    pass
+    return (time.perf_counter() - t0) / n
+
+
+def run(n: int = None) -> dict:
+    n = n or int(os.environ.get("PEGASUS_TRACE_BENCH_N", 100_000))
+    return {
+        "n": n,
+        "stage_span_us": round(bench_stage_span(n, False) * 1e6, 2),
+        "stage_span_in_session_us": round(
+            bench_stage_span(n, True) * 1e6, 2),
+        "stage_event_us": round(bench_stage_event(n) * 1e6, 2),
+        # one request trace = root + 2 nested spans + finalize
+        "request_trace_us": round(
+            bench_request_trace(max(1, n // 10)) * 1e6, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))
