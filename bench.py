@@ -535,10 +535,13 @@ def _arm_watchdog():
         # crash path that could print a second JSON line. The two temp
         # files leak at hard-exit — harmless vs a corrupted artifact.
         if not _RESULT_PRINTED:
-            n_total, n_runs, value_size, _ = _bench_params()
-            _emit(_degraded(n_total, n_runs, value_size,
-                            f"watchdog fired after {budget}s",
-                            detail=_CPU_DETAIL))
+            if os.environ.get("PEGASUS_BENCH_MODE") == "ycsb":
+                _emit(_ycsb_degraded(f"watchdog fired after {budget}s"))
+            else:
+                n_total, n_runs, value_size, _ = _bench_params()
+                _emit(_degraded(n_total, n_runs, value_size,
+                                f"watchdog fired after {budget}s",
+                                detail=_CPU_DETAIL))
         proc = _LANE_STATE["proc"]
         if proc is not None and proc.poll() is None:
             proc.send_signal(signal.SIGTERM)  # SIGTERM only, never SIGKILL
@@ -549,6 +552,191 @@ def _arm_watchdog():
     t = threading.Timer(budget, boom)
     t.daemon = True
     t.start()
+
+
+def _ycsb_params():
+    """(records, ops, threads, partitions, value_size) for the serving
+    bench — single source for the lane, the watchdog, and the crash
+    handler so a degraded line's metric name matches the success path's."""
+    return (int(os.environ.get("PEGASUS_BENCH_YCSB_RECORDS", 10_000)),
+            int(os.environ.get("PEGASUS_BENCH_YCSB_OPS", 20_000)),
+            int(os.environ.get("PEGASUS_BENCH_YCSB_THREADS", 8)),
+            int(os.environ.get("PEGASUS_BENCH_YCSB_PARTITIONS", 32)),
+            int(os.environ.get("PEGASUS_BENCH_VALUE", 100)))
+
+
+def _ycsb_metric_name() -> str:
+    records, ops, threads, partitions, value_size = _ycsb_params()
+    return (f"YCSB-A 50/50 read-update ops/sec ({records} records, "
+            f"{ops} ops, {threads} threads, {partitions} partitions, "
+            f"value={value_size}B)")
+
+
+def _ycsb_degraded(reason: str, detail: dict = None) -> dict:
+    d = {"degraded": True, "reason": reason}
+    d.update(detail or {})
+    return {"metric": _ycsb_metric_name(), "value": None, "unit": "ops/s",
+            "vs_baseline": None, "detail": d}
+
+
+class ZipfKeys:
+    """YCSB's quick-zipfian rank generator (Gray et al., SIGMOD '94
+    "Quickly generating billion-record synthetic databases"): ranks over
+    [0, n) with P(rank k) ~ 1/(k+1)^theta. The naive continuous inverse
+    transform (`u ** (1/(1-theta))`) is NOT zipf — at theta=0.99 it puts
+    ~91% of all picks on rank 0, so an ops/sec number produced with it
+    measures one hot key on one partition instead of a skewed workload."""
+
+    def __init__(self, n: int, theta: float = 0.99):
+        self.n = n
+        self.zetan = float(np.sum(1.0 / np.arange(1, n + 1) ** theta))
+        self.zeta2 = 1.0 + 0.5 ** theta
+        self.alpha = 1.0 / (1.0 - theta)
+        self.eta = ((1.0 - (2.0 / n) ** (1.0 - theta))
+                    / (1.0 - self.zeta2 / self.zetan))
+
+    def pick(self, rng) -> int:
+        u = rng.random()
+        uz = u * self.zetan
+        if uz < 1.0:
+            return 0
+        if uz < self.zeta2:
+            return 1
+        return min(self.n - 1,
+                   int(self.n * (self.eta * u - self.eta + 1.0) ** self.alpha))
+
+
+def _max_quantiles(dicts):
+    """Collector-style merge of percentile dicts across partitions: the
+    max per quantile (the worst partition bounds the fleet)."""
+    out = {}
+    for d in dicts:
+        for q, v in d.items():
+            out[q] = max(out.get(q, 0), v)
+    return out
+
+
+def ycsb_main():
+    """PEGASUS_BENCH_MODE=ycsb: the serving-path lane — BASELINE.json's
+    SECOND metric (YCSB-A 50/50 read/update over hash partitions), never
+    recorded before this lane existed. Boots an in-process onebox (1 meta
+    + 3 replica nodes over real sockets), loads N records, drives 50/50
+    read/update from T client threads, and prints ONE json line with
+    ops/sec, per-op-class p99 (from the server's <op>_latency_us
+    percentiles), the plog group-size histogram and
+    replica.prepare_latency_us (so the group-commit win is attributable),
+    and a detail.host block (so host contention can't masquerade as a
+    regression).
+
+    The serving path is host-only: jax is pinned to the cpu platform
+    BEFORE any engine import, so this mode never touches the axon device
+    lease the compaction bench's child-process discipline protects."""
+    import threading
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _enable_compile_cache()
+
+    records, n_ops, n_threads, partitions, value_size = _ycsb_params()
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    from tools._onebox import Onebox
+
+    host_start = _host_info()
+    proc_t0 = time.process_time()
+    box = Onebox("ycsb", partitions=partitions)
+    try:
+        value = os.urandom(value_size)
+        load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+        t0 = time.perf_counter()
+        for i in range(records):
+            load_cli.set(b"user%012d" % i, b"f0", value)
+        load_s = time.perf_counter() - t0
+        load_cli.close()
+
+        errors = [0]
+        read_lat = counters.percentile("bench.ycsb.read_latency_us")
+        update_lat = counters.percentile("bench.ycsb.update_latency_us")
+        zipf = ZipfKeys(records)
+
+        def worker(tid):
+            import random
+
+            rng = random.Random(tid)
+            cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+            for _ in range(n_ops // n_threads):
+                k = b"user%012d" % zipf.pick(rng)
+                s = time.perf_counter()
+                try:
+                    if rng.random() < 0.5:
+                        cli.get(k, b"f0")
+                        read_lat.set(int((time.perf_counter() - s) * 1e6))
+                    else:
+                        cli.set(k, b"f0", value)
+                        update_lat.set(int((time.perf_counter() - s) * 1e6))
+                except Exception:
+                    errors[0] += 1
+            cli.close()
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        run_s = time.perf_counter() - t0
+
+        # ---- attribution: server-side latency percentiles per op class
+        # (max across partitions, the collector's merge rule), the plog
+        # group-commit histogram, and the prepare round's latency
+        snap = counters.snapshot()
+        server_lat = {}
+        for op in ("get", "put"):
+            dicts = [v for k, v in snap.items()
+                     if k.startswith("app.") and k.endswith(f".{op}_latency_us")
+                     and isinstance(v, dict)]
+            if dicts:
+                server_lat[op] = _max_quantiles(dicts)
+        append_count = flush_count = 0
+        for stub in box.cluster.stubs:
+            for rep in stub._replicas.values():
+                append_count += rep.plog.append_count
+                flush_count += rep.plog.flush_count
+        done_ops = n_threads * (n_ops // n_threads)
+        result = {
+            "metric": _ycsb_metric_name(),
+            "value": round(done_ops / run_s, 1),
+            "unit": "ops/s",
+            "vs_baseline": None,  # first recording of this BASELINE metric
+            "detail": {
+                "run_s": round(run_s, 2),
+                "load_s": round(load_s, 2),
+                "load_ops_s": round(records / max(load_s, 1e-9), 1),
+                "errors": errors[0],
+                "client_latency_us": {
+                    "read": read_lat.percentiles(),
+                    "update": update_lat.percentiles(),
+                },
+                "server_latency_us": server_lat,
+                "prepare_latency_us": snap.get("replica.prepare_latency_us"),
+                "plog": {
+                    "group_size": snap.get("plog.append.group_size"),
+                    "append_count": append_count,
+                    "flush_count": flush_count,
+                    "group_ratio": round(
+                        append_count / max(flush_count, 1), 3),
+                },
+                "partitions": partitions,
+                "threads": n_threads,
+                "records": records,
+                "cpu_process_s": round(time.process_time() - proc_t0, 3),
+                "host": {"start": host_start, "end": _host_info()},
+            },
+        }
+    finally:
+        box.stop()
+    _emit(result)
 
 
 def main():
@@ -663,14 +851,22 @@ if __name__ == "__main__":
     if "--tpu-lane" in sys.argv:
         tpu_lane_main()
         sys.exit(0)
+    _mode = os.environ.get("PEGASUS_BENCH_MODE", "")
     try:
-        main()
+        if _mode == "ycsb":
+            _arm_watchdog()
+            ycsb_main()
+        else:
+            main()
     except Exception as e:  # noqa: BLE001 - the driver needs a JSON line, always
         import traceback
 
         traceback.print_exc()
         if not _RESULT_PRINTED:
-            n_total, n_runs, value_size, _ = _bench_params()
-            _emit(_degraded(n_total, n_runs, value_size,
-                            f"bench crashed: {e!r}", detail=_CPU_DETAIL))
+            if _mode == "ycsb":
+                _emit(_ycsb_degraded(f"bench crashed: {e!r}"))
+            else:
+                n_total, n_runs, value_size, _ = _bench_params()
+                _emit(_degraded(n_total, n_runs, value_size,
+                                f"bench crashed: {e!r}", detail=_CPU_DETAIL))
         sys.exit(0)

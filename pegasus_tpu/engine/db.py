@@ -226,6 +226,55 @@ class LsmEngine:
             # way when memtables back up)
             self._drain_imms()
 
+    def write_batch(self, pairs) -> None:
+        """Apply a contiguous committed decree window — `pairs` is
+        [(WriteBatch, decree)] in decree order — under ONE engine lock
+        acquisition. Consecutive same-kind ops collapse into memtable
+        put_batch/delete_batch calls; decree bookkeeping still advances
+        per decree, so a mid-window failure (fail points) leaves
+        last_committed_decree exactly at the last fully-applied decree."""
+        if not pairs:
+            return
+        if _fail("db_write"):
+            raise IOError("injected db_write failure")
+        fail_put = _fail("db_write_batch_put")
+        fail_del = _fail("db_write_batch_delete")
+        rotated = False
+        with self._lock:
+            for batch, decree in pairs:
+                run_kind, run = None, []
+                for op in batch.ops + [None]:  # None flushes the last run
+                    kind = op[0] if op is not None else None
+                    if kind != run_kind and run:
+                        if run_kind == "put":
+                            if fail_put:
+                                raise IOError(
+                                    "injected db_write_batch_put failure")
+                            self._mem.put_batch(run)
+                        else:
+                            if fail_del:
+                                raise IOError(
+                                    "injected db_write_batch_delete failure")
+                            self._mem.delete_batch(run)
+                        run = []
+                    if op is None:
+                        break
+                    run_kind = kind
+                    if kind == "put":
+                        run.append((op[1], op[2], op[3]))
+                    elif kind == "del":
+                        run.append(op[1])
+                    else:
+                        raise ValueError(f"unknown op {kind}")
+                self._last_committed_decree = decree
+                self._meta[META_LAST_FLUSHED_DECREE] = decree
+                self._mem.last_decree = decree
+                if self._mem.approximate_bytes >= self.opts.memtable_bytes:
+                    self._rotate_memtable_locked()
+                    rotated = True
+        if rotated:
+            self._drain_imms()
+
     def put(self, key: bytes, value: bytes, expire_ts: int = 0, decree: int = None):
         d = decree if decree is not None else self._last_committed_decree + 1
         self.write(WriteBatch().put(key, value, expire_ts), d)

@@ -38,6 +38,32 @@ class Memtable:
         self._data[key] = (b"", 0, True)
         self._bytes += len(key)
 
+    def put_batch(self, items):
+        """Insert many (key, value, expire_ts) records in one call — the
+        committed-window apply path pays one method dispatch (and one
+        attribute walk) per BATCH instead of per record."""
+        data = self._data
+        delta = 0
+        for key, value, expire_ts in items:
+            old = data.get(key)
+            if old is not None:
+                delta -= len(key) + len(old[0])
+            data[key] = (value, expire_ts, False)
+            delta += len(key) + len(value)
+        self._bytes += delta
+
+    def delete_batch(self, keys):
+        """Tombstone many keys in one call (put_batch's twin)."""
+        data = self._data
+        delta = 0
+        for key in keys:
+            old = data.get(key)
+            if old is not None:
+                delta -= len(key) + len(old[0])
+            data[key] = (b"", 0, True)
+            delta += len(key)
+        self._bytes += delta
+
     def get(self, key: bytes):
         """-> (value, expire_ts, deleted) or None if the key was never seen."""
         return self._data.get(key)

@@ -358,6 +358,32 @@ class WriteService:
 
     # ------------------------------------------------- batched put/remove
 
+    def apply_batched_window(self, entries):
+        """Apply a contiguous committed decree window of BATCHABLE
+        mutations — `entries` is [(decree, timestamp_us, [(code, req)])]
+        — in ONE engine call (engine.write_batch: one lock acquisition
+        for the whole window) instead of k. -> {decree: response list}."""
+        from ..rpc.task_codes import RPC_PUT
+
+        pairs, resps = [], {}
+        for decree, timestamp_us, reqs in entries:
+            wb = WriteBatch()
+            rl = []
+            for code, req in reqs:
+                if code == RPC_PUT:
+                    value = self._encode(req.value, req.expire_ts_seconds,
+                                         timestamp_us)
+                    wb.put(req.key, value, req.expire_ts_seconds)
+                else:
+                    wb.delete(req.key)
+                rl.append(self._fill(msg.UpdateResponse(), decree))
+            pairs.append((wb, decree))
+            resps[decree] = rl
+        with REQUEST_TRACER.span("engine.write", decree=entries[-1][0],
+                                 records=sum(len(e[2]) for e in entries)):
+            self.engine.write_batch(pairs)
+        return resps
+
     def batch_prepare(self):
         self._batch = WriteBatch()
 

@@ -335,6 +335,11 @@ class PrepareRequest:
     ballot: int = 0
     committed_decree: int = 0
     mutation: bytes = b""             # codec-encoded LogMutation
+    # decree-pipelined window [d1..dk]: one prepare RPC carries every
+    # mutation of the round (codec-encoded LogMutations, decree order).
+    # Appended last per the codec's append-only evolution rule; when
+    # non-empty it supersedes `mutation`.
+    mutations: List[bytes] = field(default_factory=list)
 
 
 @dataclass

@@ -38,6 +38,29 @@ reasons that apply here, only stronger:
 The one capability the slog bought — cross-replica batched fsync on one
 spindle — is irrelevant on flash and under group commit; nothing else in
 the recovery story needs it.
+
+Group commit (the batched fsync the docstring above promises): appends
+buffer into a bounded group — the first appender with no active leader
+claims everything buffered and lands it with ONE buffered write + ONE
+flush (+ one fsync when `fsync=True`); appenders arriving meanwhile form
+the next group. `PEGASUS_PLOG_GROUP_N` caps mutations per group (32);
+`PEGASUS_PLOG_GROUP_US` (500) bounds how long a leader that claimed a
+concurrent group lingers for stragglers — a solo appender never lingers,
+so single-writer latency is unchanged. An append returns only after its
+group is durable (never ack before durable); a leader wedged between
+claim and flush (`plog.group` fail point) degrades unclaimed appends to
+the per-append path instead of hanging the partition. Group sizes export
+as `plog.append.group_size`, flushes as `plog.append.flush_count`.
+
+Reachability note, to be honest about what runs where: PacificA holds
+the replica lock across every append call site, so per-partition the
+log sees ONE appender at a time and a group is normally exactly one
+append_window entry — the decree window IS the group, and that is where
+the batching win comes from. The leader/follower machinery above it is
+the general multi-appender contract (chaos tests drive it with raw
+threads; a future shared-log caller gets correct grouping for free) and
+carries the wedge-degrade path; it adds one cv round-trip, no waiting,
+on the solo path.
 """
 
 import os
@@ -49,10 +72,23 @@ from dataclasses import dataclass, field
 from typing import List
 
 from ..rpc import codec
+from ..runtime.fail_points import inject
 from ..runtime.perf_counters import counters
 from ..runtime.tracing import REQUEST_TRACER
 
 _FRAME = struct.Struct("<II")
+
+
+class _GroupEntry:
+    """One append (or one decree window) waiting for its group to land."""
+
+    __slots__ = ("frames", "decrees", "done", "err")
+
+    def __init__(self, frames, decrees):
+        self.frames = frames
+        self.decrees = decrees
+        self.done = False
+        self.err = None
 
 
 @dataclass
@@ -71,11 +107,32 @@ class LogMutation:
 
 class MutationLog:
     def __init__(self, log_dir: str, segment_bytes: int = 32 << 20,
-                 fsync: bool = False):
+                 fsync: bool = False, group_n: int = None,
+                 group_us: int = None):
         self.dir = log_dir
         self.segment_bytes = segment_bytes
         self.fsync = fsync
+        # group commit knobs: a group is capped at `group_n` mutations; a
+        # leader that claimed a CONCURRENT group (>= 2 entries) lingers up
+        # to `group_us` for stragglers. A solo appender never lingers, so
+        # low-QPS latency is unchanged with the knobs at their defaults.
+        self.group_n = group_n if group_n is not None else \
+            int(os.environ.get("PEGASUS_PLOG_GROUP_N", 32))
+        self.group_us = group_us if group_us is not None else \
+            int(os.environ.get("PEGASUS_PLOG_GROUP_US", 500))
+        # follower stall bound: a group leader wedged between buffer and
+        # flush (chaos fail point `plog.group`, or a pathological fsync)
+        # must degrade unclaimed appends to the per-append path instead of
+        # hanging the partition
+        self._stall_s = float(
+            os.environ.get("PEGASUS_PLOG_GROUP_STALL_MS", 500)) / 1e3
         self._lock = threading.Lock()
+        self._gcv = threading.Condition()
+        self._gbuf = []            # unclaimed _GroupEntry, submit order
+        self._gleader = False      # a leader is writing a group
+        self._degraded_until = 0.0  # monotonic ts; bypass grouping until
+        self.append_count = 0      # monotonic totals (instance-level, so
+        self.flush_count = 0       # tests can assert the grouping ratio)
         self._file = None
         self._file_start = None
         self._file_bytes = 0
@@ -87,24 +144,140 @@ class MutationLog:
 
     # ----------------------------------------------------------------- write
 
-    def append(self, m: LogMutation) -> None:
+    @staticmethod
+    def _frame(m: LogMutation) -> bytes:
         payload = codec.encode(m)
-        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+    def append(self, m: LogMutation) -> None:
+        """Append one mutation; returns once it is DURABLE (its group's
+        write+flush(+fsync) completed) — never before."""
+        self._submit(_GroupEntry([self._frame(m)], [m.decree]))
+
+    def append_window(self, ms: List[LogMutation]) -> None:
+        """Append a contiguous decree window as ONE group member: the
+        whole window lands with one buffered write + one flush (+ one
+        fsync when armed) — the primary's decree-pipelined prepare path
+        and the secondary's windowed on_prepare both land here."""
+        if not ms:
+            return
+        self._submit(_GroupEntry([self._frame(m) for m in ms],
+                                 [m.decree for m in ms]))
+
+    def _submit(self, entry: _GroupEntry) -> None:
         t0 = time.perf_counter()
-        with REQUEST_TRACER.span("plog.append", decree=m.decree,
-                                 bytes=len(frame)), self._lock:
+        nbytes = sum(len(f) for f in entry.frames)
+        with REQUEST_TRACER.span("plog.append", decree=entry.decrees[-1],
+                                 bytes=nbytes, batch=len(entry.frames)):
+            if time.monotonic() < self._degraded_until:
+                # a recent group leader wedged: per-append fallback keeps
+                # the partition moving (groups resume after the cooldown)
+                self._write_group([entry])
+            else:
+                self._group_commit(entry)
+        if entry.err is not None:
+            raise entry.err
+        counters.rate("plog.append.count").increment(len(entry.frames))
+        counters.rate("plog.append.bytes").increment(nbytes)
+        counters.percentile("plog.append.duration_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+
+    def _group_commit(self, entry: _GroupEntry) -> None:
+        """Leader/follower group commit: the first appender to find no
+        active leader claims everything buffered and lands it as one
+        group; appenders that arrive while it writes buffer into the NEXT
+        group. A follower whose entry is still unclaimed after _stall_s
+        steals it back and degrades to the per-append path."""
+        with self._gcv:
+            self._gbuf.append(entry)
+            self._gcv.notify_all()  # wake a lingering leader
+        while True:
+            fallback = False
+            with self._gcv:
+                if entry.done:
+                    return
+                if self._gleader:
+                    if self._gcv.wait(self._stall_s):
+                        continue
+                    if entry not in self._gbuf:
+                        continue  # claimed: durability requires waiting
+                    # leader wedged and never claimed us: steal our entry
+                    # back and degrade to the per-append path for a while
+                    self._gbuf.remove(entry)
+                    self._degraded_until = time.monotonic() + self._stall_s
+                    fallback = True
+                else:
+                    self._gleader = True
+                    batch = self._claim_locked([])
+            if fallback:
+                counters.rate("plog.group.fallback_count").increment()
+                self._write_group([entry])
+                return
+            # ---- leader, outside the cv: stragglers queue for next group
+            try:
+                if len(batch) >= 2 and self.group_us > 0:
+                    batch = self._linger(batch)
+                inject("plog.group")  # chaos seam: between claim and flush
+                self._write_group(batch)
+            except Exception as e:  # noqa: BLE001 - every member must see it
+                err = e if isinstance(e, OSError) else OSError(
+                    f"plog group write failed: {e!r}")
+                for b in batch:
+                    b.err = err
+            finally:
+                with self._gcv:
+                    self._gleader = False
+                    for b in batch:
+                        b.done = True
+                    self._gcv.notify_all()
+
+    def _claim_locked(self, batch: list) -> list:
+        """Move buffered entries into `batch` up to the group_n cap.
+        Caller holds self._gcv."""
+        total = sum(len(b.frames) for b in batch)
+        while self._gbuf and total < self.group_n:
+            e = self._gbuf.pop(0)
+            batch.append(e)
+            total += len(e.frames)
+        return batch
+
+    def _linger(self, batch: list) -> list:
+        """A leader that already claimed a concurrent group (>= 2 members)
+        waits up to group_us for stragglers, growing toward group_n."""
+        deadline = time.monotonic() + self.group_us / 1e6
+        while sum(len(b.frames) for b in batch) < self.group_n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            with self._gcv:
+                if not self._gbuf:
+                    self._gcv.wait(remaining)
+                batch = self._claim_locked(batch)
+        return batch
+
+    def _write_group(self, batch: list) -> None:
+        """Land a claimed group: ONE buffered write + ONE flush (+ one
+        fsync when armed) for every frame in every member. The `plog.group`
+        fail point fires in _group_commit between claim and flush, OUTSIDE
+        the file lock, so a chaos `sleep` wedges only that group — the
+        degraded per-append path still reaches the file here."""
+        n_frames = sum(len(b.frames) for b in batch)
+        blob = b"".join(f for b in batch for f in b.frames)
+        first_decree = batch[0].decrees[0]
+        with self._lock:
             if self._file is None or self._file_bytes >= self.segment_bytes:
-                self._roll_locked(m.decree)
-            self._file.write(frame)
+                self._roll_locked(first_decree)
+            self._file.write(blob)
             self._file.flush()
             if self.fsync:
                 os.fsync(self._file.fileno())
-            self._file_bytes += len(frame)
-            self.last_decree = max(self.last_decree, m.decree)
-        counters.rate("plog.append.count").increment()
-        counters.rate("plog.append.bytes").increment(len(frame))
-        counters.percentile("plog.append.duration_us").set(
-            int((time.perf_counter() - t0) * 1e6))
+            self._file_bytes += len(blob)
+            for b in batch:
+                self.last_decree = max(self.last_decree, b.decrees[-1])
+            self.append_count += n_frames
+            self.flush_count += 1
+        counters.rate("plog.append.flush_count").increment()
+        counters.percentile("plog.append.group_size").set(n_frames)
 
     def _roll_locked(self, start_decree: int) -> None:
         if self._file:

@@ -6,7 +6,14 @@ writes per partition; each mutation gets a decree, appends to the private
 log, and is sent RPC_PREPARE to every secondary; the primary commits (=
 applies to the storage engine via on_batched_write_requests) once
 `mutation_2pc_min_replica_count` replicas (incl. itself) hold it in their
-logs. Commit points piggyback on later prepares. PacificA invariants kept:
+logs. Commit points piggyback on later prepares. DECREE PIPELINING:
+mutations arriving while a prepare round is in flight coalesce into the
+next round — one prepare RPC carries the contiguous decree window
+[d1..dk], the plog lands the window as one group append, secondaries
+append the window in order and ack the highest contiguous decree, and the
+engine applies the committed window in one batched call (per-item
+overheads amortize; the protocol itself is untouched). PacificA
+invariants kept:
 
   - prepares apply in decree order; a secondary acks decree d only when its
     log holds every decree <= d (so last_prepared is contiguous coverage);
@@ -154,16 +161,13 @@ class Replica:
     def client_write(self, code: str, req, now: int = None):
         """The write path: 2PC from the primary (SURVEY §3.2 hot path).
 
-        Batchable codes (put/remove) GROUP-COMMIT: concurrent writers
-        coalesce into one decree — one log append and one prepare round for
-        the whole batch, the reference's on_batched_writes shape
-        (src/server/pegasus_server_write.cpp:64-110). Non-batchable codes
-        (read-modify-write) commit alone."""
-        from ..rpc.task_codes import BATCHABLE
-
-        if code not in BATCHABLE:
-            with self._lock:
-                return self._commit_batch([(code, req)], now=now)[0]
+        DECREE PIPELINING: every mutation gets its OWN decree (the
+        reference's one-decree-per-mutation shape), but mutations that
+        arrive while a prepare round is in flight coalesce into the NEXT
+        round — one prepare RPC carries the whole contiguous decree
+        window [d1..dk], the plog lands it as one group append, and the
+        engine applies the committed window in one batched call. Commit
+        points piggyback on later prepares exactly as before."""
         slot = _WriteSlot(code, req)
         with self._batch_cv:
             self._batch_pending.append(slot)
@@ -180,18 +184,16 @@ class Replica:
                 self._batch_leader_active = True
                 batch = self._batch_pending
                 self._batch_pending = []
-            # this thread leads one group commit (outside the cv so arriving
-            # writers can queue for the NEXT batch meanwhile)
+            # this thread leads one window commit (outside the cv so
+            # arriving writers can queue for the NEXT window meanwhile)
             try:
                 with self._lock:
-                    resps = self._commit_batch(
-                        [(s.code, s.req) for s in batch], now=now)
-                for s, r in zip(batch, resps):
-                    s.resp = r
+                    self._commit_window(batch, now=now)
             except Exception as e:  # every waiter must see the failure, not
                 for s in batch:     # a silent resp=None "success"
-                    s.err = e if isinstance(e, ReplicaError) \
-                        else ReplicaError(f"group commit failed: {e!r}")
+                    if s.err is None and s.resp is None:
+                        s.err = e if isinstance(e, ReplicaError) \
+                            else ReplicaError(f"group commit failed: {e!r}")
             finally:
                 with self._batch_cv:
                     self._batch_leader_active = False
@@ -202,22 +204,24 @@ class Replica:
             raise slot.err
         return slot.resp
 
-    def _commit_batch(self, reqs, now=None):
-        """One decree for `reqs`; caller holds self._lock."""
+    def _commit_window(self, slots, now=None):
+        """One contiguous decree window for `slots` (one decree each);
+        caller holds self._lock. Fills each slot's resp/err in place."""
         if self.status != PRIMARY:
             raise ReplicaError(f"{self.name} is not primary")
-        decree = self.last_prepared + 1
-        m = LogMutation(decree=decree, ballot=self.ballot,
-                        timestamp_us=int(time.time() * 1e6),
-                        codes=[c for c, _ in reqs],
-                        bodies=[codec.encode(r) for _, r in reqs])
+        d0 = self.last_prepared + 1
+        ts = int(time.time() * 1e6)
+        ms = [LogMutation(decree=d0 + i, ballot=self.ballot, timestamp_us=ts,
+                          codes=[s.code], bodies=[codec.encode(s.req)])
+              for i, s in enumerate(slots)]
+        dk = ms[-1].decree
         t0 = time.perf_counter()
-        with REQUEST_TRACER.span("replica.prepare", decree=decree,
-                                 batch=len(reqs)):
-            self.plog.append(m)
-            self.last_prepared = decree
-            self._uncommitted[decree] = m
-            acks = 1
+        with REQUEST_TRACER.span("replica.prepare", decree=dk,
+                                 batch=len(ms)):
+            self.plog.append_window(ms)
+            self.last_prepared = dk
+            for m in ms:
+                self._uncommitted[m.decree] = m
             secs = list(self.view.secondaries)
             if len(secs) > 1 and _parallel_prepare():
                 # prepares fan out concurrently: commit latency is
@@ -230,26 +234,44 @@ class Replica:
 
                 def send(s):
                     with REQUEST_TRACER.adopt(ctx):
-                        return self._send_prepare(s, m)
+                        return self._send_prepare_window(s, ms)
 
                 futs = [self._prepare_pool().submit(send, s) for s in secs]
-                acks += sum(1 for f in futs if f.result())
+                peer_lps = [f.result() for f in futs]
             else:
-                acks += sum(1 for s in secs if self._send_prepare(s, m))
+                peer_lps = [self._send_prepare_window(s, ms) for s in secs]
         counters.percentile("replica.prepare_latency_us").set(
             int((time.perf_counter() - t0) * 1e6))
         self._export_gauges()
-        if acks < self.quorum:
+        # commit point: the highest decree d in the window such that a
+        # quorum (incl. us) holds every decree <= d — peers ack their
+        # highest CONTIGUOUS prepared decree, so coverage is monotonic
+        acks = [lp for lp in peer_lps if lp is not None]
+        commit_d = d0 - 1
+        for d in range(d0, dk + 1):
+            if 1 + sum(1 for lp in acks if lp >= d) >= self.quorum:
+                commit_d = d
+            else:
+                break
+        if commit_d < d0:
             # cannot commit; leave prepared (a later view change decides)
             raise ReplicaError(
-                f"quorum lost: {acks}/{self.quorum} for decree {decree}")
+                f"quorum lost: {1 + len(acks)}/{self.quorum} "
+                f"for decrees [{d0}..{dk}]")
         t1 = time.perf_counter()
-        with REQUEST_TRACER.span("replica.commit", decree=decree):
-            resps = self._apply_up_to(decree, now=now)
+        with REQUEST_TRACER.span("replica.commit", decree=commit_d):
+            resps = self._apply_up_to(commit_d, now=now)
         counters.percentile("replica.commit_latency_us").set(
             int((time.perf_counter() - t1) * 1e6))
         self._export_gauges()
-        return resps
+        for i, s in enumerate(slots):
+            d = d0 + i
+            if d <= commit_d:
+                rl = resps.get(d)
+                s.resp = rl[0] if rl else None
+            else:
+                s.err = ReplicaError(
+                    f"quorum lost: decree {d} prepared but not committed")
 
     def _export_gauges(self):
         """Per-partition write-path pressure: slots queued for the next
@@ -259,32 +281,92 @@ class Replica:
         counters.number(pfx + "inflight").set(len(self._batch_pending))
         counters.number(pfx + "backlog").set(len(self._uncommitted))
 
-    def _send_prepare(self, peer_name: str, m: LogMutation) -> bool:
+    def _send_prepare_window(self, peer_name: str, ms: list):
+        """Send one windowed prepare to a peer. Returns the peer's highest
+        contiguous prepared decree (its ack), or None for a dead/rejecting
+        peer."""
         try:
             peer = self.peers(peer_name)
             try:
-                peer.on_prepare(self.ballot, m, self.last_committed)
-                return True
+                return self._peer_prepare(peer, ms)
             except PrepareRejected as rej:
                 if rej.reason == "gap":
-                    return self._catch_up_peer(peer, rej.last_prepared, m)
-                return False
+                    return self._catch_up_peer(peer, rej.last_prepared, ms)
+                return None
         except ConnectionError:
-            return False
+            return None
 
-    def _catch_up_peer(self, peer, peer_prepared: int, m: LogMutation) -> bool:
-        """Stream the missing decrees from our log, then retry."""
-        try:
-            for lm in self.plog.replay(peer_prepared):
-                if lm.decree >= m.decree:
-                    break
-                peer.on_prepare(self.ballot, lm, self.last_committed)
+    def _peer_prepare(self, peer, ms: list):
+        """One prepare round against a peer object: windowed when the peer
+        supports it, per-mutation for a legacy peer. -> acked decree."""
+        if hasattr(peer, "on_prepare_batch"):
+            return peer.on_prepare_batch(self.ballot, ms, self.last_committed)
+        for m in ms:
             peer.on_prepare(self.ballot, m, self.last_committed)
-            return True
+        return ms[-1].decree
+
+    def _catch_up_peer(self, peer, peer_prepared: int, ms: list):
+        """Stream the missing decrees from our log as chunked windows,
+        then retry the current window. -> acked decree or None. A peer
+        exposing on_prepare_windows (the RPC proxy) gets the whole backlog
+        in ONE coalesced transport send."""
+        try:
+            backlog = {}
+            for lm in self.plog.replay(peer_prepared):
+                if lm.decree < ms[0].decree:
+                    backlog[lm.decree] = lm  # dedup, newest copy wins
+            chunks = [ms]
+            ordered = [backlog[d] for d in sorted(backlog)]
+            if ordered:
+                chunks = [ordered[i:i + 64]
+                          for i in range(0, len(ordered), 64)] + [ms]
+            if hasattr(peer, "on_prepare_windows"):
+                return peer.on_prepare_windows(self.ballot, chunks,
+                                               self.last_committed)
+            lp = None
+            for chunk in chunks:
+                lp = self._peer_prepare(peer, chunk)
+            return lp
         except (PrepareRejected, ConnectionError):
-            return False
+            return None
 
     # ------------------------------------------------------------ secondary
+
+    def on_prepare_batch(self, ballot: int, ms: list, committed_decree: int):
+        """Windowed prepare: stage a contiguous decree window with ONE
+        plog group append and ack the highest contiguous prepared decree.
+        The per-decree invariants are exactly on_prepare's — ack(d) only
+        once the log holds every decree <= d."""
+        with REQUEST_TRACER.span("replica.on_prepare", decree=ms[-1].decree,
+                                 batch=len(ms)), self._lock:
+            if ballot < self.ballot:
+                raise PrepareRejected("stale_ballot", self.last_prepared)
+            self.ballot = ballot
+            fresh, gap = [], False
+            for m in ms:
+                if m.decree <= self.last_committed:
+                    continue  # already committed: drop (see on_prepare)
+                if m.decree <= self.last_prepared:
+                    # duplicate (catch-up overlap): keep newest copy staged
+                    self._uncommitted.setdefault(m.decree, m)
+                elif m.decree == self.last_prepared + len(fresh) + 1:
+                    fresh.append(m)
+                elif m.decree <= self.last_prepared + len(fresh):
+                    pass  # duplicates a decree already in this window
+                else:
+                    gap = True
+                    break
+            if fresh:
+                # durability before ack: the window is in the log (one
+                # group flush) before last_prepared moves
+                self.plog.append_window(fresh)
+                for m in fresh:
+                    self._uncommitted[m.decree] = m
+                self.last_prepared = fresh[-1].decree
+            self._apply_up_to(min(committed_decree, self.last_prepared))
+            if gap:
+                raise PrepareRejected("gap", self.last_prepared)
+            return self.last_prepared
 
     def on_prepare(self, ballot: int, m: LogMutation, committed_decree: int):
         with REQUEST_TRACER.span("replica.on_prepare", decree=m.decree), \
@@ -311,12 +393,15 @@ class Replica:
     # ---------------------------------------------------------------- apply
 
     def _apply_up_to(self, decree: int, now: int = None):
-        """Commit staged mutations in order through the storage engine.
-        Returns the response LIST of the final decree applied (the group
-        commit's per-request responses, in request order)."""
-        last_resps = None
-        while self.last_committed < decree:
-            d = self.last_committed + 1
+        """Commit staged mutations in order through the storage engine —
+        the whole contiguous window in ONE batched engine call
+        (on_batched_write_window: consecutive batchable decrees share one
+        WriteBatch and one engine lock acquisition). Returns
+        {decree: response list} for every decree applied."""
+        if self.last_committed >= decree:
+            return {}
+        window, ms = [], []
+        for d in range(self.last_committed + 1, decree + 1):
             m = self._uncommitted.pop(d, None)
             if m is None:
                 raise ReplicaError(f"{self.name}: commit gap at decree {d}")
@@ -324,13 +409,31 @@ class Replica:
             for code, body in zip(m.codes, m.bodies):
                 req_cls, _ = WRITE_CODES[code]
                 reqs.append((code, codec.decode(req_cls, body)))
-            resps = self.server.on_batched_write_requests(
-                d, m.timestamp_us, reqs, now=now)
-            last_resps = resps
-            self.last_committed = d
+            window.append((d, m.timestamp_us, reqs))
+            ms.append(m)
+        try:
+            resps = self.server.on_batched_write_window(window, now=now)
+        except Exception:
+            # a mid-window engine failure (fail points) leaves the engine
+            # at its own committed point: re-stage what was not applied so
+            # a later view change or retry can still commit it, and fire
+            # the commit hooks for what WAS applied — a duplication
+            # shipper advances past this window on the next commit, so a
+            # decree skipped here would never ship
+            applied = self.server.engine.last_committed_decree()
+            for m in ms:
+                if m.decree > applied:
+                    self._uncommitted[m.decree] = m
+                else:
+                    for hook in self.commit_hooks:
+                        hook(m)
+            self.last_committed = max(self.last_committed, applied)
+            raise
+        self.last_committed = decree
+        for m in ms:
             for hook in self.commit_hooks:
                 hook(m)
-        return last_resps
+        return resps
 
     # --------------------------------------------------------------- learner
 

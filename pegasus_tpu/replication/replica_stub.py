@@ -56,6 +56,41 @@ class _RemotePeer:
         if resp.error:
             raise PrepareRejected(resp.reason, resp.last_prepared)
 
+    def on_prepare_batch(self, ballot, ms, committed_decree: int) -> int:
+        """Windowed prepare: the whole decree window rides ONE RPC; the
+        peer acks its highest contiguous prepared decree."""
+        body = self._call(RPC_PREPARE, mm.PrepareRequest(
+            app_id=self.app_id, pidx=self.pidx, ballot=ballot,
+            committed_decree=committed_decree,
+            mutations=[codec.encode(m) for m in ms]))
+        resp = codec.decode(mm.PrepareResponse, body)
+        if resp.error:
+            raise PrepareRejected(resp.reason, resp.last_prepared)
+        return resp.last_prepared
+
+    def on_prepare_windows(self, ballot, windows, committed_decree: int) -> int:
+        """Catch-up fast path: every chunked window of the backlog is
+        encoded up front and the requests leave in ONE coalesced transport
+        send (RpcConnection.call_many — writev-style), then the responses
+        are collected in order. -> the peer's final acked decree."""
+        host, _, port = self.addr.rpartition(":")
+        reqs = [(RPC_PREPARE, codec.encode(mm.PrepareRequest(
+            app_id=self.app_id, pidx=self.pidx, ballot=ballot,
+            committed_decree=committed_decree,
+            mutations=[codec.encode(m) for m in w]))) for w in windows]
+        try:
+            conn = self.stub.pool.get((host, int(port)))
+            results = conn.call_many(reqs, timeout=10.0)
+        except (RpcError, OSError) as e:
+            raise ConnectionError(str(e))
+        last = 0
+        for _, body in results:
+            resp = codec.decode(mm.PrepareResponse, body)
+            if resp.error:
+                raise PrepareRejected(resp.reason, resp.last_prepared)
+            last = resp.last_prepared
+        return last
+
     def fetch_learn_state(self) -> dict:
         body = self._call(RPC_LEARN, mm.LearnRequest(self.app_id, self.pidx))
         resp = codec.decode(mm.LearnResponse, body)
@@ -520,10 +555,13 @@ class ReplicaStub:
             rep = self._replicas.get((req.app_id, req.pidx))
         if rep is None:
             return codec.encode(mm.PrepareResponse(error=1, reason="no_replica"))
-        m = codec.decode(LogMutation, req.mutation)
+        if req.mutations:  # decree-pipelined window
+            ms = [codec.decode(LogMutation, b) for b in req.mutations]
+        else:              # single-mutation frame from an older sender
+            ms = [codec.decode(LogMutation, req.mutation)]
         try:
-            rep.on_prepare(req.ballot, m, req.committed_decree)
-            return codec.encode(mm.PrepareResponse(last_prepared=rep.last_prepared))
+            lp = rep.on_prepare_batch(req.ballot, ms, req.committed_decree)
+            return codec.encode(mm.PrepareResponse(last_prepared=lp))
         except PrepareRejected as rej:
             return codec.encode(mm.PrepareResponse(
                 error=1, reason=rej.reason, last_prepared=rej.last_prepared))

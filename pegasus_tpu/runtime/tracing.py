@@ -362,7 +362,7 @@ class RequestTracer:
     @contextmanager
     def adopt(self, ctx):
         """Install an existing context in THIS thread for a worker-pool
-        hop (the parallel prepare fan-out runs _send_prepare on pool
+        hop (the parallel prepare fan-out runs _send_prepare_window on pool
         threads) — spans the worker closes join the owner's trace. No
         finalize: the owning thread's root/serve does that, and it blocks
         on the workers before closing, so the trace stays active. ctx

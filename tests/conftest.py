@@ -25,3 +25,36 @@ if not os.environ.get("PEGASUS_TEST_TPU"):
 from pegasus_tpu.base.utils import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Join the process-wide daemon executors BEFORE interpreter exit.
+
+    The long-standing "rc=134/139 after 'N passed'" shutdown crash
+    (CHANGES PR 3/4): CPython finalization kills daemon threads at an
+    arbitrary bytecode boundary, and the suite leaves three kinds of them
+    alive — the compact pipeline/install pool workers and the
+    device-watchdog probe loop — all of which may be INSIDE an XLA
+    dispatch (watchdog probes jit a kernel on a cadence; pool workers run
+    deferred installs/primes). A worker killed mid-dispatch dies holding
+    TSL/XLA resources, and the C++ static teardown then aborts
+    ("terminate called without an active exception") AFTER pytest printed
+    its summary — so the tier-1 command's rc lied about a green run.
+    Stopping the watchdog and joining the pools (bounded: ThreadPool.stop
+    joins with a 5 s timeout per worker) drains the process of
+    XLA-touching daemons before Py_Finalize runs."""
+    try:
+        from pegasus_tpu.ops import pipeline
+        from pegasus_tpu.ops.device_watchdog import WATCHDOG
+
+        WATCHDOG.stop()
+        t = getattr(WATCHDOG, "_loop_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        with pipeline._POOL_LOCK:
+            pools = [p for p in (pipeline._POOL, pipeline._IO_POOL)
+                     if p is not None]
+        for p in pools:
+            p.stop()
+    except Exception as e:  # teardown must never mask the run's outcome
+        print(f"[conftest] executor teardown: {e!r}")

@@ -202,3 +202,49 @@ def test_lane_wedge_reports_stage_attribution():
     for stage in ("pack", "device", "gather"):
         assert stage in d["trace"], d["trace"]
     assert d["trace"]["pack"]["records"] == 30_000
+
+
+def _python_procs():
+    out = subprocess.run(["ps", "-eo", "args"], capture_output=True,
+                         text=True).stdout.splitlines()
+    return [l for l in out if "bench.py" in l or "tpu-lane" in l]
+
+
+def test_ycsb_mode_smoke():
+    """PEGASUS_BENCH_MODE=ycsb at tiny N: one parseable JSON line with
+    ops/sec > 0, per-op-class latency percentiles, the plog group-size
+    histogram + prepare-latency attribution, and a host block; the
+    in-process onebox leaves no processes behind; the default mode's
+    schema is untouched (covered by the other tests in this file)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PEGASUS_BENCH_MODE": "ycsb",
+        "PEGASUS_BENCH_YCSB_RECORDS": "300",
+        "PEGASUS_BENCH_YCSB_OPS": "600",
+        "PEGASUS_BENCH_YCSB_THREADS": "4",
+        "PEGASUS_BENCH_YCSB_PARTITIONS": "4",
+        "PEGASUS_BENCH_TIMEOUT_S": "150",
+    })
+    proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, timeout=170, env=env, cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert proc.returncode == 0 and len(lines) == 1, \
+        f"rc={proc.returncode} out={proc.stdout[-300:]} err={proc.stderr[-500:]}"
+    line = json.loads(lines[0])
+    assert line["unit"] == "ops/s"
+    assert line["value"] and line["value"] > 0
+    assert line["metric"].startswith("YCSB-A")
+    d = line["detail"]
+    assert d["errors"] == 0
+    assert d["partitions"] == 4 and d["records"] == 300
+    for cls in ("read", "update"):
+        assert d["client_latency_us"][cls]["p99"] > 0
+    # the batching win is attributable: group histogram + prepare latency
+    assert set(d["plog"]["group_size"]) == {"p50", "p90", "p95", "p99", "p999"}
+    assert d["plog"]["append_count"] > 0 and d["plog"]["flush_count"] > 0
+    assert d["prepare_latency_us"]["p99"] > 0
+    # host-contention attribution rides the line like the compaction bench
+    assert "loadavg" in d["host"]["start"] and "cpu_count" in d["host"]["end"]
+    # the self-booted onebox is in-process: nothing may outlive the bench
+    assert not _python_procs(), "ycsb mode left processes behind"

@@ -7,14 +7,18 @@ against the in-process ReplicaGroup: every acknowledged write must survive
 arbitrary kills/restarts.
 """
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
 from pegasus_tpu.base import key_schema
-from pegasus_tpu.engine.server_impl import RPC_PUT, RPC_REMOVE
+from pegasus_tpu.engine.server_impl import RPC_MULTI_PUT, RPC_PUT, RPC_REMOVE
 from pegasus_tpu.replication import MutationLog, LogMutation, ReplicaGroup, ReplicaError
 from pegasus_tpu.rpc import messages as msg
 from pegasus_tpu.rpc.messages import Status
+from pegasus_tpu.runtime import fail_points as fp
 
 
 def K(i):
@@ -226,6 +230,259 @@ def test_kill_loop_no_committed_write_lost(tmp_path):
         resp = g.read(K(i))
         assert resp.error == Status.OK, f"acked write {i} lost"
     g.close()
+
+
+# ------------------------------------------- group commit / decree windows
+
+def test_concurrent_writers_form_plog_groups(tmp_path):
+    """Acceptance: >= 4 client threads on ONE partition -> decree windows
+    form, so the plog appends-per-flush ratio exceeds 1 (one group flush
+    covers a whole prepare window) while every write still commits."""
+    g = ReplicaGroup(str(tmp_path), n=3)
+    n_threads, per = 4, 25
+    errs = []
+
+    def w(tid):
+        for i in range(per):
+            try:
+                g.write(RPC_PUT, put_req(tid * 1000 + i))
+            except ReplicaError as e:
+                errs.append(e)
+
+    threads = [threading.Thread(target=w, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    prim = g.primary_replica()
+    # one decree per mutation: the window layer must not coalesce decrees
+    assert prim.last_committed == n_threads * per
+    assert prim.plog.append_count == n_threads * per
+    assert prim.plog.flush_count < prim.plog.append_count, \
+        "no plog groups formed under 4 concurrent writers"
+    # every replica holds every decree
+    for rep in g.alive.values():
+        assert rep.last_prepared == n_threads * per
+    g.close()
+
+
+def test_single_writer_groups_of_one(tmp_path):
+    """A solo low-QPS writer must see group size 1 — the group-commit
+    machinery never lingers (and so never adds latency) without
+    concurrency."""
+    g = ReplicaGroup(str(tmp_path), n=3)
+    for i in range(20):
+        g.write(RPC_PUT, put_req(i))
+    prim = g.primary_replica()
+    assert prim.plog.append_count == 20
+    assert prim.plog.flush_count == 20  # every group was exactly one append
+    g.close()
+
+
+def test_window_gap_triggers_catch_up(group):
+    """A secondary that missed windows while unreachable rejects the next
+    window with `gap`; the primary streams the backlog as chunked windows
+    and the peer ends fully caught up (ack = highest contiguous decree)."""
+    for i in range(3):
+        group.write(RPC_PUT, put_req(i))
+    prim = group.primary_replica()
+    sec_name = next(n for n in group.alive if n != group.primary)
+    sec = group.alive.pop(sec_name)  # unreachable (not killed: no election)
+    for i in range(3, 6):
+        group.write(RPC_PUT, put_req(i))
+    group.alive[sec_name] = sec      # back, with a decree gap
+    group.write(RPC_PUT, put_req(6))
+    assert sec.last_prepared == prim.last_prepared
+    assert sec.last_committed >= 6  # committed point piggybacked
+
+
+def test_batched_vs_serial_byte_identical(tmp_path, monkeypatch):
+    """Equivalence acceptance: the same client trace through the
+    decree-pipelined path (concurrent writers, mixed put/remove/multi_put,
+    a secondary killed and re-seeded mid-stream) and through the serial
+    path (single-threaded: every window is one decree) produces
+    byte-identical plog files and identical engine state."""
+    import pegasus_tpu.replication.replica as rp
+    from pegasus_tpu.engine.replica_service import WRITE_CODES
+    from pegasus_tpu.rpc import codec
+
+    class _FrozenTime:
+        """time.time() frozen so LogMutation timestamps are reproducible
+        across the two runs; everything else passes through."""
+
+        def __init__(self, real):
+            self._real = real
+
+        def time(self):
+            return 1.7e9
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+    monkeypatch.setattr(rp, "time", _FrozenTime(time))
+
+    def multi_put_req(j):
+        return msg.MultiPutRequest(
+            hash_key=b"mh%d" % (j % 7),
+            kvs=[msg.KeyValue(b"s%d" % k, b"mv%d.%d" % (j, k))
+                 for k in range(3)],
+            expire_ts_seconds=0)
+
+    # ---- run A: batched (4 concurrent writers, kill+re-seed mid-stream)
+    ga = ReplicaGroup(str(tmp_path / "a"), n=3)
+    victim = next(n for n in ga.alive if n != ga.primary)
+
+    def writer(tid):
+        for i in range(18):
+            j = tid * 100 + i
+            kind = j % 5
+            if kind < 3:
+                ga.write(RPC_PUT, put_req(j))
+            elif kind == 3:
+                ga.write(RPC_REMOVE, msg.KeyRequest(K(j)))
+            else:
+                ga.write(RPC_MULTI_PUT, multi_put_req(j))
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    ga.kill(victim)          # mid-stream secondary failure
+    time.sleep(0.05)
+    ga.restart(victim)       # learner re-seed while traffic continues
+    for t in threads:
+        t.join()
+    prim_a = ga.primary_replica()
+    trace = sorted(prim_a.plog.replay(0), key=lambda m: m.decree)
+    assert len(trace) == 4 * 18
+    keys = _trace_keys(trace)
+    # reads, not memtable internals: the mid-stream learner re-seed
+    # checkpoints the primary, so A and B legitimately differ in how much
+    # state already flushed to L0 — the visible contents must not
+    state_a = {k: _read(prim_a, k) for k in keys}
+    committed_a = prim_a.last_committed
+    plog_a = _plog_bytes(prim_a.plog.dir)
+
+    # ---- run B: serial (single thread => every window is one decree)
+    gb = ReplicaGroup(str(tmp_path / "b"), n=3)
+    for idx, m in enumerate(trace):
+        if idx == len(trace) // 2:
+            gb.kill(victim)
+            gb.restart(victim)
+        (code,) = m.codes
+        req = codec.decode(WRITE_CODES[code][0], m.bodies[0])
+        gb.write(code, req)
+    prim_b = gb.primary_replica()
+    assert prim_b.last_committed == committed_a
+    assert {k: _read(prim_b, k) for k in keys} == state_a
+    assert _plog_bytes(prim_b.plog.dir) == plog_a
+    ga.close()
+    gb.close()
+
+
+def _plog_bytes(plog_dir):
+    import os
+
+    out = {}
+    for name in sorted(os.listdir(plog_dir)):
+        if name.startswith("log."):
+            with open(os.path.join(plog_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def _trace_keys(trace) -> set:
+    """Every stored key a replayed client trace touches."""
+    from pegasus_tpu.engine.replica_service import WRITE_CODES
+    from pegasus_tpu.rpc import codec
+
+    keys = set()
+    for m in trace:
+        for code, body in zip(m.codes, m.bodies):
+            req = codec.decode(WRITE_CODES[code][0], body)
+            if code == RPC_MULTI_PUT:
+                keys.update(key_schema.generate_key(req.hash_key, kv.key)
+                            for kv in req.kvs)
+            else:
+                keys.add(req.key)
+    return keys
+
+
+def _read(rep, key):
+    resp = rep.server.on_get(key)
+    return (resp.error, bytes(resp.value))
+
+
+# --------------------------------------------- group-commit chaos (plog.group)
+
+def test_plog_group_raise_never_acks_lost_writes(tmp_path):
+    """Chaos: `plog.group` armed with raise() fails every group BEFORE the
+    buffered write. No failed write may be acked, no acked write may be
+    lost after a full-group power loss, and the log must heal once the
+    fault clears."""
+    fp.setup()
+    try:
+        g = ReplicaGroup(str(tmp_path), n=3)
+        g.write(RPC_PUT, put_req(0))
+        fp.cfg("plog.group", "raise(chaos)")
+        for i in range(1, 6):
+            with pytest.raises(ReplicaError):
+                g.write(RPC_PUT, put_req(i))
+        fp.cfg("plog.group", "off()")
+        g.write(RPC_PUT, put_req(9))
+        # whole-cluster power loss: no flush, no close
+        for n in list(g.alive):
+            g.alive[n].plog.close()
+        g.alive.clear()
+        g2 = ReplicaGroup(str(tmp_path), n=3)
+        assert g2.read(K(0)).error == Status.OK
+        assert g2.read(K(9)).error == Status.OK
+        for i in range(1, 6):
+            assert g2.read(K(i)).error == Status.NOT_FOUND, \
+                f"write {i} failed its ack but appeared after replay"
+        g2.close()
+    finally:
+        fp.teardown()
+
+
+def test_plog_wedged_group_writer_degrades_not_hangs(tmp_path):
+    """Chaos: a group leader wedged between claim and flush (sleep verb)
+    must NOT hang the partition — appends it never claimed steal
+    themselves back after the stall bound and land per-append; the wedged
+    group itself still lands (and only then acks)."""
+    fp.setup()
+    try:
+        log = MutationLog(str(tmp_path / "plog"))
+        log._stall_s = 0.2
+        fp.cfg("plog.group", "1*sleep(2500)")
+        errs = []
+
+        def w(d):
+            try:
+                log.append(LogMutation(decree=d, codes=["c"], bodies=[b"x"]))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t_wedge = threading.Thread(target=w, args=(1,))
+        t_wedge.start()
+        time.sleep(0.3)  # the leader claimed decree 1 and is now wedged
+        others = [threading.Thread(target=w, args=(d,)) for d in range(2, 6)]
+        t0 = time.monotonic()
+        for t in others:
+            t.start()
+        for t in others:
+            t.join(timeout=10)
+            assert not t.is_alive(), "append hung behind the wedged leader"
+        assert time.monotonic() - t0 < 2.0, \
+            "degraded appends waited for the wedged group writer"
+        t_wedge.join(timeout=10)
+        assert not t_wedge.is_alive()
+        assert not errs
+        assert sorted(m.decree for m in log.replay(0)) == [1, 2, 3, 4, 5]
+        log.close()
+    finally:
+        fp.teardown()
 
 
 def test_remove_and_reopen_replays_tombstone(group):

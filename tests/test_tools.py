@@ -562,3 +562,20 @@ def test_trace_overhead_bench_smoke():
     # a stage span must stay far below the stages it wraps (>=10ms each):
     # even on a loaded CI box, 1ms/span would mean the probe is broken
     assert out["stage_span_us"] < 1000, out
+
+
+def test_metric_lint_reverse_pass_flags_stale_rows(monkeypatch):
+    """The reverse direction of tools/check_metric_names.py: README rows
+    parse into wildcard name variants, and a row whose counter was
+    deleted from source is flagged (a documented metric no scrape will
+    ever return again)."""
+    from tools import check_metric_names as cm
+
+    rows = cm.readme_metric_rows()
+    assert "rpc.server.qps" in rows                      # plain row
+    assert "plog.append.group_size" in rows              # this PR's rows
+    assert any(r.startswith("app.*") for r in rows)      # <holes> -> *
+    monkeypatch.setattr(cm, "readme_metric_rows",
+                        lambda: rows + ["ghost.deleted_counter_qps"])
+    errs = cm.run_lint()
+    assert any("ghost.deleted_counter_qps" in e for e in errs)
