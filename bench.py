@@ -616,6 +616,121 @@ def _max_quantiles(dicts):
     return out
 
 
+def _ycsb_load_and_run(box, records, n_ops, n_threads, value):
+    """Shared YCSB-A workload driver: load `records`, run the 50/50
+    read/update mix from `n_threads` clients. -> stats dict (the sweep
+    mode reruns this once per group count)."""
+    import threading
+
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+    t0 = time.perf_counter()
+    for i in range(records):
+        load_cli.set(b"user%012d" % i, b"f0", value)
+    load_s = time.perf_counter() - t0
+    load_cli.close()
+
+    errors = [0]
+    read_lat = counters.percentile("bench.ycsb.read_latency_us")
+    update_lat = counters.percentile("bench.ycsb.update_latency_us")
+    zipf = ZipfKeys(records)
+
+    def worker(tid):
+        import random
+
+        rng = random.Random(tid)
+        cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+        for _ in range(n_ops // n_threads):
+            k = b"user%012d" % zipf.pick(rng)
+            s = time.perf_counter()
+            try:
+                if rng.random() < 0.5:
+                    cli.get(k, b"f0")
+                    read_lat.set(int((time.perf_counter() - s) * 1e6))
+                else:
+                    cli.set(k, b"f0", value)
+                    update_lat.set(int((time.perf_counter() - s) * 1e6))
+            except Exception:
+                errors[0] += 1
+        cli.close()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    run_s = time.perf_counter() - t0
+    done_ops = n_threads * (n_ops // n_threads)
+    return {
+        "ops_s": round(done_ops / run_s, 1),
+        "run_s": round(run_s, 2),
+        "load_s": round(load_s, 2),
+        "load_ops_s": round(records / max(load_s, 1e-9), 1),
+        "errors": errors[0],
+        "client_latency_us": {
+            "read": read_lat.percentiles(),
+            "update": update_lat.percentiles(),
+        },
+    }
+
+
+def _ycsb_group_sweep(groups_list):
+    """PEGASUS_BENCH_YCSB_GROUPS=1,4: the partition-group scaling
+    artifact. The SAME YCSB-A workload runs once per group count, each
+    against a fresh onebox whose replica nodes serve through that many
+    shared-nothing group-executor processes (groups=1 is the one-GIL
+    ceiling, through the identical router architecture, so the sweep
+    isolates the sharding win). Emits ONE json line whose value is the
+    best ops/s and whose detail.sweep records every run + the host's
+    contention state (per-group worker processes show up in loadavg)."""
+    records, n_ops, n_threads, partitions, value_size = _ycsb_params()
+    from tools._onebox import Onebox
+
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    value = os.urandom(value_size)
+    sweep = []
+    for g in groups_list:
+        # fresh latency windows per sweep entry: the percentile counters
+        # are process-global and would otherwise blend the runs
+        counters.remove("bench.ycsb.read_latency_us")
+        counters.remove("bench.ycsb.update_latency_us")
+        host_start = _host_info()
+        box = Onebox("ycsb", partitions=partitions, serve_groups=g)
+        try:
+            stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value)
+        finally:
+            box.stop()
+        entry = {"groups": g, "host": {"start": host_start,
+                                       "end": _host_info()}}
+        entry.update(stats)
+        sweep.append(entry)
+        print(f"ycsb sweep: groups={g} -> {stats['ops_s']} ops/s "
+              f"(errors={stats['errors']})", file=sys.stderr, flush=True)
+    base = next((e for e in sweep if e["groups"] == 1), None)
+    best = max(sweep, key=lambda e: e["ops_s"])
+    detail = {
+        "sweep": sweep,
+        "partitions": partitions, "threads": n_threads, "records": records,
+        "scaling_vs_groups1": (round(best["ops_s"] / base["ops_s"], 3)
+                               if base and base["ops_s"] else None),
+    }
+    _emit({
+        "metric": (f"YCSB-A ops/sec, serve-group sweep groups="
+                   f"{','.join(str(g) for g in groups_list)} "
+                   f"({records} records, {n_ops} ops, {n_threads} threads, "
+                   f"{partitions} partitions, value={value_size}B)"),
+        "value": best["ops_s"],
+        "unit": "ops/s",
+        "vs_baseline": detail["scaling_vs_groups1"],
+        "detail": detail,
+    })
+
+
 def ycsb_main():
     """PEGASUS_BENCH_MODE=ycsb: the serving-path lane — BASELINE.json's
     SECOND metric (YCSB-A 50/50 read/update over hash partitions), never
@@ -628,16 +743,25 @@ def ycsb_main():
     and a detail.host block (so host contention can't masquerade as a
     regression).
 
+    PEGASUS_BENCH_YCSB_GROUPS=1,4 switches to the partition-group SWEEP:
+    the same workload repeated per group count with the replica nodes
+    split into that many shared-nothing executor processes
+    (replication/serve_groups.py) — the scaling artifact for the
+    serve-group work (BENCH_r06-ready).
+
     The serving path is host-only: jax is pinned to the cpu platform
     BEFORE any engine import, so this mode never touches the axon device
     lease the compaction bench's child-process discipline protects."""
-    import threading
-
     os.environ["JAX_PLATFORMS"] = "cpu"
     _enable_compile_cache()
 
+    groups_env = os.environ.get("PEGASUS_BENCH_YCSB_GROUPS", "").strip()
+    if groups_env:
+        groups_list = [max(1, int(x)) for x in groups_env.split(",") if x]
+        _ycsb_group_sweep(groups_list)
+        return
+
     records, n_ops, n_threads, partitions, value_size = _ycsb_params()
-    from pegasus_tpu.client import MetaResolver, PegasusClient
     from pegasus_tpu.runtime.perf_counters import counters
 
     from tools._onebox import Onebox
@@ -647,45 +771,7 @@ def ycsb_main():
     box = Onebox("ycsb", partitions=partitions)
     try:
         value = os.urandom(value_size)
-        load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
-        t0 = time.perf_counter()
-        for i in range(records):
-            load_cli.set(b"user%012d" % i, b"f0", value)
-        load_s = time.perf_counter() - t0
-        load_cli.close()
-
-        errors = [0]
-        read_lat = counters.percentile("bench.ycsb.read_latency_us")
-        update_lat = counters.percentile("bench.ycsb.update_latency_us")
-        zipf = ZipfKeys(records)
-
-        def worker(tid):
-            import random
-
-            rng = random.Random(tid)
-            cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
-            for _ in range(n_ops // n_threads):
-                k = b"user%012d" % zipf.pick(rng)
-                s = time.perf_counter()
-                try:
-                    if rng.random() < 0.5:
-                        cli.get(k, b"f0")
-                        read_lat.set(int((time.perf_counter() - s) * 1e6))
-                    else:
-                        cli.set(k, b"f0", value)
-                        update_lat.set(int((time.perf_counter() - s) * 1e6))
-                except Exception:
-                    errors[0] += 1
-            cli.close()
-
-        threads = [threading.Thread(target=worker, args=(t,))
-                   for t in range(n_threads)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        run_s = time.perf_counter() - t0
+        stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value)
 
         # ---- attribution: server-side latency percentiles per op class
         # (max across partitions, the collector's merge rule), the plog
@@ -703,21 +789,17 @@ def ycsb_main():
             for rep in stub._replicas.values():
                 append_count += rep.plog.append_count
                 flush_count += rep.plog.flush_count
-        done_ops = n_threads * (n_ops // n_threads)
         result = {
             "metric": _ycsb_metric_name(),
-            "value": round(done_ops / run_s, 1),
+            "value": stats["ops_s"],
             "unit": "ops/s",
             "vs_baseline": None,  # first recording of this BASELINE metric
             "detail": {
-                "run_s": round(run_s, 2),
-                "load_s": round(load_s, 2),
-                "load_ops_s": round(records / max(load_s, 1e-9), 1),
-                "errors": errors[0],
-                "client_latency_us": {
-                    "read": read_lat.percentiles(),
-                    "update": update_lat.percentiles(),
-                },
+                "run_s": stats["run_s"],
+                "load_s": stats["load_s"],
+                "load_ops_s": stats["load_ops_s"],
+                "errors": stats["errors"],
+                "client_latency_us": stats["client_latency_us"],
                 "server_latency_us": server_lat,
                 "prepare_latency_us": snap.get("replica.prepare_latency_us"),
                 "plog": {

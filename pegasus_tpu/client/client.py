@@ -105,7 +105,10 @@ class PegasusClient:
                     pidx = phash % self.resolver.partition_count
             addr = self.resolver.resolve(pidx)
             try:
-                conn = self.pool.get(addr)
+                # one connection per (node, partition): the partition-group
+                # serving node hands sharded connections to the owning
+                # group executor, taking the router out of the data path
+                conn = self.pool.get(addr, shard=pidx)
                 _, rbody = conn.call(code, body, app_id=self.resolver.app_id,
                                      partition_index=pidx, partition_hash=phash,
                                      timeout=self.timeout)
@@ -141,7 +144,7 @@ class PegasusClient:
             return None
         for addr in self.resolver.secondaries(pidx):
             try:
-                conn = self.pool.get(addr)
+                conn = self.pool.get(addr, shard=pidx)
                 _, rbody = conn.call(code, body, app_id=self.resolver.app_id,
                                      partition_index=pidx, partition_hash=phash,
                                      timeout=self.timeout)
@@ -217,6 +220,57 @@ class PegasusClient:
                           msg.IncrResponse)
         self._ok(resp)
         return resp.new_value
+
+    def batch_get(self, items, timeout: float = None):
+        """Multi-partition point-read fan-out: items is [(hash_key,
+        sort_key), ...] -> [value | None, ...] in order.
+
+        Keys group by their (node, partition) connection and each group's
+        requests leave as ONE pipelined call_many wave — send phase first
+        across every connection, then collect, so k partitions' worth of
+        server work runs concurrently and each direction costs one
+        syscall per partition instead of one per key. A failed wave falls
+        back to the per-key retrying path for just its keys."""
+        out = [None] * len(items)
+        groups = {}   # (addr, pidx) -> [(i, body, phash)]
+        for i, (hk, sk) in enumerate(items):
+            key = key_schema.generate_key(hk, sk)
+            pidx, h = self._route(key)
+            addr = tuple(self.resolver.resolve(pidx))
+            groups.setdefault((addr, pidx), []).append(
+                (i, codec.encode(msg.KeyRequest(key)), h))
+        pends = []
+        for (addr, pidx), entries in groups.items():
+            calls = [(codes.RPC_GET, body, self.resolver.app_id, pidx, h)
+                     for _, body, h in entries]
+            try:
+                conn = self.pool.get(addr, shard=pidx)
+                pends.append((conn, calls, entries,
+                              conn.call_many_send(calls)))
+            except (RpcError, OSError):
+                pends.append((None, calls, entries, None))
+        for conn, calls, entries, handle in pends:
+            results = None
+            if handle is not None:
+                try:
+                    results = conn.call_many_collect(
+                        handle, calls, timeout or self.timeout)
+                except (RpcError, OSError):
+                    results = None
+            if results is None:   # wave failed: per-key retrying fallback
+                for i, _, _ in entries:
+                    hk, sk = items[i]
+                    out[i] = self.get(hk, sk)
+                continue
+            for (i, _, _), (_, rbody) in zip(entries, results):
+                resp = codec.decode(msg.ReadResponse, rbody)
+                if resp.error == Status.NOT_FOUND:
+                    out[i] = None
+                elif resp.error != Status.OK:
+                    raise PegasusError(resp.error)
+                else:
+                    out[i] = resp.value
+        return out
 
     def multi_set(self, hash_key: bytes, kvs: dict, ttl_seconds: int = 0) -> None:
         req = msg.MultiPutRequest(
@@ -305,11 +359,44 @@ class PegasusClient:
         return Scanner(self, list(range(self.resolver.partition_count)),
                        b"", b"", batch_size, **opts)
 
-    def get_unordered_scanners(self, max_split_count: int = 0):
-        """One scanner per partition group (full-table scan,
-        reference client.h:322-380)."""
+    def get_unordered_scanners(self, max_split_count: int = 0,
+                               batch_size: int = 1000,
+                               prefetch: bool = True):
+        """One scanner per partition group (full-table scan, reference
+        client.h:322-380). prefetch=True (default) opens every
+        partition's scan session up front as a batched fan-out: all the
+        get_scanner requests leave before any response is awaited
+        (call_many send/collect split), so the partitions build their
+        first batches concurrently instead of serially on first use. A
+        failed prefetch degrades that scanner to lazy fetching."""
         n = self.resolver.partition_count
-        return [Scanner(self, [p], b"", b"", 1000) for p in range(n)]
+        scanners = [Scanner(self, [p], b"", b"", batch_size)
+                    for p in range(n)]
+        if not prefetch:
+            return scanners
+        pends = []
+        for sc in scanners:
+            pidx = sc.pidxs[0]
+            req = msg.GetScannerRequest(batch_size=batch_size,
+                                        validate_partition_hash=False)
+            calls = [(codes.RPC_GET_SCANNER, codec.encode(req),
+                      self.resolver.app_id, pidx, 0)]
+            try:
+                conn = self.pool.get(self.resolver.resolve(pidx),
+                                     shard=pidx)
+                pends.append((sc, conn, calls, conn.call_many_send(calls)))
+            except (RpcError, OSError):
+                continue
+        for sc, conn, calls, handle in pends:
+            try:
+                (_, rbody), = conn.call_many_collect(handle, calls,
+                                                     self.timeout)
+                resp = codec.decode(msg.ScanResponse, rbody)
+            except (RpcError, OSError):
+                continue
+            if resp.error == Status.OK:
+                sc._preload(resp)
+        return scanners
 
     # -------------------------------------------------------------- async
     # The reference API is half async_* callbacks over its rDSN task pool
@@ -454,6 +541,11 @@ class Scanner:
                                      msg.ScanRequest(self._ctx), msg.ScanResponse)
         if resp.error not in (Status.OK,):
             raise PegasusError(resp.error)
+        self._absorb(resp)
+
+    def _absorb(self, resp):
+        from ..base import consts
+
         self._batch = resp.kvs
         self._bi = 0
         if resp.context_id == consts.SCAN_CONTEXT_ID_COMPLETED:
@@ -464,6 +556,12 @@ class Scanner:
             # limiter may spend its whole budget on filtered-out rows —
             # keep the session and fetch again
             self._ctx = resp.context_id
+
+    def _preload(self, resp):
+        """Absorb a fan-out-prefetched first batch (get_unordered_scanners
+        opened this partition's session before iteration started)."""
+        if self._cur == 0 and self._ctx is None and not self._batch:
+            self._absorb(resp)
 
     def close(self):
         if self._ctx is not None and self._cur < len(self.pidxs):

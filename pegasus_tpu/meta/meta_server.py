@@ -1300,6 +1300,13 @@ class MetaServer:
 
     def _send_to_node(self, node: str, code: str, req, ignore_errors=False,
                       app_id: int = 0, pidx: int = 0):
+        # per-partition lifecycle requests carry their own (app_id, pidx);
+        # lift them into the RPC header so a partition-group serving node
+        # (replication/serve_groups.py) routes the frame without decoding
+        # the body
+        if app_id == 0 and pidx == 0:
+            app_id = getattr(req, "app_id", 0) or 0
+            pidx = getattr(req, "pidx", 0) or 0
         host, _, port = node.rpartition(":")
         try:
             conn = self.pool.get((host, int(port)))

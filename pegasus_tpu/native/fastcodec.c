@@ -24,8 +24,10 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <errno.h>
 #include <stdint.h>
 #include <string.h>
+#include <sys/socket.h>
 
 static PyObject *CodecError; /* set by register_error(); fallback ValueError */
 
@@ -637,6 +639,197 @@ static PyTypeObject Plan_Type = {
     .tp_methods = Plan_methods,
 };
 
+/* ------------------------------------------------------- frame wave reader
+ *
+ * The RPC serving inner loop (rpc/transport.py): u32 LE payload length |
+ * u32 LE header length | header | body.  The Python loop re-entered the
+ * interpreter per frame (length parse, header decode, body slice — ~4
+ * allocations and a dict of closures per frame).  FrameReader drains a
+ * socket's whole pipelined wave in C: one recv() (GIL released), then
+ * every complete frame in the buffer is parsed and header-decoded without
+ * touching Python until the finished (header, body) list is returned.
+ */
+
+typedef struct {
+    PyObject_HEAD
+    PlanObject *plan;   /* RpcHeader plan (strong) */
+    unsigned char *buf; /* unparsed bytes */
+    Py_ssize_t len, cap, pos;
+} FrameReaderObject;
+
+static PyObject *FrameReader_new(PyTypeObject *type, PyObject *args,
+                                 PyObject *kw)
+{
+    PyObject *plan;
+    if (!PyArg_ParseTuple(args, "O!", &Plan_Type, &plan))
+        return NULL;
+    FrameReaderObject *self = (FrameReaderObject *)type->tp_alloc(type, 0);
+    if (!self)
+        return NULL;
+    Py_INCREF(plan);
+    self->plan = (PlanObject *)plan;
+    self->buf = NULL;
+    self->len = self->cap = self->pos = 0;
+    return (PyObject *)self;
+}
+
+static void FrameReader_dealloc(FrameReaderObject *self)
+{
+    Py_XDECREF(self->plan);
+    PyMem_Free(self->buf);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static int fr_reserve(FrameReaderObject *self, Py_ssize_t extra)
+{
+    /* compact consumed bytes first so the buffer stays wave-sized */
+    if (self->pos) {
+        memmove(self->buf, self->buf + self->pos, self->len - self->pos);
+        self->len -= self->pos;
+        self->pos = 0;
+    }
+    Py_ssize_t need = self->len + extra;
+    if (need <= self->cap)
+        return 0;
+    Py_ssize_t cap = self->cap ? self->cap : (1 << 16);
+    while (cap < need)
+        cap <<= 1;
+    unsigned char *np = PyMem_Realloc(self->buf, cap);
+    if (!np) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    self->buf = np;
+    self->cap = cap;
+    return 0;
+}
+
+static PyObject *FrameReader_feed(FrameReaderObject *self, PyObject *data)
+{
+    /* preload bytes already read elsewhere (adopted-connection leftovers
+       from the partition-group router's first-frame peek) */
+    Py_buffer view;
+    if (PyObject_GetBuffer(data, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    int rc = fr_reserve(self, view.len);
+    if (rc == 0) {
+        memcpy(self->buf + self->len, view.buf, view.len);
+        self->len += view.len;
+    }
+    PyBuffer_Release(&view);
+    if (rc < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+/* parse every complete frame at self->pos into `out`; 0 ok, -1 error */
+static int fr_parse_frames(FrameReaderObject *self, PyObject *out)
+{
+    for (;;) {
+        Py_ssize_t avail = self->len - self->pos;
+        if (avail < 8)
+            return 0;
+        const unsigned char *p = self->buf + self->pos;
+        uint32_t plen, hlen;
+        memcpy(&plen, p, 4); /* little-endian host assumed (x86/arm) */
+        memcpy(&hlen, p + 4, 4);
+        if (plen < 4 || (Py_ssize_t)hlen > (Py_ssize_t)plen - 4) {
+            RAISE("corrupt frame lengths");
+            return -1;
+        }
+        if (avail < 4 + (Py_ssize_t)plen)
+            return 0;
+        Rd r = {p + 8, (Py_ssize_t)hlen, 0};
+        PyObject *header = dec_struct(self->plan, &r);
+        if (!header)
+            return -1;
+        if (r.off != r.len) {
+            Py_DECREF(header);
+            RAISE("trailing bytes after header");
+            return -1;
+        }
+        PyObject *body = PyBytes_FromStringAndSize(
+            (const char *)p + 8 + hlen, (Py_ssize_t)plen - 4 - hlen);
+        if (!body) {
+            Py_DECREF(header);
+            return -1;
+        }
+        PyObject *pair = PyTuple_Pack(2, header, body);
+        Py_DECREF(header);
+        Py_DECREF(body);
+        if (!pair)
+            return -1;
+        int rc = PyList_Append(out, pair);
+        Py_DECREF(pair);
+        if (rc < 0)
+            return -1;
+        self->pos += 4 + (Py_ssize_t)plen;
+    }
+}
+
+static PyObject *FrameReader_read_wave(FrameReaderObject *self, PyObject *arg)
+{
+    long fd = PyLong_AsLong(arg);
+    if (fd == -1 && PyErr_Occurred())
+        return NULL;
+    PyObject *out = PyList_New(0);
+    if (!out)
+        return NULL;
+    for (;;) {
+        if (fr_parse_frames(self, out) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        if (PyList_GET_SIZE(out) > 0)
+            return out;
+        if (fr_reserve(self, 1 << 18) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        Py_ssize_t n;
+        for (;;) {
+            Py_BEGIN_ALLOW_THREADS
+            n = recv((int)fd, self->buf + self->len,
+                     (size_t)(self->cap - self->len), 0);
+            Py_END_ALLOW_THREADS
+            if (n >= 0 || errno != EINTR)
+                break;
+            if (PyErr_CheckSignals() < 0) {
+                Py_DECREF(out);
+                return NULL;
+            }
+        }
+        if (n == 0) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_ConnectionError, "peer closed");
+            return NULL;
+        }
+        if (n < 0) {
+            Py_DECREF(out);
+            return PyErr_SetFromErrno(PyExc_OSError);
+        }
+        self->len += n;
+    }
+}
+
+static PyMethodDef FrameReader_methods[] = {
+    {"feed", (PyCFunction)FrameReader_feed, METH_O,
+     "feed(bytes): preload already-read bytes into the buffer"},
+    {"read_wave", (PyCFunction)FrameReader_read_wave, METH_O,
+     "read_wave(fd) -> [(header, body), ...]; blocks for >=1 frame"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject FrameReader_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "fastcodec.FrameReader",
+    .tp_basicsize = sizeof(FrameReaderObject),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_new = FrameReader_new,
+    .tp_dealloc = (destructor)FrameReader_dealloc,
+    .tp_methods = FrameReader_methods,
+};
+
 /* ----------------------------------------------------------------- module */
 
 static PyObject *register_error(PyObject *mod, PyObject *exc)
@@ -659,7 +852,7 @@ static struct PyModuleDef fastcodec_module = {
 
 PyMODINIT_FUNC PyInit_fastcodec(void)
 {
-    if (PyType_Ready(&Plan_Type) < 0)
+    if (PyType_Ready(&Plan_Type) < 0 || PyType_Ready(&FrameReader_Type) < 0)
         return NULL;
     PyObject *m = PyModule_Create(&fastcodec_module);
     if (!m)
@@ -667,6 +860,13 @@ PyMODINIT_FUNC PyInit_fastcodec(void)
     Py_INCREF(&Plan_Type);
     if (PyModule_AddObject(m, "Plan", (PyObject *)&Plan_Type) < 0) {
         Py_DECREF(&Plan_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&FrameReader_Type);
+    if (PyModule_AddObject(m, "FrameReader",
+                           (PyObject *)&FrameReader_Type) < 0) {
+        Py_DECREF(&FrameReader_Type);
         Py_DECREF(m);
         return NULL;
     }

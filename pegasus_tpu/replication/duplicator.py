@@ -128,6 +128,8 @@ class MutationDuplicator:
 
     # ----------------------------------------------------------------- ship
 
+    _SHIP_BATCH = 32   # queued mutations shipped per pipelined wave
+
     def _ship_loop(self):
         while True:
             with self._cv:
@@ -137,14 +139,75 @@ class MutationDuplicator:
                     self._cv.wait(0.2)
                 if self._stop and (not self._queue or self._paused):
                     return
-                m = self._queue.pop(0)
+                batch = self._queue[:self._SHIP_BATCH]
+                del self._queue[:len(batch)]
                 self._inflight = True
-            try:
-                if self._ship_one(m):
-                    self._save_progress()
-            except Exception as e:  # never let the shipper thread die
-                self.skipped += 1
-                print(f"[duplicator] dropped decree {m.decree}: {e!r}")
+            # batched fast path: a backlog (catch-up, paused burst, slow
+            # remote) ships as ONE pipelined call_many wave per (node,
+            # partition) instead of a round trip per request. Any failure
+            # falls back to the per-mutation retry/skip policy below —
+            # shipping is at-least-once and the remote's timetag LWW
+            # resolves the overlap.
+            shipped_batch = False
+            if len(batch) > 1:
+                try:
+                    shipped_batch = self._ship_window(batch)
+                except Exception:  # noqa: BLE001 - wave failed: retry singly
+                    shipped_batch = False
+            if shipped_batch:
+                self._save_progress()
+                continue
+            for m in batch:
+                try:
+                    if self._ship_one(m):
+                        self._save_progress()
+                except Exception as e:  # never let the shipper thread die
+                    self.skipped += 1
+                    print(f"[duplicator] dropped decree {m.decree}: {e!r}")
+
+    def _ship_window(self, ms) -> bool:
+        """Ship a window of mutations as batched per-partition waves.
+        -> True only when EVERY request landed (the window's decrees are
+        then confirmed in order). Per-partition request order is
+        preserved, which keeps the per-hash FIFO guarantee; cross-
+        partition order is already unordered at the remote."""
+        groups = {}   # (addr, pidx) -> ordered call list
+        n_skipped = 0  # counted only once the WHOLE window lands — a
+        # failed wave reruns through _ship_one, which does its own count
+        for m in ms:
+            if m.decree <= self.last_shipped_decree:
+                continue
+            for code, body in zip(m.codes, m.bodies):
+                if code == RPC_DUPLICATE:
+                    continue   # never re-duplicate a duplicate (loop guard)
+                try:
+                    key = _routing_key(code, body)
+                except (ValueError, KeyError):
+                    n_skipped += 1   # non-duplicable (e.g. bulk load)
+                    continue
+                req = msg.DuplicateRequest(
+                    timestamp=m.timestamp_us, task_code=code,
+                    raw_message=body, cluster_id=self.cluster_id,
+                    verify_timetag=True)
+                h = key_schema.key_hash(key)
+                pidx = h % self.resolver.partition_count
+                addr = tuple(self.resolver.resolve(pidx))
+                groups.setdefault((addr, pidx), []).append(
+                    (RPC_DUPLICATE, codec.encode(req),
+                     self.resolver.app_id, pidx, h))
+        pends = []
+        for (addr, pidx), calls in groups.items():
+            conn = self.pool.get(addr, shard=pidx)
+            pends.append((conn, calls, conn.call_many_send(calls)))
+        n = 0
+        for conn, calls, handle in pends:
+            conn.call_many_collect(handle, calls, 10.0)
+            n += len(calls)
+        self.shipped += n
+        self.skipped += n_skipped
+        self.last_shipped_decree = max(self.last_shipped_decree,
+                                       ms[-1].decree)
+        return True
 
     def _ship_one(self, m: LogMutation) -> bool:
         """-> True when the decree is confirmed (shipped, or skipped by

@@ -10,6 +10,7 @@ pull checkpoint+log-tail state, and a beacon thread keeps the meta lease.
 
 import json
 import os
+import socket
 import threading
 import time
 
@@ -42,8 +43,13 @@ class _RemotePeer:
     def _call(self, code, req):
         host, _, port = self.addr.rpartition(":")
         try:
-            conn = self.stub.pool.get((host, int(port)))
-            _, body = conn.call(code, codec.encode(req), timeout=10.0)
+            # one SHARDED connection per (peer, partition): the peer's
+            # partition-group router can hand the whole socket to the
+            # owning group executor, and the header carries the route
+            conn = self.stub.pool.get((host, int(port)),
+                                      shard=("rep", self.app_id, self.pidx))
+            _, body = conn.call(code, codec.encode(req), app_id=self.app_id,
+                                partition_index=self.pidx, timeout=10.0)
             return body
         except (RpcError, OSError) as e:
             raise ConnectionError(str(e))
@@ -77,9 +83,11 @@ class _RemotePeer:
         reqs = [(RPC_PREPARE, codec.encode(mm.PrepareRequest(
             app_id=self.app_id, pidx=self.pidx, ballot=ballot,
             committed_decree=committed_decree,
-            mutations=[codec.encode(m) for m in w]))) for w in windows]
+            mutations=[codec.encode(m) for m in w])),
+            self.app_id, self.pidx, 0) for w in windows]
         try:
-            conn = self.stub.pool.get((host, int(port)))
+            conn = self.stub.pool.get((host, int(port)),
+                                      shard=("rep", self.app_id, self.pidx))
             results = conn.call_many(reqs, timeout=10.0)
         except (RpcError, OSError) as e:
             raise ConnectionError(str(e))
@@ -108,9 +116,16 @@ class ReplicaStub:
     def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
                  port: int = 0, options_factory=None,
                  block_service_provider: str = "local_service",
-                 remote_clusters: dict = None, cluster_id: int = 1):
+                 remote_clusters: dict = None, cluster_id: int = 1,
+                 group_spec: dict = None):
         self.root = root
         self.meta_addrs = list(meta_addrs)
+        # partition-group executor mode (replication/serve_groups.py): this
+        # stub is ONE group worker of a grouped serving node — it owns only
+        # partitions with group_of(app, pidx) == group_index, identifies as
+        # the node's public address, never beacons (the parent aggregates),
+        # and adopts handed-off client sockets over the control channel
+        self.group_spec = group_spec or None
         self.block_service_provider = block_service_provider
         # [pegasus.clusters]: remote cluster name -> meta address list, the
         # duplication target directory (reference pegasus_const cluster
@@ -153,6 +168,20 @@ class ReplicaStub:
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
+        if self.group_spec:
+            from .serve_groups import RPC_GROUP_STATE
+
+            # replica naming / primary identity must be the PUBLIC address
+            # the meta assigned to this node, not the worker's private port
+            self.address = self.group_spec["public_address"]
+            self.rpc.register(RPC_GROUP_STATE, self._on_group_state)
+            # bind BEFORE the parent can read GROUP_READY; only accept()
+            # runs on the thread
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(self.group_spec["control_path"])
+            srv.listen(2)
+            self._adoption_srv = srv
+            threading.Thread(target=self._adoption_loop, daemon=True).start()
         self._stop = threading.Event()
         self._beacon_threads = {}  # meta addr -> in-flight ping thread
         self._beacon_thread = threading.Thread(target=self._beacon_loop,
@@ -164,10 +193,81 @@ class ReplicaStub:
               maintenance_interval: float = 60.0) -> "ReplicaStub":
         self._beacon_interval = beacon_interval
         self._maint_interval = maintenance_interval
-        self.send_beacon()
-        self._beacon_thread.start()
+        if not self.group_spec:   # a group worker's parent beacons for it
+            self.send_beacon()
+            self._beacon_thread.start()
         self._maint_thread.start()
         return self
+
+    # --------------------------------------------- group-executor plumbing
+
+    def _beacon_fragment_locked(self):
+        alive = [f"{a}.{p}" for (a, p) in self._replicas]
+        progress = [
+            f"{a}.{p}.{dupid}:{d.last_shipped_decree}"
+            for (a, p), rep in self._replicas.items()
+            # dict() snapshot: _sync_duplications swaps the mapping
+            # copy-on-write, so iteration here can never see a resize
+            for dupid, d in dict(rep.duplicators).items()]
+        return alive, progress
+
+    def _on_group_state(self, header, body) -> bytes:
+        """The parent's beacon-aggregation scrape: this worker's share of
+        the node beacon (alive replicas + duplication progress)."""
+        with self._lock:
+            alive, progress = self._beacon_fragment_locked()
+        return json.dumps({"alive": alive,
+                           "dup_progress": progress}).encode("utf-8")
+
+    def _owns(self, app_id: int, pidx: int) -> bool:
+        if not self.group_spec:
+            return True
+        from .serve_groups import group_of
+
+        return group_of(app_id, pidx, self.group_spec["group_count"]) \
+            == self.group_spec["group_index"]
+
+    def _adoption_loop(self):
+        """Accept the parent's control connection and adopt handed-off
+        client sockets (SCM_RIGHTS + length-prefixed already-read bytes).
+        EOF on the control stream means the parent is gone: exit — an
+        orphan worker must never outlive its node."""
+        import struct as _struct
+
+        srv = self._adoption_srv
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    msg, fds, _, _ = socket.recv_fds(conn, 1 << 16, 4)
+                    if not msg and not fds:
+                        raise ConnectionError("parent closed")
+                    while len(msg) < 4:
+                        chunk = conn.recv(4 - len(msg))
+                        if not chunk:
+                            raise ConnectionError("parent closed")
+                        msg += chunk
+                    (need,) = _struct.unpack("<I", msg[:4])
+                    payload = bytearray(msg[4:])
+                    while len(payload) < need:
+                        chunk = conn.recv(min(1 << 16, need - len(payload)))
+                        if not chunk:
+                            raise ConnectionError("parent closed")
+                        payload += chunk
+                    if fds:
+                        sock = socket.socket(fileno=fds[0])
+                        for extra in fds[1:]:
+                            os.close(extra)
+                        self.rpc.serve_adopted(sock, bytes(payload))
+                    conn.sendall(b"A")
+            except (ConnectionError, OSError):
+                pass
+            # the parent never reconnects a control stream: it restarts
+            # the whole worker instead — treat EOF as a death sentence
+            os._exit(0)
 
     def _maintenance_loop(self):
         """Per-replica timers (the reference's replica-level checkpoint timer
@@ -198,13 +298,7 @@ class ReplicaStub:
 
     def send_beacon(self):
         with self._lock:
-            alive = [f"{a}.{p}" for (a, p) in self._replicas]
-            progress = [
-                f"{a}.{p}.{dupid}:{d.last_shipped_decree}"
-                for (a, p), rep in self._replicas.items()
-                # dict() snapshot: _sync_duplications swaps the mapping
-                # copy-on-write, so iteration here can never see a resize
-                for dupid, d in dict(rep.duplicators).items()]
+            alive, progress = self._beacon_fragment_locked()
         req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
                                dup_progress=progress)
         body = codec.encode(req)
@@ -246,6 +340,10 @@ class ReplicaStub:
 
     def _on_open_replica(self, header, body) -> bytes:
         req = codec.decode(mm.OpenReplicaRequest, body)
+        if not self._owns(req.app_id, req.pidx):
+            raise RpcError(ERR_INVALID_STATE,
+                           f"partition {req.app_id}.{req.pidx} belongs to "
+                           f"another group executor")
         key = (req.app_id, req.pidx)
         with self._lock:
             rep = self._replicas.get(key)
@@ -269,6 +367,14 @@ class ReplicaStub:
                 with self._lock:
                     src = self._replicas.get((req.app_id, learn_pidx))
                 peer = src  # in-process parent (split on the same node)
+                if peer is None and self.group_spec \
+                        and not self._owns(req.app_id, learn_pidx):
+                    # split across group executors: the parent partition
+                    # lives in a SIBLING group's process — learn over RPC
+                    # through the node's public router, which hands the
+                    # LEARN to the owning group
+                    peer = _RemotePeer(self, req.learn_from, req.app_id,
+                                       learn_pidx)
             else:
                 peer = _RemotePeer(self, req.learn_from, req.app_id, learn_pidx)
             if peer is not None:
@@ -688,6 +794,11 @@ class ReplicaStub:
 
     def stop(self):
         self._stop.set()
+        if getattr(self, "_adoption_srv", None) is not None:
+            try:
+                self._adoption_srv.close()
+            except OSError:
+                pass
         self.rpc.stop()
         with self._lock:
             reps = list(self._replicas.values())

@@ -1,0 +1,594 @@
+"""Shared-nothing partition-group executors: the node-level GIL shatter.
+
+BASELINE showed the serving stack 1-core-bound: YCSB-A peaked ~5.7k ops/s
+at 8 client threads because every partition's reads, writes, codec work
+and engine apply shared ONE interpreter. The reference Pegasus gets its
+per-node scaling from rDSN's shared-nothing per-partition task engine
+(SURVEY §L0): partitions never share an execution context.
+
+This module is that architecture for the Python build. A serving node
+with ``PEGASUS_SERVE_GROUPS=N`` runs:
+
+  parent (this class, GroupedReplicaNode)
+    - binds the node's PUBLIC address (what the meta routes clients to)
+    - spawns N group-worker processes; worker g owns every
+      (app_id, pidx) with ``group_of(app_id, pidx, N) == g``
+      (pidx % N — consistent with replica_service's per-partition routing)
+    - acceptor/router: a connection whose first frame is SHARDED
+      (RpcHeader.sharded — the ConnectionPool's one-partition-per-
+      connection shard keys) is handed to the owning worker wholesale via
+      SCM_RIGHTS fd passing: after the handoff the parent is OUT of the
+      data path and the partition's whole request loop runs under the
+      worker's own GIL. Unsharded connections (meta lifecycle, shell,
+      legacy clients) stay in the parent on a per-frame relay that routes
+      each frame by (app_id, partition_index) — correct for everything,
+      just not the fast path.
+    - aggregates the workers' replica state into ONE beacon (the meta
+      still sees one node) and replays cached open-replica state into a
+      restarted worker so a crashed group re-serves without waiting for
+      the meta's next proposal round.
+
+  worker (ReplicaStub with a group spec, server/__main__.py
+  ``--group-worker``)
+    - a full replica stub on an ephemeral localhost port: engine, plog,
+      PacificA, throttling — nothing shared with its sibling groups
+    - identifies as the PUBLIC address (replica naming / primary identity
+      must match what the meta assigned), never beacons itself
+    - adopts handed-off client sockets from the parent's control channel
+
+Consistency is unchanged: group boundaries follow the existing
+per-partition serialization (one writer per partition, partition-hash
+sanity check, never-ack-before-durable all live in the worker exactly as
+they did in the single-process stub).
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..meta import messages as mm
+from ..rpc import codec
+from ..rpc.transport import (ConnectionPool, ERR_NETWORK_FAILURE,
+                             RpcConnection, RpcError, RpcHeader, _send_frame)
+from ..runtime.perf_counters import counters
+
+RPC_GROUP_STATE = "RPC_GROUP_STATE"  # worker -> parent beacon fragment
+
+
+def group_of(app_id: int, pidx: int, n_groups: int) -> int:
+    """Partition -> group executor map. pidx % n keeps it consistent with
+    the client's hash % partition_count routing: consecutive partitions
+    land on different groups, so hash-uniform traffic spreads evenly."""
+    return pidx % max(1, n_groups)
+
+
+# frames routable by the RPC header's (app_id, partition_index); bodies of
+# these lifecycle codes carry the partition too, for senders that predate
+# header routing
+_BODY_ROUTED = None
+
+
+def _body_routed():
+    global _BODY_ROUTED
+    if _BODY_ROUTED is None:
+        from ..meta.meta_server import (RPC_BULK_LOAD, RPC_CLOSE_REPLICA,
+                                        RPC_COLD_BACKUP, RPC_OPEN_REPLICA,
+                                        RPC_REPLICA_STATE)
+        from .replica_stub import RPC_LEARN, RPC_PREPARE
+
+        _BODY_ROUTED = {
+            RPC_OPEN_REPLICA: mm.OpenReplicaRequest,
+            RPC_CLOSE_REPLICA: mm.CloseReplicaRequest,
+            RPC_REPLICA_STATE: mm.ReplicaStateRequest,
+            RPC_COLD_BACKUP: mm.OpenReplicaRequest,
+            RPC_BULK_LOAD: mm.OpenReplicaRequest,
+            RPC_PREPARE: mm.PrepareRequest,
+            RPC_LEARN: mm.LearnRequest,
+        }
+    return _BODY_ROUTED
+
+
+def _merge_command_outputs(parts):
+    """Merge per-group remote-command outputs into ONE response the
+    caller can still parse. JSON-dict outputs (perf-counters*,
+    replica-disk, collector scrapes) merge structurally — numeric values
+    sum across groups, percentile dicts take the per-quantile max (the
+    collector's own merge rule) — because a '\\n'.join of two dicts is
+    not JSON and would silently blind every scraper. JSON lists concat;
+    anything non-JSON joins line-wise (flush-log, describe, ...)."""
+    parts = [p for p in parts if p]
+    if len(parts) <= 1:
+        return parts[0] if parts else ""
+    try:
+        docs = [json.loads(p) for p in parts]
+    except ValueError:
+        return "\n".join(parts)
+    if all(isinstance(d, list) for d in docs):
+        return json.dumps([x for d in docs for x in d])
+    if not all(isinstance(d, dict) for d in docs):
+        return "\n".join(parts)
+    merged = {}
+    for d in docs:
+        for k, v in d.items():
+            cur = merged.get(k)
+            if cur is None:
+                merged[k] = v
+            elif isinstance(cur, (int, float)) \
+                    and isinstance(v, (int, float)):
+                merged[k] = cur + v
+            elif isinstance(cur, dict) and isinstance(v, dict):
+                merged[k] = {q: max(cur.get(q, 0), v.get(q, 0))
+                             for q in set(cur) | set(v)}
+            # else: first group's value wins (strings, mixed shapes)
+    return json.dumps(merged)
+
+
+class _Worker:
+    """One spawned group executor process + its control channel."""
+
+    def __init__(self, g: int):
+        self.g = g
+        self.proc = None
+        self.port = 0          # worker's real localhost RPC port
+        self.ctrl = None       # unix-socket control conn (handoffs ride it)
+        self.ctrl_lock = threading.Lock()
+        self.ctrl_ok = True    # False after a failed/timed-out handoff:
+        # the channel may be desynced, so no further handoffs — relay
+        # still serves everything; restart_group builds a fresh channel
+        self.alive = False
+
+    def close(self):
+        self.alive = False
+        if self.ctrl is not None:
+            try:
+                self.ctrl.close()
+            except OSError:
+                pass
+            self.ctrl = None
+
+
+class GroupedReplicaNode:
+    """Drop-in for ReplicaStub at the node level when serving is split
+    across partition-group executors. Exposes the surface the service
+    container and the onebox harnesses use: address, start/stop, plus
+    kill_group/restart_group for chaos tests."""
+
+    def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
+                 port: int = 0, groups: int = 2, backend: str = "cpu",
+                 compression: str = "none", sharded_compaction: bool = False,
+                 remote_clusters: dict = None, cluster_id: int = 1,
+                 spawn_timeout: float = 120.0):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.meta_addrs = list(meta_addrs)
+        self.groups = max(1, int(groups))
+        self.spawn_timeout = spawn_timeout
+        self._spec_base = {
+            "root": root, "metas": self.meta_addrs, "backend": backend,
+            "compression": compression,
+            "sharded_compaction": sharded_compaction,
+            "remote_clusters": remote_clusters or {},
+            "cluster_id": cluster_id, "group_count": self.groups,
+        }
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.address = (f"{self._listener.getsockname()[0]}:"
+                        f"{self._listener.getsockname()[1]}")
+        self._ctrl_dir = tempfile.mkdtemp(prefix="pegasus_grp_")
+        self._workers = [_Worker(g) for g in range(self.groups)]
+        self._open_cache = {}     # (app_id, pidx) -> open-replica body bytes
+        self._lock = threading.Lock()
+        self.pool = ConnectionPool()   # beacons to the metas
+        self._stop = threading.Event()
+        self._threads = []
+        self._c_handoff = counters.rate("serve.group.handoff_count")
+        self._c_relay = counters.rate("serve.group.relay_count")
+        self._c_active = counters.number("serve.group.active")
+        self._c_restart = counters.rate("serve.group.restart_count")
+        self._c_down = counters.rate("serve.group.down_error_count")
+        # reporter-route compatibility with ReplicaStub (empty: the
+        # replicas live in the workers; /replica/info on a grouped node
+        # reports per-group state via query_replica_info instead)
+        self._replicas = {}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, beacon_interval: float = 1.0,
+              maintenance_interval: float = 60.0) -> "GroupedReplicaNode":
+        self._beacon_interval = beacon_interval
+        threads = [threading.Thread(target=self._spawn_checked, args=(g,))
+                   for g in range(self.groups)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dead = [w.g for w in self._workers if not w.alive]
+        if dead:
+            self.stop()
+            raise RuntimeError(f"group executors failed to start: {dead}")
+        self._c_active.set(sum(w.alive for w in self._workers))
+        for target in (self._accept_loop, self._beacon_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.send_beacon()
+        return self
+
+    def _spawn_checked(self, g: int):
+        try:
+            self._spawn(g)
+        except Exception as e:  # noqa: BLE001 - start() reports the group
+            print(f"[serve-groups] group {g} spawn failed: {e!r}", flush=True)
+
+    def _spawn(self, g: int):
+        w = self._workers[g]
+        ctrl_path = os.path.join(self._ctrl_dir, f"g{g}.sock")
+        try:
+            os.unlink(ctrl_path)
+        except OSError:
+            pass
+        spec = dict(self._spec_base, group_index=g,
+                    public_address=self.address, control_path=ctrl_path)
+        spec_path = os.path.join(self._ctrl_dir, f"g{g}.spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        import pegasus_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(pegasus_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["PEGASUS_GROUP_WORKER"] = "1"   # the conftest reaper's marker
+        env.pop("PEGASUS_SERVE_GROUPS", None)  # a worker must never nest
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pegasus_tpu.server", "--group-worker",
+             spec_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, text=True, env=env)
+        ready = threading.Event()
+        port_box = [0]
+
+        def drain():
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith("GROUP_READY "):
+                    port_box[0] = int(line.split()[1])
+                    ready.set()
+                else:
+                    print(f"[group{g}] {line}", flush=True)
+            ready.set()  # EOF: unblock the waiter (alive check fails below)
+
+        threading.Thread(target=drain, daemon=True).start()
+        if not ready.wait(self.spawn_timeout) or not port_box[0]:
+            proc.kill()
+            raise RuntimeError(f"group {g} produced no GROUP_READY "
+                               f"within {self.spawn_timeout:.0f}s")
+        ctrl = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        ctrl.connect(ctrl_path)
+        w.proc, w.port, w.ctrl, w.alive = proc, port_box[0], ctrl, True
+        w.ctrl_ok = True
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for w in self._workers:
+            w.close()   # control-channel EOF = the worker's exit signal
+        for w in self._workers:
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                    w.proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        w.proc.kill()
+                        w.proc.wait(timeout=5)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+        self.pool.close()
+        import shutil
+
+        shutil.rmtree(self._ctrl_dir, ignore_errors=True)
+
+    # ----------------------------------------------------------- chaos API
+
+    def kill_group(self, g: int):
+        """Hard-kill one group executor (chaos: a wedged/crashed group)."""
+        w = self._workers[g]
+        port = w.port
+        w.close()
+        if w.proc is not None:
+            try:
+                w.proc.kill()
+                w.proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        if port:
+            self.pool.invalidate(("127.0.0.1", port))
+        self._c_active.set(sum(x.alive for x in self._workers))
+
+    def restart_group(self, g: int):
+        """Respawn a dead group and replay its cached open-replica state
+        so it re-serves immediately (decree state recovers from the
+        shared-on-disk plog + engine; the meta's next proposal round
+        would eventually do the same, this just doesn't wait for it)."""
+        self._spawn(g)
+        self._c_restart.increment()
+        self._c_active.set(sum(x.alive for x in self._workers))
+        with self._lock:
+            cached = [(k, v) for k, v in self._open_cache.items()
+                      if group_of(k[0], k[1], self.groups) == g]
+        from ..meta.meta_server import RPC_OPEN_REPLICA
+
+        for (app_id, pidx), body in cached:
+            try:
+                self._upstream(g).call(RPC_OPEN_REPLICA, body, app_id=app_id,
+                                       partition_index=pidx, timeout=30.0)
+            except (RpcError, OSError, ConnectionError) as e:
+                print(f"[serve-groups] replay {app_id}.{pidx} -> group {g} "
+                      f"failed: {e!r}", flush=True)
+
+    # ------------------------------------------------------------- routing
+
+    def _upstream(self, g: int) -> RpcConnection:
+        """Parent->worker connection, cached in the node's ConnectionPool
+        (reconnect-on-failure semantics come with it; a restarted worker
+        gets a fresh port and therefore a fresh pool entry)."""
+        w = self._workers[g]
+        if not w.alive:
+            raise ConnectionError(f"group {g} down")
+        return self.pool.get(("127.0.0.1", w.port))
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._router_conn, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_first_frame(conn):
+        """-> (RpcHeader, buffered bytes incl. the frame and any extra
+        already-received bytes), or (None, b"") at EOF."""
+        buf = bytearray()
+        while True:
+            if len(buf) >= 8:
+                (plen,) = struct.unpack_from("<I", buf, 0)
+                if len(buf) >= 4 + plen:
+                    (hlen,) = struct.unpack_from("<I", buf, 4)
+                    header = codec.decode(RpcHeader, bytes(buf[8:8 + hlen]))
+                    return header, buf
+            chunk = conn.recv(1 << 16)
+            if not chunk:
+                return None, b""
+            buf += chunk
+
+    def _handoff(self, w: _Worker, conn, buffered: bytes) -> bool:
+        """Pass the connected socket + its already-read bytes to the
+        worker over the control channel (SCM_RIGHTS). -> True on success
+        (the parent must then close its fd copy and forget the conn)."""
+        payload = struct.pack("<I", len(buffered)) + bytes(buffered)
+        try:
+            with w.ctrl_lock:
+                if not w.ctrl_ok:
+                    return False
+                # send_fds is ONE sendmsg: the fd rides its ancillary data,
+                # but a large first frame can exceed the unix-socket buffer
+                # and return a SHORT write — push the rest with sendall or
+                # both ends wedge (worker waiting for bytes, parent for ack)
+                w.ctrl.settimeout(10.0)  # a wedged worker must not pin
+                # ctrl_lock forever (every later handoff would queue on it)
+                try:
+                    sent = socket.send_fds(w.ctrl, [payload],
+                                           [conn.fileno()])
+                    if sent < len(payload):
+                        w.ctrl.sendall(payload[sent:])
+                    # 1-byte ack serializes fd+payload pairs on the stream
+                    if w.ctrl.recv(1) != b"A":
+                        raise ConnectionError("handoff not acked")
+                finally:
+                    w.ctrl.settimeout(None)
+            return True
+        except (OSError, ConnectionError) as e:
+            # the channel may be desynced mid-message: stop handing off to
+            # this group but KEEP it alive — relay still serves it, and a
+            # transient send failure must not take the whole group down
+            w.ctrl_ok = False
+            print(f"[serve-groups] group {w.g} handoff channel degraded "
+                  f"({e!r}); serving via relay until restart", flush=True)
+            return False
+
+    def _route_frame(self, header, body):
+        """-> group index, or None for node-level codes."""
+        if header.app_id > 0 or header.partition_index > 0:
+            return group_of(header.app_id, header.partition_index,
+                            self.groups)
+        req_cls = _body_routed().get(header.code)
+        if req_cls is not None:
+            try:
+                req = codec.decode(req_cls, body)
+                return group_of(req.app_id, req.pidx, self.groups)
+            except codec.CodecError:
+                return None
+        if header.code == RPC_GROUP_STATE:
+            return 0
+        return None   # node-level: fan out
+
+    def _router_conn(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            header, buffered = self._read_first_frame(conn)
+        except (OSError, codec.CodecError):
+            header = None
+        if header is None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        # fast path: a sharded connection carries ONE partition's frames —
+        # hand the socket to the owning group and get out of the way
+        if header.sharded:
+            g = self._route_frame(header, b"")
+            if g is not None:
+                w = self._workers[g]
+                if w.alive and self._handoff(w, conn, buffered):
+                    self._c_handoff.increment()
+                    try:
+                        conn.close()   # worker owns the duplicated fd now
+                    except OSError:
+                        pass
+                    return
+        # relay path: serve the connection here, routing frame by frame
+        self._relay_conn(conn, bytes(buffered))
+
+    def _relay_conn(self, conn, initial: bytes):
+        from ..rpc.transport import make_frame_reader
+
+        wlock = threading.Lock()
+        try:
+            reader = make_frame_reader(conn, initial)
+            while True:
+                for header, body in reader.wave():
+                    try:
+                        self._relay_frame(conn, wlock, header, body)
+                    except (ConnectionError, OSError):
+                        raise
+                    except Exception as e:  # noqa: BLE001 - a router bug
+                        # must surface as an error RESPONSE, not a dead
+                        # connection the client can only time out on
+                        err = RpcHeader(seq=header.seq, code=header.code,
+                                        is_response=True,
+                                        error=ERR_NETWORK_FAILURE,
+                                        error_text=f"router error: {e!r}")
+                        _send_frame(conn, err, b"", lock=wlock)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _relay_frame(self, conn, wlock, header, body):
+        from ..meta.meta_server import RPC_CLOSE_REPLICA, RPC_OPEN_REPLICA
+
+        self._c_relay.increment()
+        g = self._route_frame(header, body)
+        resp = RpcHeader(seq=header.seq, code=header.code, is_response=True)
+        out = b""
+        if g is None:
+            resp, out = self._fanout(header, body, resp)
+        else:
+            # lifecycle cache: a restarted group replays from here
+            if header.code == RPC_OPEN_REPLICA:
+                try:
+                    req = codec.decode(mm.OpenReplicaRequest, body)
+                    with self._lock:
+                        self._open_cache[(req.app_id, req.pidx)] = body
+                except codec.CodecError:
+                    pass
+            elif header.code == RPC_CLOSE_REPLICA:
+                try:
+                    req = codec.decode(mm.CloseReplicaRequest, body)
+                    with self._lock:
+                        self._open_cache.pop((req.app_id, req.pidx), None)
+                except codec.CodecError:
+                    pass
+            try:
+                rh, out = self._upstream(g).call(
+                    header.code, body, app_id=header.app_id,
+                    partition_index=header.partition_index,
+                    partition_hash=header.partition_hash, timeout=60.0)
+            except RpcError as e:
+                resp.error, resp.error_text = e.err, e.text
+                if e.err == ERR_NETWORK_FAILURE:
+                    self._c_down.increment()
+            except (OSError, ConnectionError) as e:
+                resp.error = ERR_NETWORK_FAILURE
+                resp.error_text = f"group {g} down: {e}"
+                self._c_down.increment()
+        try:
+            _send_frame(conn, resp, out, lock=wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    def _fanout(self, header, body, resp):
+        """Node-level codes hit every live group; responses merge."""
+        from ..meta.meta_server import RPC_QUERY_REPLICA_INFO
+        from ..runtime.remote_command import (RemoteCommandResponse)
+        from .replica_stub import RPC_REMOTE_COMMAND
+
+        results, last_err = [], None
+        for g in range(self.groups):
+            try:
+                results.append(self._upstream(g).call(header.code, body,
+                                                      timeout=30.0))
+            except (RpcError, OSError, ConnectionError) as e:
+                last_err = e
+        if not results:
+            resp.error = ERR_NETWORK_FAILURE
+            resp.error_text = f"no live group: {last_err}"
+            return resp, b""
+        if header.code == RPC_QUERY_REPLICA_INFO:
+            merged = []
+            for _, rbody in results:
+                merged.extend(codec.decode(mm.QueryReplicaInfoResponse,
+                                           rbody).replicas)
+            return resp, codec.encode(
+                mm.QueryReplicaInfoResponse(replicas=merged))
+        if header.code == RPC_REMOTE_COMMAND:
+            parts = [codec.decode(RemoteCommandResponse, rbody).output
+                     for _, rbody in results]
+            return resp, codec.encode(RemoteCommandResponse(
+                _merge_command_outputs(parts)))
+        return resp, results[0][1]
+
+    # ------------------------------------------------------------- beacons
+
+    def _beacon_loop(self):
+        while not self._stop.wait(self._beacon_interval):
+            try:
+                self.send_beacon()
+            except Exception as e:  # a dead beacon loop = node declared dead
+                print(f"[serve-groups beacon] {self.address}: {e!r}",
+                      flush=True)
+
+    def send_beacon(self):
+        """ONE beacon for the whole node: merge every live worker's
+        replica/dup state (RPC_GROUP_STATE) under the public address."""
+        from ..meta.meta_server import RPC_FD_BEACON
+
+        alive, progress = [], []
+        for g in range(self.groups):
+            if not self._workers[g].alive:
+                continue
+            try:
+                _, rbody = self._upstream(g).call(RPC_GROUP_STATE, b"",
+                                                  timeout=2.0)
+                st = json.loads(rbody.decode("utf-8"))
+                alive.extend(st.get("alive", []))
+                progress.extend(st.get("dup_progress", []))
+            except (RpcError, OSError, ConnectionError, ValueError):
+                continue
+        body = codec.encode(mm.BeaconRequest(
+            node=self.address, alive_replicas=alive, dup_progress=progress))
+        for m in self.meta_addrs:
+            host, _, port = m.rpartition(":")
+            try:
+                self.pool.get((host, int(port))).call(RPC_FD_BEACON, body,
+                                                      timeout=2.0)
+            except (RpcError, OSError):
+                continue

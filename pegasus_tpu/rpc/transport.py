@@ -24,6 +24,7 @@ from contextlib import nullcontext
 from dataclasses import dataclass
 
 from . import codec
+from ..runtime.fail_points import FailPointError, fail_point
 from ..runtime.perf_counters import counters
 from ..runtime.tracing import REQUEST_TRACER, TraceContext
 
@@ -58,6 +59,12 @@ class RpcHeader:
     # older encoder still decode (the fields default).
     trace_id: int = 0
     trace_sampled: bool = False
+    # True on every frame of a connection that carries ONE partition's
+    # traffic only (ConnectionPool shard keys). A partition-group router
+    # may hand such a connection off to the owning group executor wholesale
+    # (replication/serve_groups.py); unsharded connections stay on the
+    # per-frame relay path. Appended last (evolution rule).
+    sharded: bool = False
 
 
 class RpcError(Exception):
@@ -90,9 +97,9 @@ class _FrameReader:
 
     __slots__ = ("sock", "buf", "pos")
 
-    def __init__(self, sock):
+    def __init__(self, sock, initial: bytes = b""):
         self.sock = sock
-        self.buf = bytearray()
+        self.buf = bytearray(initial)
         self.pos = 0
 
     def _fill(self, need: int) -> None:
@@ -122,6 +129,61 @@ class _FrameReader:
         self.pos = pos + 4 + plen
         return header, body
 
+    def _buffered_frame(self) -> bool:
+        """A complete frame sits in the buffer (no recv needed)?"""
+        avail = len(self.buf) - self.pos
+        if avail < 4:
+            return False
+        (plen,) = struct.unpack_from("<I", self.buf, self.pos)
+        return avail >= 4 + plen
+
+    def wave(self):
+        """-> every complete frame currently available (blocking for the
+        first): the pure-Python twin of fastcodec.FrameReader.read_wave."""
+        out = [self.frame()]
+        while self._buffered_frame():
+            out.append(self.frame())
+        return out
+
+
+class _NativeFrameReader:
+    """fastcodec.FrameReader wrapper: drains a pipelined frame wave in ONE
+    C call (recv with the GIL released + header decode + body slicing),
+    instead of re-entering Python per frame."""
+
+    __slots__ = ("sock", "fr")
+
+    def __init__(self, fc, sock, initial: bytes = b""):
+        self.sock = sock
+        self.fr = fc.FrameReader(codec._plan_of(RpcHeader))
+        if initial:
+            self.fr.feed(initial)
+
+    def wave(self):
+        # resolve the fd per wave, never cache it: after sock.close() (a
+        # timed-out connection being invalidated under this reader) the
+        # number can be REUSED by a brand-new socket, and a cached fd
+        # would recv another connection's bytes. fileno() on a closed
+        # socket returns -1 -> EBADF -> clean reader exit.
+        fd = self.sock.fileno()
+        if fd < 0:
+            raise ConnectionError("socket closed")
+        return self.fr.read_wave(fd)
+
+
+def make_frame_reader(sock, initial: bytes = b""):
+    """Best available frame reader for a blocking socket: the C wave
+    drainer when fastcodec is importable AND the RpcHeader plan compiled
+    to a C plan (a Python-plan header would hand the C reader an
+    incompatible object), else the buffered Python reader."""
+    from .. import native
+
+    fc = native.fastcodec()
+    if fc is not None and hasattr(fc, "FrameReader") \
+            and isinstance(codec._plan_of(RpcHeader), fc.Plan):
+        return _NativeFrameReader(fc, sock, initial)
+    return _FrameReader(sock, initial)
+
 
 class RpcServer:
     """Threaded TCP serverlet. Handlers: code -> fn(header, body) -> body.
@@ -130,11 +192,19 @@ class RpcServer:
     on the connection's thread (the engine has its own locking)."""
 
     # requests run on a shared worker pool (a thread spawn per request cost
-    # ~60us x thousands/s on the serving path); when every worker is busy —
-    # e.g. blocked in group-commit waits or a long learn — overflow requests
-    # get a fresh thread so a saturated pool can never deadlock behind its
-    # own blocked work
+    # ~60us x thousands/s on the serving path). Requests beyond the pool
+    # QUEUE (bounded dispatch — the old design spawned an unbounded raw
+    # thread per overflow request), except PRIORITY_CODES: replication and
+    # lifecycle RPCs keep the escape-hatch thread, because a pool whose 16
+    # workers all sit in client_write waiting for secondary prepare acks
+    # must still serve the prepares those acks depend on (the classic
+    # distributed pool deadlock).
     POOL_WORKERS = 16
+    PRIORITY_CODES = frozenset({
+        "RPC_PREPARE", "RPC_LEARN", "RPC_FD_FAILURE_DETECTOR_PING",
+        "RPC_CONFIG_PROPOSAL_OPEN_REPLICA",
+        "RPC_CONFIG_PROPOSAL_CLOSE_REPLICA",
+    })
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers = {}
@@ -145,20 +215,12 @@ class RpcServer:
                                         thread_name_prefix="rpc-serve")
         self._busy = 0
         self._busy_lock = threading.Lock()
+        self._depth_gauge = counters.number("rpc.server.dispatch_queue_depth")
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                self.request.setsockopt(socket.IPPROTO_TCP,
-                                        socket.TCP_NODELAY, 1)
-                wlock = threading.Lock()
-                reader = _FrameReader(self.request)
-                try:
-                    while True:
-                        header, body = reader.frame()
-                        outer._dispatch(self.request, wlock, header, body)
-                except (ConnectionError, OSError):
-                    pass
+                outer.serve_connection(self.request)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -168,6 +230,40 @@ class RpcServer:
         self.address = self._srv.server_address  # (host, actual_port)
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
+
+    def serve_connection(self, sock, initial: bytes = b"") -> None:
+        """Serve one connection to exhaustion: drain pipelined frame waves
+        (fastcodec.FrameReader when available — frame read + header decode
+        stay in C for the whole wave) and dispatch each request."""
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            return
+        wlock = threading.Lock()
+        dispatch = self._dispatch
+        try:
+            reader = make_frame_reader(sock, initial)
+            while True:
+                for header, body in reader.wave():
+                    dispatch(sock, wlock, header, body)
+        except (ConnectionError, OSError):
+            pass
+
+    def serve_adopted(self, sock, initial: bytes = b"") -> None:
+        """Adopt a connection accepted elsewhere (the partition-group
+        router hands client sockets over with their already-read bytes);
+        serving runs on a fresh daemon thread, closing the socket at EOF."""
+        def run():
+            try:
+                self.serve_connection(sock, initial)
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="rpc-adopted").start()
 
     def register(self, code: str, handler) -> None:
         self._handlers[code] = handler
@@ -192,16 +288,42 @@ class RpcServer:
         self._pool.shutdown(wait=False)
 
     def _dispatch(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
+        # serve.dispatch: the chaos seam for a wedged group executor —
+        # sleep(ms) stalls this connection's whole dispatch loop (frames
+        # queue in the kernel buffer, the client's timeout is the bound),
+        # raise(msg) rejects the request with ERR_BUSY instead of serving
+        try:
+            fail_point("serve.dispatch")
+        except FailPointError as e:
+            resp = RpcHeader(seq=header.seq, code=header.code,
+                             is_response=True, error=ERR_BUSY,
+                             error_text=str(e))
+            counters.rate("rpc.server.error_count").increment()
+            try:
+                _send_frame(sock, resp, b"", lock=wlock)
+            except (ConnectionError, OSError):
+                pass
+            return
+        if header.code in self.PRIORITY_CODES:
+            with self._busy_lock:
+                overflow = self._busy >= self.POOL_WORKERS
+            if overflow:
+                # liveness escape: replication/lifecycle must never queue
+                # behind a pool full of work that is WAITING on them
+                threading.Thread(target=self._serve_one,
+                                 args=(sock, wlock, header, body),
+                                 daemon=True).start()
+                return
         with self._busy_lock:
-            overflow = self._busy >= self.POOL_WORKERS
-            if not overflow:
-                self._busy += 1
-        if overflow:
-            threading.Thread(target=self._serve_one,
-                             args=(sock, wlock, header, body),
-                             daemon=True).start()
-        else:
+            self._busy += 1
+            depth = self._busy - self.POOL_WORKERS
+        if depth > 0:
+            self._depth_gauge.set(depth)
+        try:
             self._pool.submit(self._serve_pooled, sock, wlock, header, body)
+        except RuntimeError:   # server stopping: pool already shut down
+            with self._busy_lock:
+                self._busy -= 1
 
     def _serve_pooled(self, sock, wlock, header, body) -> None:
         try:
@@ -209,6 +331,8 @@ class RpcServer:
         finally:
             with self._busy_lock:
                 self._busy -= 1
+                depth = self._busy - self.POOL_WORKERS
+            self._depth_gauge.set(max(0, depth))
 
     def _serve_one(self, sock, wlock, header: RpcHeader, body: bytes) -> None:
         resp = RpcHeader(seq=header.seq, code=header.code, is_response=True)
@@ -247,10 +371,17 @@ class RpcServer:
 
 
 class RpcConnection:
-    """One full-duplex client connection with pipelined calls."""
+    """One full-duplex client connection with pipelined calls.
 
-    def __init__(self, addr, connect_timeout: float = 5.0):
+    shard: any hashable marking this connection as carrying exactly ONE
+    partition's traffic (the ConnectionPool's shard key). Sharded
+    connections set RpcHeader.sharded on every frame, which lets a
+    partition-group serving node hand the whole connection to the owning
+    group executor instead of relaying frame by frame."""
+
+    def __init__(self, addr, connect_timeout: float = 5.0, shard=None):
         self.addr = tuple(addr)
+        self.shard = shard
         self._sock = socket.create_connection(self.addr, timeout=connect_timeout)
         self._sock.settimeout(None)
         # rpc frames are small request/response pairs: Nagle + delayed ACK
@@ -267,15 +398,20 @@ class RpcConnection:
 
     def _read_loop(self):
         try:
-            reader = _FrameReader(self._sock)
+            reader = make_frame_reader(self._sock)
             while True:
-                header, body = reader.frame()
+                frames = reader.wave()
+                # one lock round per WAVE: pipelined responses (call_many
+                # peers, group-commit bursts) stop paying a lock handoff
+                # per frame
                 with self._plock:
-                    ent = self._pending.pop(header.seq, None)
-                if ent:
-                    ev, slot = ent
-                    slot.append((header, body))
-                    ev.set()
+                    ents = [(self._pending.pop(h.seq, None), h, b)
+                            for h, b in frames]
+                for ent, header, body in ents:
+                    if ent:
+                        ev, slot = ent
+                        slot.append((header, body))
+                        ev.set()
         except (ConnectionError, OSError) as e:
             self._dead = e
             with self._plock:
@@ -305,7 +441,8 @@ class RpcConnection:
                            partition_index=partition_index,
                            partition_hash=partition_hash,
                            trace_id=ctx.trace_id if ctx else 0,
-                           trace_sampled=bool(ctx and ctx.sampled))
+                           trace_sampled=bool(ctx and ctx.sampled),
+                           sharded=self.shard is not None)
         with REQUEST_TRACER.span(f"rpc.{code}", bytes=len(body)):
             try:
                 _send_frame(self._sock, header, body, lock=self._wlock)
@@ -331,21 +468,39 @@ class RpcConnection:
         return rh, rbody
 
     def call_many(self, calls, timeout: float = 10.0):
-        """Pipelined batch call: every (code, body) request frame is
-        buffered and leaves in ONE coalesced socket send (writev-style —
-        the per-frame sendall of k small frames cost k syscalls and k
-        wlock acquisitions), then the responses are collected in issue
-        order. -> [(RpcHeader, body)]; raises RpcError on the first
-        failure. The replication catch-up path streams its backlog
-        windows through here."""
+        """Pipelined batch call: every request frame is buffered and
+        leaves in ONE coalesced socket send (writev-style — the per-frame
+        sendall of k small frames cost k syscalls and k wlock
+        acquisitions), then the responses are collected in issue order.
+
+        Each call is (code, body) or (code, body, app_id, pidx, phash) —
+        the 5-tuple shape routes each frame like call() does, so the
+        client's multi-partition fan-out (batch_get / scanner prefetch /
+        duplicator shipping) pipelines through here too.
+
+        -> [(RpcHeader, body)]; raises RpcError on the first failure. The
+        replication catch-up path streams its backlog windows through
+        here."""
+        pend = self.call_many_send(calls)
+        return self.call_many_collect(pend, calls, timeout)
+
+    def call_many_send(self, calls):
+        """Send half of call_many: one coalesced write, -> pending token.
+        Lets a caller overlap waves across SEVERAL connections (fan-out
+        sends first, then collects), so k partitions' worth of server work
+        runs concurrently instead of lockstep."""
         if not calls:
             return []
         if self._dead:
             raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
         ctx = REQUEST_TRACER.current()
+        sharded = self.shard is not None
         pend, buf = [], bytearray()
         with self._plock:
-            for code, body in calls:
+            for call in calls:
+                code, body = call[0], call[1]
+                app_id, pidx, phash = (call[2], call[3], call[4]) \
+                    if len(call) > 2 else (0, 0, 0)
                 self._seq += 1
                 seq = self._seq
                 ev = self._ev_pool.pop() if self._ev_pool else threading.Event()
@@ -353,14 +508,15 @@ class RpcConnection:
                 self._pending[seq] = (ev, slot)
                 pend.append((seq, ev, slot))
                 header = RpcHeader(
-                    seq=seq, code=code,
+                    seq=seq, code=code, app_id=app_id,
+                    partition_index=pidx, partition_hash=phash,
                     trace_id=ctx.trace_id if ctx else 0,
-                    trace_sampled=bool(ctx and ctx.sampled))
+                    trace_sampled=bool(ctx and ctx.sampled),
+                    sharded=sharded)
                 h = codec.encode(header)
                 buf += struct.pack("<II", 4 + len(h) + len(body), len(h))
                 buf += h
                 buf += body
-        deadline = time.monotonic() + timeout
         with REQUEST_TRACER.span("rpc.call_many", bytes=len(buf),
                                  records=len(calls)):
             try:
@@ -371,24 +527,29 @@ class RpcConnection:
                     for seq, _, _ in pend:
                         self._pending.pop(seq, None)
                 raise RpcError(ERR_NETWORK_FAILURE, str(e))
-            out = []
-            for i, (seq, ev, slot) in enumerate(pend):
-                if not ev.wait(max(0.0, deadline - time.monotonic())):
-                    with self._plock:  # abandon everything still in flight
-                        for s2, _, _ in pend[i:]:
-                            self._pending.pop(s2, None)
-                    raise RpcError(ERR_TIMEOUT,
-                                   f"{calls[i][0]} after {timeout}s")
-                if not slot or slot[0] is None:
-                    raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
-                rh, rbody = slot[0]
-                ev.clear()
-                with self._plock:
-                    if len(self._ev_pool) < 64:
-                        self._ev_pool.append(ev)
-                if rh.error != ERR_OK:
-                    raise RpcError(rh.error, rh.error_text)
-                out.append((rh, rbody))
+        return pend
+
+    def call_many_collect(self, pend, calls, timeout: float = 10.0):
+        """Collect half of call_many: responses in issue order."""
+        deadline = time.monotonic() + timeout
+        out = []
+        for i, (seq, ev, slot) in enumerate(pend):
+            if not ev.wait(max(0.0, deadline - time.monotonic())):
+                with self._plock:  # abandon everything still in flight
+                    for s2, _, _ in pend[i:]:
+                        self._pending.pop(s2, None)
+                raise RpcError(ERR_TIMEOUT,
+                               f"{calls[i][0]} after {timeout}s")
+            if not slot or slot[0] is None:
+                raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
+            rh, rbody = slot[0]
+            ev.clear()
+            with self._plock:
+                if len(self._ev_pool) < 64:
+                    self._ev_pool.append(ev)
+            if rh.error != ERR_OK:
+                raise RpcError(rh.error, rh.error_text)
+            out.append((rh, rbody))
         return out
 
     def close(self):
@@ -399,35 +560,46 @@ class RpcConnection:
 
 
 class ConnectionPool:
-    """addr -> RpcConnection cache with reconnect-on-failure."""
+    """(addr, shard) -> RpcConnection cache with reconnect-on-failure.
+
+    shard=None (default) is the classic one-connection-per-node behavior.
+    A non-None shard keys a DEDICATED connection for one partition's
+    traffic: the client's partition fan-out stops serializing behind a
+    single socket, and a partition-group serving node can hand the whole
+    connection to the owning group executor (RpcHeader.sharded)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._conns = {}
 
-    def get(self, addr) -> RpcConnection:
+    def get(self, addr, shard=None) -> RpcConnection:
         addr = tuple(addr)
+        key = (addr, shard)
         with self._lock:
-            conn = self._conns.get(addr)
+            conn = self._conns.get(key)
         if conn is not None and not conn._dead:
             return conn
         # connect OUTSIDE the pool lock: a black-holed peer blocks
         # create_connection for its full timeout, and holding the pool-wide
         # lock through that would serialize every other caller (including
         # the replication write path) behind one dead host
-        fresh = RpcConnection(addr)
+        fresh = RpcConnection(addr, shard=shard)
         with self._lock:
-            cur = self._conns.get(addr)
+            cur = self._conns.get(key)
             if cur is not None and not cur._dead and cur is not conn:
                 fresh.close()  # lost the race to another connector
                 return cur
-            self._conns[addr] = fresh
+            self._conns[key] = fresh
         return fresh
 
     def invalidate(self, addr) -> None:
+        """Drop EVERY shard's connection to addr (a dead node is dead for
+        all of its partitions)."""
+        addr = tuple(addr)
         with self._lock:
-            conn = self._conns.pop(tuple(addr), None)
-        if conn:
+            dead = [k for k in self._conns if k[0] == addr]
+            conns = [self._conns.pop(k) for k in dead]
+        for conn in conns:
             conn.close()
 
     def close(self):

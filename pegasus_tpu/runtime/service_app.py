@@ -356,20 +356,42 @@ class ReplicaApp:
             for key in config.keys("pegasus.clusters"):
                 remote_clusters[key] = config.get_list("pegasus.clusters",
                                                        key, [])
-        self.stub = ReplicaStub(
-            data_dir, list(metas),
-            host=config.get_string(section, "host", "127.0.0.1"),
-            port=config.get_int(section, "port", 0),
-            options_factory=options_factory,
-            remote_clusters=remote_clusters,
-            cluster_id=config.get_int("pegasus.server", "cluster_id", 1))
+        # shared-nothing partition-group executors: PEGASUS_SERVE_GROUPS
+        # (or [apps.replica] serve_groups) > 1 forks that many worker
+        # processes, each owning a disjoint partition set, behind one
+        # public acceptor/router (replication/serve_groups.py)
+        groups = int(os.environ.get("PEGASUS_SERVE_GROUPS")
+                     or config.get_int(section, "serve_groups", 1))
+        if groups > 1:
+            from ..replication.serve_groups import GroupedReplicaNode
+
+            self.stub = GroupedReplicaNode(
+                data_dir, list(metas),
+                host=config.get_string(section, "host", "127.0.0.1"),
+                port=config.get_int(section, "port", 0),
+                groups=groups, backend=backend, compression=compression,
+                sharded_compaction=sharded,
+                remote_clusters=remote_clusters,
+                cluster_id=config.get_int("pegasus.server", "cluster_id", 1))
+        else:
+            self.stub = ReplicaStub(
+                data_dir, list(metas),
+                host=config.get_string(section, "host", "127.0.0.1"),
+                port=config.get_int(section, "port", 0),
+                options_factory=options_factory,
+                remote_clusters=remote_clusters,
+                cluster_id=config.get_int("pegasus.server", "cluster_id", 1))
         self._beacon = config.get_float("failure_detector",
                                         "beacon_interval_seconds", 1.0)
-        from .toollets import install_toollets
+        if hasattr(self.stub, "rpc"):
+            # toollets wrap the in-process serverlet; a grouped node's
+            # serving happens inside the worker processes (each worker's
+            # own stub could grow toollets, but the router has no handlers)
+            from .toollets import install_toollets
 
-        install_toollets(self.stub.rpc,
-                         config.get_list("core", "toollets", ()),
-                         command_service=self.stub.commands)
+            install_toollets(self.stub.rpc,
+                             config.get_list("core", "toollets", ()),
+                             command_service=self.stub.commands)
         http_port = config.get_int(section, "http_port", -1)
         self.reporter = None
         if http_port >= 0:

@@ -1,11 +1,12 @@
 import argparse
 import os
-
-from ..runtime.config import Config
-from ..runtime.service_app import ServiceAppContainer
+import sys
 
 
 def main(argv=None):
+    from ..runtime.config import Config
+    from ..runtime.service_app import ServiceAppContainer
+
     ap = argparse.ArgumentParser(prog="pegasus-server")
     ap.add_argument("--config", required=True, help="ini config path")
     ap.add_argument("--app", default="", help="comma-separated app names "
@@ -35,4 +36,37 @@ def main(argv=None):
         container.stop()
 
 
-main()
+def group_worker_main(spec_path: str):
+    """One partition-group executor (replication/serve_groups.py): a full
+    ReplicaStub on an ephemeral localhost port owning this group's share
+    of the node's partitions. Prints GROUP_READY <port> once serving; the
+    parent's control-channel EOF (watched by the stub's adoption loop) is
+    the exit signal, so an orphan worker can never outlive its node."""
+    import json
+    import threading
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    from ..engine import EngineOptions
+    from ..replication.replica_stub import ReplicaStub
+
+    def options_factory():
+        return EngineOptions(
+            backend=spec.get("backend", "cpu"),
+            compression=spec.get("compression", "none"),
+            sharded_compaction=bool(spec.get("sharded_compaction")))
+
+    stub = ReplicaStub(
+        spec["root"], list(spec["metas"]), host="127.0.0.1", port=0,
+        options_factory=options_factory,
+        remote_clusters=spec.get("remote_clusters") or {},
+        cluster_id=int(spec.get("cluster_id", 1)), group_spec=spec)
+    stub.start()
+    print(f"GROUP_READY {stub.rpc.address[1]}", flush=True)
+    threading.Event().wait()
+
+
+if "--group-worker" in sys.argv[1:]:
+    group_worker_main(sys.argv[sys.argv.index("--group-worker") + 1])
+else:
+    main()

@@ -27,6 +27,37 @@ from pegasus_tpu.base.utils import enable_compile_cache  # noqa: E402
 enable_compile_cache()
 
 
+def _reap_group_workers():
+    """Kill any partition-group executor the suite (or a crashed test)
+    left behind: workers are separate OS processes (`-m pegasus_tpu.server
+    --group-worker`), and a leaked one would hold its engine dirs and
+    sockets past the run. Normal teardown (GroupedReplicaNode.stop or
+    control-channel EOF) exits them; this is the backstop that keeps
+    tier-1 leak-free no matter how a test died."""
+    import signal
+
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(errors="replace")
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        # scope the kill: only THIS session's children and true orphans
+        # (ppid 1 = a worker whose parent already died) — never another
+        # concurrent run's live workers
+        if "--group-worker" in cmd and ppid in (me, 1):
+            print(f"[conftest] reaping leaked group worker pid={pid}")
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+            except OSError:
+                pass
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Join the process-wide daemon executors BEFORE interpreter exit.
 
@@ -58,3 +89,7 @@ def pytest_sessionfinish(session, exitstatus):
             p.stop()
     except Exception as e:  # teardown must never mask the run's outcome
         print(f"[conftest] executor teardown: {e!r}")
+    try:
+        _reap_group_workers()
+    except Exception as e:  # the reaper is best-effort
+        print(f"[conftest] group-worker reap: {e!r}")

@@ -248,3 +248,47 @@ def test_ycsb_mode_smoke():
     assert "loadavg" in d["host"]["start"] and "cpu_count" in d["host"]["end"]
     # the self-booted onebox is in-process: nothing may outlive the bench
     assert not _python_procs(), "ycsb mode left processes behind"
+
+
+@pytest.mark.slow
+def test_ycsb_group_sweep_scaling():
+    """The partition-group scaling artifact (BENCH_r06-ready): the sweep
+    mode runs the same YCSB-A workload with the replica nodes split into
+    1 vs 4 shared-nothing group executors. On a >=4-core host groups=4
+    must clear 1.5x the ops/s of groups=1 (the single-GIL ceiling); on
+    smaller hosts only the sweep mechanics are asserted — the scaling
+    claim needs cores for the executors to land on."""
+    cores = os.cpu_count() or 1
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PEGASUS_BENCH_MODE": "ycsb",
+        "PEGASUS_BENCH_YCSB_GROUPS": "1,4",
+        "PEGASUS_BENCH_YCSB_RECORDS": "2000",
+        "PEGASUS_BENCH_YCSB_OPS": "16000",
+        "PEGASUS_BENCH_YCSB_THREADS": "8",
+        "PEGASUS_BENCH_YCSB_PARTITIONS": "8",
+        "PEGASUS_BENCH_TIMEOUT_S": "560",
+    })
+    proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, timeout=580, env=env, cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert proc.returncode == 0 and len(lines) == 1, \
+        f"rc={proc.returncode} out={proc.stdout[-300:]} err={proc.stderr[-500:]}"
+    line = json.loads(lines[0])
+    assert line["unit"] == "ops/s"
+    assert "serve-group sweep" in line["metric"]
+    sweep = line["detail"]["sweep"]
+    assert [e["groups"] for e in sweep] == [1, 4]
+    assert all(e["errors"] == 0 for e in sweep), sweep
+    assert all(e["ops_s"] > 0 for e in sweep)
+    # host-contention detail rides every sweep entry
+    assert all("loadavg" in e["host"]["start"] for e in sweep)
+    # no leaked group-executor processes after the bench exits
+    assert not _python_procs(), "sweep left processes behind"
+    if cores >= 4:
+        scaling = sweep[1]["ops_s"] / sweep[0]["ops_s"]
+        assert scaling >= 1.5, (
+            f"groups=4 must clear 1.5x groups=1 on a {cores}-core host, "
+            f"got {scaling:.2f}x ({sweep[0]['ops_s']} -> "
+            f"{sweep[1]['ops_s']} ops/s)")

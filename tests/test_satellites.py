@@ -25,23 +25,34 @@ from pegasus_tpu.rpc.transport import RpcConnection, RpcServer
 
 
 class MiniCluster:
-    def __init__(self, root, n_nodes=3):
+    def __init__(self, root, n_nodes=3, serve_groups=0):
         self.meta = MetaServer(str(root / "meta.json"), fd_grace_seconds=60)
         self.rpc = RpcServer().start()
         for code, fn in self.meta.rpc_handlers().items():
             self.rpc.register(code, fn)
         self.meta_addr = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
-        self.stubs = [ReplicaStub(str(root / f"n{i}"), [self.meta_addr]).start(0.2)
-                      for i in range(n_nodes)]
+        if serve_groups and serve_groups >= 1:
+            # shared-nothing partition-group serving: each node forks
+            # serve_groups worker processes behind one public router
+            from pegasus_tpu.replication.serve_groups import GroupedReplicaNode
+
+            self.stubs = [GroupedReplicaNode(str(root / f"n{i}"),
+                                             [self.meta_addr],
+                                             groups=serve_groups).start(0.2)
+                          for i in range(n_nodes)]
+        else:
+            self.stubs = [ReplicaStub(str(root / f"n{i}"),
+                                      [self.meta_addr]).start(0.2)
+                          for i in range(n_nodes)]
         self._conn = RpcConnection(self.rpc.address)
 
     def ddl(self, code, req, resp_cls):
         _, body = self._conn.call(code, codec.encode(req), timeout=30.0)
         return codec.decode(resp_cls, body)
 
-    def create(self, name, partitions=2):
+    def create(self, name, partitions=2, replicas=3):
         r = self.ddl(RPC_CM_CREATE_APP,
-                     mm.CreateAppRequest(name, partitions, 3),
+                     mm.CreateAppRequest(name, partitions, replicas),
                      mm.CreateAppResponse)
         assert r.error == 0
         return PegasusClient(MetaResolver([self.meta_addr], name))

@@ -15,13 +15,15 @@ class Onebox:
     stop() (or `with Onebox(...) as box:`); `meta_addr` is the routing
     entry point."""
 
-    def __init__(self, table: str, partitions: int = 8, n_nodes: int = 3):
+    def __init__(self, table: str, partitions: int = 8, n_nodes: int = 3,
+                 serve_groups: int = 0, replicas: int = 3):
         from tests.test_satellites import MiniCluster
 
         self._tmp = tempfile.TemporaryDirectory(prefix="pegasus_tool_")
         self.cluster = MiniCluster(pathlib.Path(self._tmp.name),
-                                   n_nodes=n_nodes)
-        self.cluster.create(table, partitions=partitions).close()
+                                   n_nodes=n_nodes, serve_groups=serve_groups)
+        self.cluster.create(table, partitions=partitions,
+                            replicas=replicas).close()
         self.meta_addr = self.cluster.meta_addr
 
     def __enter__(self):
