@@ -565,9 +565,23 @@ def _ycsb_params():
             int(os.environ.get("PEGASUS_BENCH_VALUE", 100)))
 
 
+def _ycsb_mix():
+    """(mix letter, read fraction): PEGASUS_BENCH_YCSB_MIX selects the
+    YCSB point-op mix — 'a' 50/50 read/update (default), 'b' 95/5,
+    'c' 100/0 read-only. The read-heavy variants are the device-served
+    read A/B workload (run with PEGASUS_DEVICE_READS=1 vs 0 against a
+    tpu-backend onebox on hardware; see ROADMAP)."""
+    m = (os.environ.get("PEGASUS_BENCH_YCSB_MIX", "a").strip().lower()
+         or "a")
+    return m, {"a": 0.5, "b": 0.95, "c": 1.0}.get(m, 0.5)
+
+
 def _ycsb_metric_name() -> str:
     records, ops, threads, partitions, value_size = _ycsb_params()
-    return (f"YCSB-A 50/50 read-update ops/sec ({records} records, "
+    mix, read_frac = _ycsb_mix()
+    pct = int(round(read_frac * 100))
+    return (f"YCSB-{mix.upper()} {pct}/{100 - pct} read-update ops/sec "
+            f"({records} records, "
             f"{ops} ops, {threads} threads, {partitions} partitions, "
             f"value={value_size}B)")
 
@@ -616,10 +630,11 @@ def _max_quantiles(dicts):
     return out
 
 
-def _ycsb_load_and_run(box, records, n_ops, n_threads, value):
-    """Shared YCSB-A workload driver: load `records`, run the 50/50
-    read/update mix from `n_threads` clients. -> stats dict (the sweep
-    mode reruns this once per group count)."""
+def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
+                       read_frac: float = 0.5):
+    """Shared YCSB workload driver: load `records`, run the read/update
+    mix (`read_frac` reads) from `n_threads` clients. -> stats dict (the
+    sweep mode reruns this once per group count)."""
     import threading
 
     from pegasus_tpu.client import MetaResolver, PegasusClient
@@ -646,7 +661,7 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value):
             k = b"user%012d" % zipf.pick(rng)
             s = time.perf_counter()
             try:
-                if rng.random() < 0.5:
+                if rng.random() < read_frac:
                     cli.get(k, b"f0")
                     read_lat.set(int((time.perf_counter() - s) * 1e6))
                 else:
@@ -702,7 +717,8 @@ def _ycsb_group_sweep(groups_list):
         host_start = _host_info()
         box = Onebox("ycsb", partitions=partitions, serve_groups=g)
         try:
-            stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value)
+            stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
+                                       read_frac=_ycsb_mix()[1])
         finally:
             box.stop()
         entry = {"groups": g, "host": {"start": host_start,
@@ -720,7 +736,8 @@ def _ycsb_group_sweep(groups_list):
                                if base and base["ops_s"] else None),
     }
     _emit({
-        "metric": (f"YCSB-A ops/sec, serve-group sweep groups="
+        "metric": (f"YCSB-{_ycsb_mix()[0].upper()} ops/sec, "
+                   f"serve-group sweep groups="
                    f"{','.join(str(g) for g in groups_list)} "
                    f"({records} records, {n_ops} ops, {n_threads} threads, "
                    f"{partitions} partitions, value={value_size}B)"),
@@ -768,10 +785,12 @@ def ycsb_main():
 
     host_start = _host_info()
     proc_t0 = time.process_time()
+    mix, read_frac = _ycsb_mix()
     box = Onebox("ycsb", partitions=partitions)
     try:
         value = os.urandom(value_size)
-        stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value)
+        stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
+                                   read_frac=read_frac)
 
         # ---- attribution: server-side latency percentiles per op class
         # (max across partitions, the collector's merge rule), the plog
@@ -789,6 +808,30 @@ def ycsb_main():
             for rep in stub._replicas.values():
                 append_count += rep.plog.append_count
                 flush_count += rep.plog.flush_count
+
+        # ---- device-served reads attribution (ISSUE 7): per-stage read
+        # spans, device probe totals and the read lane guard's state. The
+        # same fallback-free rule the compaction bench applies: a run
+        # whose read lane degraded (fallbacks/abandons > 0) must never
+        # pass its device-read throughput off as a clean device number.
+        from pegasus_tpu.runtime.lane_guard import READ_LANE_GUARD
+
+        read_lane = READ_LANE_GUARD.state()
+        reads_detail = {
+            "mix": mix,
+            "read_fraction": read_frac,
+            "device": {
+                "lookup_count": snap.get("read.device.lookup_count", 0),
+                "keys": snap.get("read.device.keys", 0),
+                "hits": snap.get("read.device.hits", 0),
+            },
+            "batch_size": snap.get("read.batch.size"),
+            "spans": {k: v for k, v in snap.items()
+                      if k.startswith("compact.stage.read.")},
+            "lane": read_lane,
+            "device_numbers_degraded": bool(
+                read_lane["fallbacks"] or read_lane["deadline_abandons"]),
+        }
         result = {
             "metric": _ycsb_metric_name(),
             "value": stats["ops_s"],
@@ -812,6 +855,7 @@ def ycsb_main():
                 "partitions": partitions,
                 "threads": n_threads,
                 "records": records,
+                "reads": reads_detail,
                 "cpu_process_s": round(time.process_time() - proc_t0, 3),
                 "host": {"start": host_start, "end": _host_info()},
             },

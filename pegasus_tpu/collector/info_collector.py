@@ -47,6 +47,11 @@ class InfoCollector:
         self._hot_streak = {}      # (app_name, pidx) -> consecutive rounds
         self._detections = {}      # (app_name, pidx) -> in-flight state
         self.hotkey_results = {}   # app_name -> {pidx: {"kind","key","ts"}}
+        # read-residency the hotkey loop switched on: (app_name, pidx) ->
+        # {"node", "gpid"} — turned off again when the partition calms,
+        # closing the loop that decides which partitions' SSTs stay
+        # HBM-resident for the device read path (ISSUE 7)
+        self.read_residency = {}
 
     def start(self):
         self._thread.start()
@@ -201,11 +206,13 @@ class InfoCollector:
             del self._hot_streak[key]
         # a published verdict gauge must clear once the partition calms
         # (the streak entry is gone by then — key off the verdicts, or a
-        # fixed hot key would page as hot forever)
+        # fixed hot key would page as hot forever); calming also releases
+        # the read residency the verdict switched on
         for pidx in self.hotkey_results.get(app_name, {}):
             if pidx not in flagged_set and (app_name, pidx) not in self._detections:
                 counters.number(
                     f"collector.app.{app_name}.hotkey.{pidx}.hot").set(0)
+                self._set_read_residency(app_name, pidx, on=False)
         # start a detection once the streak proves the hotspot persistent
         for pidx in sorted(flagged_set):
             key = (app_name, pidx)
@@ -256,6 +263,13 @@ class InfoCollector:
                     f"collector.app.{app_name}.hotkey.found_count").increment()
                 counters.number(
                     f"collector.app.{app_name}.hotkey.{pidx}.hot").set(1)
+                if det["kind"] == "read":
+                    # a confirmed read hotspot pins the partition's SSTs
+                    # HBM-resident so its point reads serve from the
+                    # device lookup path (released when it calms)
+                    self._set_read_residency(app_name, pidx, on=True,
+                                             node=det["node"],
+                                             gpid=det["gpid"])
                 self._finish_detection(key, det)
             elif "STOPPED" in out:    # detector timed out without an outlier
                 self._finish_detection(key, det, stop=False)
@@ -266,6 +280,37 @@ class InfoCollector:
         counters.number(
             f"collector.app.{app_name}.hotkey.active_detections").set(
             sum(1 for k in self._detections if k[0] == app_name))
+
+    def _set_read_residency(self, app_name: str, pidx: int, on: bool,
+                            node: str = None, gpid: str = None) -> None:
+        """Flip one partition's device read residency on its primary via
+        the set-read-residency remote command; bookkeeping in
+        self.read_residency so calming turns off exactly what a verdict
+        turned on. Failures are dropped — the next verdict (or calm
+        round) retries, and residency is a hint, not state."""
+        key = (app_name, pidx)
+        if on:
+            target = {"node": node, "gpid": gpid}
+        else:
+            target = self.read_residency.get(key)
+            if target is None:
+                return  # never switched on (or already released)
+        try:
+            self.remote_command(target["node"], "set-read-residency",
+                                [target["gpid"], "on" if on else "off"])
+        except (RpcError, OSError):
+            # state untouched either way: a failed ON is not resident (a
+            # later verdict retries), a failed OFF keeps its bookkeeping
+            # so the next calm round resends the release — the server's
+            # flag must not stay hot because one RPC was dropped
+            return
+        if on:
+            self.read_residency[key] = target
+        else:
+            self.read_residency.pop(key, None)
+        counters.number(
+            f"collector.app.{app_name}.hotkey.{pidx}.device_resident").set(
+            1 if on else 0)
 
     def _finish_detection(self, key, det, stop: bool = True) -> None:
         self._detections.pop(key, None)

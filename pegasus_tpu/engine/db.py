@@ -75,6 +75,15 @@ class EngineOptions:
     level_base_bytes: int = 256 << 20        # L1 budget; Ln = base * ratio^(n-1)
     level_size_ratio: int = 10
     device_cache_bytes: int = 8 << 30  # HBM budget for resident run columns
+    # device-served point reads (ISSUE 7): route get/multi_get batches
+    # through the HBM-resident lookup kernels (ops/device_lookup.py)
+    # under the read lane guard. None = on for backend=="tpu" unless
+    # PEGASUS_DEVICE_READS=0. device_read_min_batch: smallest per-SST
+    # candidate batch worth a device dispatch (below it the host binary
+    # search wins; None = PEGASUS_DEVICE_READ_MIN_BATCH, default 2, so a
+    # lone sequential get never pays kernel-dispatch latency).
+    device_reads: bool = None
+    device_read_min_batch: int = None
     # value residency: pin uniform-layout value rows in HBM alongside the
     # key columns so compaction outputs materialize on device (host gather
     # was the r3 bottleneck: 1.27s vs 0.375s merge at 10M). Off until the
@@ -124,6 +133,47 @@ class _RevBytes:
         return self.k == other.k
 
 
+class _HbmGauges:
+    """Process-wide HBM-residency accounting behind the
+    `engine.hbm.budget_bytes` / `engine.hbm.resident_bytes` /
+    `engine.hbm.resident_ssts` gauges on /metrics: each tpu-backend
+    engine (one per partition) reports its budget/usage here on every
+    prime/release, and the gauges publish the process sums — the numbers
+    the collector/scheduler items queued behind the budget need to see.
+    Leaf lock: never takes an engine lock (callers may hold theirs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._per_engine = {}  # id(engine) -> (budget, used_bytes, ssts)
+
+    def _publish_locked(self):
+        from ..runtime.perf_counters import counters
+
+        vals = list(self._per_engine.values())
+        counters.number("engine.hbm.budget_bytes").set(
+            sum(v[0] for v in vals))
+        counters.number("engine.hbm.resident_bytes").set(
+            sum(v[1] for v in vals))
+        counters.number("engine.hbm.resident_ssts").set(
+            sum(v[2] for v in vals))
+
+    def update(self, engine) -> None:
+        with self._lock:
+            self._per_engine[id(engine)] = (
+                engine.opts.device_cache_bytes,
+                engine._device_cache_used,
+                engine._device_resident_ssts)
+            self._publish_locked()
+
+    def drop(self, engine) -> None:
+        with self._lock:
+            self._per_engine.pop(id(engine), None)
+            self._publish_locked()
+
+
+HBM_GAUGES = _HbmGauges()
+
+
 def _fail(name: str):
     """FAIL_POINT_INJECT_F call-site helper: only the 'return' verb injects
     a failure; 'print' logs and continues (ADVICE r1: a print-armed point
@@ -163,6 +213,11 @@ class LsmEngine:
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
         self._compaction_lock = threading.RLock()
         self._device_cache_used = 0  # bytes of HBM pinned by resident runs
+        self._device_resident_ssts = 0  # files currently holding a run
+        # read-residency policy flag (collector hotkey loop drives it via
+        # the set-read-residency remote command): hot partitions keep
+        # their SSTs primed so point reads hit the device path
+        self._read_hot = False
         # same-SST prime coordination (see _device_run_budgeted): waiters
         # block on this until the in-flight prime finishes and notifies
         self._prime_cv = threading.Condition(self._lock)
@@ -173,8 +228,21 @@ class LsmEngine:
         self._pending_unlinks = []
         self._manifest_dirty = False
         self._resolved_mesh = _UNRESOLVED  # lazy sharded-compaction mesh
+        # device-read knobs resolved ONCE (the coalescer consults them on
+        # every point read — no per-get environ parse); the backend check
+        # stays dynamic because app-envs can flip it at runtime
+        dv = self.opts.device_reads
+        self._device_reads_flag = ((os.environ.get("PEGASUS_DEVICE_READS",
+                                                   "") != "0")
+                                   if dv is None else bool(dv))
+        mb = self.opts.device_read_min_batch
+        self._device_read_min = max(1, int(
+            os.environ.get("PEGASUS_DEVICE_READ_MIN_BATCH", "2"))
+            if mb is None else mb)
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
+        if self.opts.backend == "tpu":
+            HBM_GAUGES.update(self)  # budget visible before the first prime
 
     # ------------------------------------------------------------------ meta
 
@@ -312,26 +380,157 @@ class LsmEngine:
             if deleted or check_if_ts_expired(now, expire):
                 return None
             return value
-        for sst in sources:
-            if not sst.maybe_contains_hash(h32):
-                continue
-            i = sst.find(key)
-            if i >= 0:
-                return self._record_or_none(sst.block(), i, now)
-        for lv in sorted(levels):
-            files = levels[lv]
-            j = bisect.bisect_right([f.min_key for f in files], key) - 1
-            if j >= 0 and files[j].maybe_contains_hash(h32):
-                i = files[j].find(key)
-                if i >= 0:
-                    return self._record_or_none(files[j].block(), i, now)
-        return None
+        # the SAME recency walk get_batch's host fallback runs (one copy
+        # of the ordering/pruning rules); a lone get stays host-served —
+        # device batches enter through get_batch
+        res = self._walk_sources([key], [now], [h32], [0], sources, levels,
+                                 use_device=False)
+        return res.get(0)
 
     @staticmethod
     def _record_or_none(block: KVBlock, i: int, now: int):
         if block.deleted[i] or check_if_ts_expired(now, int(block.expire_ts[i])):
             return None
         return block.value(i)
+
+    # -------------------------------------------------- device-served reads
+
+    def _device_reads_on(self) -> bool:
+        return self.opts.backend == "tpu" and self._device_reads_flag
+
+    def set_read_residency(self, on: bool) -> None:
+        """Read-residency policy hook (the collector's hotkey loop drives
+        this through the set-read-residency remote command): a read-hot
+        partition primes every current SST into HBM — fire-and-forget on
+        the pipeline pool — and may fill its WHOLE HBM budget, where a
+        cold partition's primes stop at 7/8 of it (the reserved headroom
+        this pin claims; see _device_run_budgeted). Off only clears the
+        flag: resident runs stay (compaction still wants them) and age
+        out through the normal merge lifecycle."""
+        self._read_hot = bool(on)
+        if on and self.opts.backend == "tpu":
+            with self._lock:
+                ssts = self._all_ssts_locked()
+            for sst in ssts:
+                self._prime_async(sst)
+
+    def get_batch(self, keys, now=None) -> list:
+        """Batched point lookup, semantically identical to
+        [get(k) for k in keys] against one consistent snapshot. `now` is
+        a scalar or a per-key list (the server's read coalescer groups
+        requests that resolved their clocks independently).
+
+        Memtable/immutable hits resolve on the host; the SST walk runs
+        device-side when HBM-resident runs with indexes exist — one
+        batched probe per SST (ops/device_lookup.py) under the read lane
+        guard, whose fallback reruns the identical walk with host binary
+        search, byte-identical by construction (both return the same row
+        index into the same cached block)."""
+        if _fail("db_get"):
+            raise IOError("injected db_get failure")
+        n = len(keys)
+        if now is None:
+            now = epoch_now()
+        nows = list(now) if isinstance(now, (list, tuple)) else [now] * n
+        from ..runtime.tracing import COMPACT_TRACER
+
+        with COMPACT_TRACER.span("read.batch", records=n):
+            return self._get_batch_impl(keys, nows)
+
+    def _get_batch_impl(self, keys, nows) -> list:
+        n = len(keys)
+        out = [_UNRESOLVED] * n
+        h32s = [np.uint32(key_hash(k) & 0xFFFFFFFF) for k in keys]
+        with self._lock:
+            for i, k in enumerate(keys):
+                hit = self._mem.get(k)
+                if hit is None:
+                    for imm in self._imm:
+                        hit = imm.get(k)
+                        if hit is not None:
+                            break
+                if hit is not None:
+                    value, expire, deleted = hit
+                    out[i] = (None if deleted
+                              or check_if_ts_expired(nows[i], expire)
+                              else value)
+            sources = list(self._l0)
+            levels = {lv: list(fs) for lv, fs in self._levels.items()}
+        pending = [i for i in range(n) if out[i] is _UNRESOLVED]
+        if pending:
+            all_ssts = sources + [f for fs in levels.values() for f in fs]
+            device_ok = (self._device_reads_on()
+                         and any(s.device_index is not None
+                                 for s in all_ssts))
+
+            def walk(use_device):
+                return self._walk_sources(keys, nows, h32s, pending,
+                                          sources, levels, use_device)
+
+            if device_ok:
+                from ..runtime.lane_guard import READ_LANE_GUARD
+
+                res = READ_LANE_GUARD.run(lambda: walk(True),
+                                          lambda: walk(False), op="read")
+            else:
+                res = walk(False)
+            for i, v in res.items():
+                out[i] = v
+        return [None if v is _UNRESOLVED else v for v in out]
+
+    def _walk_sources(self, keys, nows, h32s, pending, sources, levels,
+                      use_device) -> dict:
+        """Recency-ordered SST walk for a key batch over a snapshot.
+        Pure function of the snapshot (no engine state mutated): the read
+        lane's fallback reruns it with use_device=False and must see the
+        exact same inputs. -> {key index: value | None(resolved)}."""
+        res = {}
+        pend = list(pending)
+        for sst in sources:
+            if not pend:
+                break
+            cand = [i for i in pend if sst.maybe_contains_hash(h32s[i])]
+            self._probe_sst(sst, cand, keys, nows, res, use_device)
+            pend = [i for i in pend if i not in res]
+        for lv in sorted(levels):
+            if not pend:
+                break
+            files = levels[lv]
+            mins = [f.min_key for f in files]
+            by_file = {}
+            for i in pend:
+                j = bisect.bisect_right(mins, keys[i]) - 1
+                if j >= 0 and files[j].maybe_contains_hash(h32s[i]):
+                    by_file.setdefault(j, []).append(i)
+            for j, cand in sorted(by_file.items()):
+                self._probe_sst(files[j], cand, keys, nows, res, use_device)
+            pend = [i for i in pend if i not in res]
+        return res
+
+    def _probe_sst(self, sst, cand, keys, nows, res, use_device) -> None:
+        """Resolve one SST's candidates into `res` (hits only — a found
+        tombstone/expired record resolves to None exactly like db.get).
+        Device path when the file holds an indexed resident run and the
+        candidate batch is worth a dispatch; host binary search otherwise
+        — identical row indexes either way."""
+        if not cand:
+            return
+        dr = sst.device_index if use_device else None
+        if dr is not None and len(cand) >= self._device_read_min:
+            from ..ops.device_lookup import lookup_batch
+            from ..runtime.tracing import COMPACT_TRACER
+
+            rows = lookup_batch(dr, [keys[i] for i in cand])
+            hits = [(i, int(r)) for i, r in zip(cand, rows) if r >= 0]
+            with COMPACT_TRACER.span("read.gather", records=len(hits)):
+                block = sst.block()
+                for i, row in hits:
+                    res[i] = self._record_or_none(block, row, nows[i])
+            return
+        for i in cand:
+            row = sst.find(keys[i])
+            if row >= 0:
+                res[i] = self._record_or_none(sst.block(), row, nows[i])
 
     def scan(self, start_key: bytes = b"", stop_key: bytes = None, now: int = None,
              include_deleted: bool = False, reverse: bool = False,
@@ -546,7 +745,15 @@ class LsmEngine:
                 # compaction does that
                 return cached
             with self._lock:
-                if self._device_cache_used >= self.opts.device_cache_bytes:
+                # read-residency priority: a partition NOT flagged
+                # read-hot stops priming at 7/8 of its budget, reserving
+                # headroom the hotkey loop's set-read-residency pin can
+                # claim — the flag is a real input to what stays
+                # resident, not just a stat
+                budget = self.opts.device_cache_bytes
+                if not self._read_hot:
+                    budget -= budget >> 3
+                if self._device_cache_used >= budget:
                     return cached  # a value-less cached run still serves
             old_bytes = cached.nbytes() if cached is not None else 0
             try:
@@ -570,7 +777,10 @@ class LsmEngine:
                     return None
                 if dr is not None:
                     self._device_cache_used += dr.nbytes() - old_bytes
+                    if not sst._device_budgeted:
+                        self._device_resident_ssts += 1
                     sst._device_budgeted = True
+                    HBM_GAUGES.update(self)
             return dr
         finally:
             with self._lock:
@@ -582,6 +792,8 @@ class LsmEngine:
             sst._device_retired = True
             if sst._device_run is not None and sst._device_budgeted:
                 self._device_cache_used -= sst._device_run.nbytes()
+                self._device_resident_ssts -= 1
+                HBM_GAUGES.update(self)
             sst._device_budgeted = False
             sst._device_run = None
 
@@ -1192,6 +1404,7 @@ class LsmEngine:
 
     def close(self):
         self._drain_pending_installs()
+        HBM_GAUGES.drop(self)
 
     # ------------------------------------------------------------- statistics
 
@@ -1208,6 +1421,9 @@ class LsmEngine:
                 "total_sst_records": sum(s.n for s in self._all_ssts_locked()),
                 "last_committed_decree": self._last_committed_decree,
                 "last_durable_decree": self.last_durable_decree(),
+                "device_resident_bytes": self._device_cache_used,
+                "device_resident_ssts": self._device_resident_ssts,
+                "read_hot": self._read_hot,
             }
 
 

@@ -10,7 +10,9 @@ engine write; multi_put/incr/CAS/... routed to single handlers), dynamic
 behavior driven by app-envs (update_app_envs :2406).
 """
 
+import os
 import struct
+import threading
 import time
 
 from ..base import consts, key_schema
@@ -53,6 +55,105 @@ def _hk_hash32(hash_key: bytes):
         key_schema.generate_key(hash_key, b"")) & 0xFFFFFFFF
 
 
+class _ReadSlot:
+    __slots__ = ("key", "now", "event", "value", "err", "done")
+
+    def __init__(self, key, now):
+        self.key, self.now = key, now
+        self.event = threading.Event()
+        self.value = self.err = None
+        self.done = False
+
+
+class _ReadCoalescer:
+    """Groups CONCURRENT point reads into one engine.get_batch call — the
+    read-path twin of the plog's leader/follower group commit: the first
+    arriving thread claims the drain and serves queued slots (itself
+    included) in device-batch-sized groups; threads that arrive mid-drain
+    park on their slot. A solo get is a batch of one (no linger —
+    lone-reader latency is unchanged, and db.get_batch routes a batch of
+    one to the host walk anyway via the device_read_min_batch floor);
+    under concurrency the queue forms the device batches by itself. A
+    leader serves at most MAX_LEADER_ROUNDS batches past its own result
+    (one client must never pay unbounded latency serving everyone else
+    under saturation), then relinquishes; parked slots re-check on a
+    bounded wait and self-promote, which also recovers leadership if a
+    leader thread died non-locally. Only active when the engine's device
+    reads are on — otherwise every get goes straight to engine.get."""
+
+    MAX_LEADER_ROUNDS = 4
+
+    def __init__(self, engine, max_batch: int = None):
+        self.engine = engine
+        self.max_batch = max_batch if max_batch is not None else \
+            max(1, int(os.environ.get("PEGASUS_READ_BATCH_N", "64")))
+        self._lock = threading.Lock()
+        self._queue = []
+        self._draining = False
+        # hot-path counter resolved once (PR 6's rule: the registry lock
+        # is per-lookup, and this fires on every point read)
+        self._c_batch_size = counters.percentile("read.batch.size")
+
+    def get(self, key: bytes, now: int):
+        if not self.engine._device_reads_on():
+            return self.engine.get(key, now=now)
+        slot = _ReadSlot(key, now)
+        with self._lock:
+            self._queue.append(slot)
+        while not slot.done:
+            with self._lock:
+                lead = not self._draining and bool(self._queue)
+                if lead:
+                    self._draining = True
+            if not lead:
+                # parked; the bounded wait re-checks so a relinquished
+                # (or dead) leader's leftover queue gets a new leader.
+                # A poke without a result (leader handoff) clears the
+                # event so the next park actually waits — slot.done, not
+                # the event, is the loop's truth
+                slot.event.wait(0.05)
+                if not slot.done:
+                    slot.event.clear()
+                continue
+            try:
+                rounds = 0
+                while True:
+                    with self._lock:
+                        batch = self._queue[: self.max_batch]
+                        del self._queue[: self.max_batch]
+                    if not batch:
+                        break
+                    self._serve(batch)
+                    rounds += 1
+                    if slot.done and rounds >= self.MAX_LEADER_ROUNDS:
+                        break
+            finally:
+                with self._lock:
+                    self._draining = False
+                    if self._queue:
+                        # hand the drain off promptly: wake one parked
+                        # slot so relinquished work doesn't wait out a
+                        # 50ms poll tick
+                        self._queue[0].event.set()
+        if slot.err is not None:
+            raise slot.err
+        return slot.value
+
+    def _serve(self, batch) -> None:
+        self._c_batch_size.set(len(batch))
+        try:
+            vals = self.engine.get_batch([s.key for s in batch],
+                                         now=[s.now for s in batch])
+        except Exception as e:  # noqa: BLE001 - every waiter needs the outcome
+            for s in batch:
+                s.err, s.done = e, True
+                s.event.set()
+            return
+        for s, v in zip(batch, vals):
+            s.value, s.done = v, True
+            s.event.set()
+
+
 class PegasusServer:
     """One partition's storage server (a replication_app_base storage engine,
     registered by name like the reference's string-keyed factory,
@@ -87,6 +188,10 @@ class PegasusServer:
         self._c_scan_qps = counters.rate(self._pfx + "scan_qps")
         self._c_get_latency = counters.percentile(
             self._pfx + "get_latency_us")
+        # device-served reads: concurrent on_get point reads coalesce into
+        # engine.get_batch device batches (no-op passthrough when the
+        # engine's device reads are off)
+        self._read_coalescer = _ReadCoalescer(self.engine)
         from .manual_compact_service import ManualCompactService
 
         self.manual_compact_service = ManualCompactService(self)
@@ -362,7 +467,7 @@ class PegasusServer:
         now = epoch_now() if now is None else now
         resp = msg.ReadResponse(app_id=self.app_id, partition_index=self.pidx,
                                 server=self.server)
-        raw = self.engine.get(key, now=now)
+        raw = self._read_coalescer.get(key, now)
         if raw is None:
             resp.error = Status.NOT_FOUND
         else:
@@ -419,8 +524,13 @@ class PegasusServer:
         self._c_multi_get_qps.increment()
         if req.sort_keys:
             size = 0
-            for sk in req.sort_keys:
-                raw = self.engine.get(key_schema.generate_key(req.hash_key, sk), now=now)
+            # a specified-sort_keys multi_get IS a point-read batch: one
+            # engine.get_batch over one snapshot (device-served when the
+            # SSTs are resident, host-walked otherwise)
+            raws = self.engine.get_batch(
+                [key_schema.generate_key(req.hash_key, sk)
+                 for sk in req.sort_keys], now=now)
+            for sk, raw in zip(req.sort_keys, raws):
                 if raw is not None:
                     data = b"" if req.no_value else self._schema.extract_user_data(raw)
                     resp.kvs.append(msg.KeyValue(sk, data))

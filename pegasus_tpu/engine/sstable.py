@@ -303,6 +303,20 @@ class SSTable:
         """First index with block.key(i) >= key (n if none)."""
         return self.block().lower_bound(key)
 
+    @property
+    def device_index(self):
+        """The HBM-resident read index for this file, or None when the
+        file is not device-servable: the DeviceRun primed at flush/
+        compaction time, carrying the fence-pointer index its prime built
+        as a byproduct (ops/device_lookup.py). The engine's batched read
+        path (db.get_batch) probes this instead of the host binary
+        search; a retired run (consumed by a merge) stops serving."""
+        dr = self._device_run
+        if dr is None or self._device_retired or \
+                getattr(dr, "fence", None) is None:
+            return None
+        return dr
+
     def device_run(self, prefix_u32: int, with_values: bool = False):
         """Lazily pack + upload this file's sort columns to the device and
         PIN them for its lifetime (the engine's HBM-resident run cache,

@@ -1,8 +1,11 @@
 from .packing import pack_key_prefixes, compute_suffix_ranks, DEFAULT_PREFIX_U32
 from .compact import CompactOptions, CompactResult, compact_blocks, sort_block, get_backend
+from .device_lookup import build_fence_index, lookup_batch
 from .pipeline import CompactPipeline, pipeline_depth
 
 __all__ = [
+    "build_fence_index",
+    "lookup_batch",
     "pack_key_prefixes",
     "compute_suffix_ranks",
     "DEFAULT_PREFIX_U32",

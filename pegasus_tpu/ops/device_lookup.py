@@ -1,0 +1,191 @@
+"""Batched point lookups over HBM-resident SST key columns.
+
+The compaction side of the LSM already lives on the device: flush and
+compaction prime each run's packed key columns into HBM (`DeviceRun`,
+ops/compact.py) and merge them there. This module serves the OTHER half
+of the LSM from the same resident data (CompassDB's argument, PAPERS.md:
+build the read index as a byproduct of compaction, exactly when the
+sorted key column is already on the chip): `get`/`multi_get`/`batch_get`
+point reads become one vmapped probe kernel per SST instead of a Python
+binary search per key.
+
+Two pieces:
+
+  1. A per-SST FENCE-POINTER index (`build_fence_index`), computed on
+     device from the already-resident sorted first key lane as a
+     byproduct of the flush/compaction prime (pack_run_device): every
+     `step`-th first-lane value is sampled into a small fence array.
+     A query's two searchsorted probes against the fence bound its
+     position to one `step`-sized block of the run — the CompassDB
+     perfect-hash role, filled by the structure we get for free from
+     sortedness. (A true minimal perfect hash over full keys needs a
+     host pass over the key bytes; the fence needs nothing the chip
+     does not already hold.)
+  2. A batched lookup kernel (`lookup_batch`): queries are packed into
+     the run's uint32 prefix lanes (the same packing the merge sort
+     keys use — DeviceRun runs hold the FULL key in their lanes, so
+     lane+klen equality IS full-key equality), fenced, then resolved
+     with a fixed-depth vectorized binary search. Returns each query's
+     row index in the run, or -1.
+
+The kernel returns INDICES only; the host materializes values from the
+SST's cached block exactly like the host binary search does, so the
+device path is byte-identical to `SSTable.find` by construction. Every
+batched probe runs under the read lane guard (runtime/lane_guard.py
+READ_LANE_GUARD) from engine/db.py — deadline, retry, breaker, host
+fallback — and fires the `read.device` fail point for chaos tests.
+"""
+
+import functools
+
+import numpy as np
+
+from ..runtime.fail_points import inject as _inject
+from ..runtime.perf_counters import counters
+from ..runtime.tracing import COMPACT_TRACER as _TRACE
+from .compact import _pow2ceil
+from .packing import pack_key_prefixes
+
+_FENCE_MAX = 4096     # fence entries per run (16 KiB of HBM at the cap)
+_QUERY_MIN_BUCKET = 8  # pad query batches to pow2 buckets >= this
+
+# probe totals resolved once — this path fires per coalesced batch
+_C_LOOKUPS = counters.number("read.device.lookup_count")
+_C_KEYS = counters.number("read.device.keys")
+_C_HITS = counters.number("read.device.hits")
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_fence_build(padded_len: int, fence_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(col0, n, step):
+        pos = lax.iota(jnp.int32, fence_len) * step
+        return jnp.take(col0, jnp.minimum(pos, n - 1))
+
+    return jax.jit(fn)
+
+
+def build_fence_index(dr) -> bool:
+    """Attach the fence-pointer index to a DeviceRun in place (fields
+    `fence`, `fence_step`, `fence_len`). Computed on device from the
+    resident first key lane — the compaction/flush pass calls this right
+    after the upload, so the index is a byproduct of work already done.
+    Returns False (and leaves the run index-less, i.e. host-served) on
+    any backend failure."""
+    import jax.numpy as jnp
+
+    if dr is None or dr.n == 0:
+        return False
+    fence_len = min(_FENCE_MAX, _pow2ceil(max(1, dr.n // 8), 16))
+    step = -(-dr.n // fence_len)  # ceil: fence_len * step >= n
+    try:
+        fn = _compiled_fence_build(dr.padded_len, fence_len)
+        dr.fence = fn(dr.cols[0], jnp.int32(dr.n), jnp.int32(step))
+        dr.fence_step = step
+        dr.fence_len = fence_len
+        return True
+    except Exception as e:  # noqa: BLE001 - an index-less run is just host-served
+        print(f"[device-lookup] fence build failed: {e!r}", flush=True)
+        dr.fence = None
+        return False
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_lookup(padded_len: int, w: int, fence_len: int, qpad: int):
+    """Jitted batched point lookup for one (run shape, query bucket):
+    fence probe -> fixed-depth vectorized lower_bound over the full
+    (prefix lanes, klen) sort key -> exact-equality check. Keyed on the
+    padded bucket lengths only, so a live engine's varying run/batch
+    sizes share programs (the compaction pipeline's recompile rule)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .device_sort import lex_less
+
+    steps = max(1, padded_len.bit_length())
+
+    def fn(cols, klen, fence, n, step, qcols, qklen):
+        q0 = qcols[0]
+        # fence window: rows before sample a-1 are < q0, rows from sample
+        # b on are > q0, so the full-key lower_bound lies in [lo, hi)
+        a = jnp.searchsorted(fence, q0, side="left").astype(jnp.int32)
+        b = jnp.searchsorted(fence, q0, side="right").astype(jnp.int32)
+        n1 = n - 1
+        lo = jnp.where(a > 0, jnp.minimum((a - 1) * step, n1), 0)
+        hi = jnp.where(b < fence_len, jnp.minimum(b * step, n1), n)
+        length = jnp.maximum(hi - lo, 0)
+        qkey = list(qcols) + [qklen]
+        for _ in range(steps):
+            half = length >> 1
+            mid = lo + half
+            midc = jnp.minimum(mid, padded_len - 1)
+            row = [jnp.take(cols[j], midc) for j in range(w)] \
+                + [jnp.take(klen, midc)]
+            less = lex_less(row, qkey)
+            active = length > 0
+            lo = jnp.where(active & less, mid + 1, lo)
+            length = jnp.where(active,
+                               jnp.where(less, length - half - 1, half),
+                               0)
+        safe = jnp.minimum(lo, padded_len - 1)
+        eq = lo < n
+        for j in range(w):
+            eq &= jnp.take(cols[j], safe) == qcols[j]
+        eq &= jnp.take(klen, safe) == qklen
+        return jnp.where(eq, lo, jnp.int32(-1))
+
+    return jax.jit(fn)
+
+
+def pack_queries(keys, w: int):
+    """Host-side packing of query keys into a run's lane layout:
+    -> (list of w uint32[qpad] lanes, uint32[qpad] klen), zero-padded to
+    the pow2 query bucket. A query longer than the run's 4*w-byte window
+    truncates in the lanes but keeps its true klen — it can never equal
+    a resident key (all <= 4*w bytes), so the equality check still
+    returns -1 for it, which is the correct answer."""
+    n = len(keys)
+    arena = np.frombuffer(b"".join(keys), dtype=np.uint8).copy() \
+        if n else np.zeros(0, np.uint8)
+    lens = np.fromiter((len(k) for k in keys), dtype=np.int32, count=n)
+    offs = np.zeros(n, dtype=np.int64)
+    if n:
+        np.cumsum(lens[:-1], out=offs[1:])
+    pref = pack_key_prefixes(arena, offs, lens, w)
+    qpad = _pow2ceil(max(1, n), _QUERY_MIN_BUCKET)
+    qcols = []
+    for j in range(w):
+        col = np.zeros(qpad, np.uint32)
+        col[:n] = pref[:, j]
+        qcols.append(col)
+    qklen = np.zeros(qpad, np.uint32)
+    qklen[:n] = lens
+    return qcols, qklen
+
+
+def lookup_batch(dr, keys) -> np.ndarray:
+    """Probe `keys` (list of full stored keys, any order) against one
+    HBM-resident run. -> np.int32[len(keys)]: the run row index of each
+    exact match, -1 for absent keys. Raises on device failure — the
+    caller (engine/db.py get_batch) runs this under READ_LANE_GUARD with
+    the host binary-search walk as the byte-identical fallback."""
+    import jax.numpy as jnp
+
+    if not keys or dr is None or dr.fence is None:
+        return np.full(len(keys), -1, np.int32)
+    with _TRACE.span("read.device", records=len(keys)):
+        _inject("read.device")
+        qcols, qklen = pack_queries(keys, dr.w)
+        fn = _compiled_lookup(dr.padded_len, dr.w, dr.fence_len,
+                              len(qklen))
+        out = fn(tuple(dr.cols), dr.klen, dr.fence,
+                 jnp.int32(dr.n), jnp.int32(dr.fence_step),
+                 tuple(jnp.asarray(c) for c in qcols), jnp.asarray(qklen))
+        rows = np.asarray(out)[: len(keys)]
+    _C_LOOKUPS.increment()
+    _C_KEYS.increment(len(keys))
+    _C_HITS.increment(int((rows >= 0).sum()))
+    return rows

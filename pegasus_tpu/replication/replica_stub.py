@@ -164,6 +164,8 @@ class ReplicaStub:
         self.commands.register("replica-disk", self._cmd_replica_disk)
         self.commands.register("query-compact-state", self._cmd_compact_state)
         self.commands.register("detect_hotkey", self._cmd_detect_hotkey)
+        self.commands.register("set-read-residency",
+                               self._cmd_set_read_residency)
         self.commands.register("flush-log", self._cmd_flush_log)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
@@ -766,6 +768,22 @@ class ReplicaStub:
         if rep is None:
             return f"no replica {gpid}"
         return rep.server.on_detect_hotkey(kind, action)
+
+    def _cmd_set_read_residency(self, args: list) -> str:
+        """set-read-residency <app_id.pidx> <on|off> — pin/unpin one
+        partition's SSTs HBM-resident for the device read path (the
+        collector's hotkey loop drives this from read-hot verdicts)."""
+        if len(args) < 2 or args[1] not in ("on", "off"):
+            return "usage: set-read-residency <app_id.pidx> <on|off>"
+        gpid = args[0]
+        a, _, p = gpid.partition(".")
+        with self._lock:
+            rep = self._replicas.get((int(a), int(p)))
+        if rep is None:
+            return f"no replica {gpid}"
+        on = args[1] == "on"
+        rep.server.engine.set_read_residency(on)
+        return f"read residency {'on' if on else 'off'} for {gpid}"
 
     def _cmd_flush_log(self, args: list) -> str:
         """flush-log: fsync every hosted replica's mutation log (reference

@@ -44,6 +44,18 @@ status-file heartbeat, query_compact_state, and bench's detail.lane.
 Env knobs (read once at import for the process-wide LANE_GUARD):
   PEGASUS_LANE_DEADLINE_S / PEGASUS_LANE_MAX_RETRIES /
   PEGASUS_LANE_BREAKER_THRESHOLD / PEGASUS_LANE_BREAKER_COOLDOWN_S
+
+Since ISSUE 7 there are TWO lanes sharing this policy class but nothing
+else: the compaction lane (LANE_GUARD, counters `compact.lane.*`) and the
+serving read lane (READ_LANE_GUARD, counters `read.lane.*`) guarding the
+device point-lookup path (ops/device_lookup.py via engine/db.py
+get_batch). Separate instances mean separate breakers: a wedged read
+probe routes READS to the host walk without pushing compactions off the
+device, and vice versa (test-enforced in tests/test_lane_guard.py).
+Read-lane knobs: PEGASUS_READ_LANE_DEADLINE_S (default 30 — reads are
+latency-sensitive; the host fallback is always available) /
+PEGASUS_READ_LANE_MAX_RETRIES / PEGASUS_READ_LANE_BREAKER_THRESHOLD /
+PEGASUS_READ_LANE_BREAKER_COOLDOWN_S.
 """
 
 import os
@@ -53,6 +65,42 @@ from dataclasses import dataclass
 
 from .perf_counters import counters
 from .tracing import COMPACT_TRACER
+
+
+class _LaneWorker(threading.Thread):
+    """Reusable deadline worker: the guard hands it one call at a time
+    and waits with a timeout. On timeout the caller ABANDONS it (never
+    killed — a TPU-attached thread must not be killed) and the worker
+    re-joins the guard's idle pool only after the stale call eventually
+    finishes; a truly wedged worker simply never comes back, and the
+    pool spawns a fresh one on demand. This keeps the per-call cost of a
+    guarded attempt at an Event round-trip instead of a thread spawn —
+    the read lane puts the guard on the serving hot path."""
+
+    def __init__(self, guard):
+        super().__init__(daemon=True, name=f"lane-{guard.metric_prefix}")
+        self._guard = guard
+        self._ready = threading.Event()
+        self._job = None
+
+    def submit(self, fn, box, done, sessions) -> None:
+        self._job = (fn, box, done, sessions)
+        self._ready.set()
+
+    def run(self):
+        while True:
+            self._ready.wait()
+            self._ready.clear()
+            fn, box, done, sessions = self._job
+            self._job = None
+            self._guard.tracer.adopt_sessions(sessions)
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 - crosses the thread boundary
+                box["error"] = e
+            done.set()
+            with self._guard._lock:
+                self._guard._idle_workers.append(self)
 
 
 class LaneError(RuntimeError):
@@ -86,21 +134,28 @@ class LaneGuardConfig:
     breaker_cooldown_s: float = 30.0
 
     @classmethod
-    def from_env(cls) -> "LaneGuardConfig":
+    def from_env(cls, env_prefix: str = "PEGASUS_LANE",
+                 deadline_s: float = None, max_retries: int = 2,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0) -> "LaneGuardConfig":
         return cls(
-            deadline_s=_env_float("PEGASUS_LANE_DEADLINE_S", None),
-            max_retries=_env_int("PEGASUS_LANE_MAX_RETRIES", 2),
-            breaker_threshold=_env_int("PEGASUS_LANE_BREAKER_THRESHOLD", 3),
-            breaker_cooldown_s=_env_float("PEGASUS_LANE_BREAKER_COOLDOWN_S",
-                                          30.0),
+            deadline_s=_env_float(f"{env_prefix}_DEADLINE_S", deadline_s),
+            max_retries=_env_int(f"{env_prefix}_MAX_RETRIES", max_retries),
+            breaker_threshold=_env_int(f"{env_prefix}_BREAKER_THRESHOLD",
+                                       breaker_threshold),
+            breaker_cooldown_s=_env_float(f"{env_prefix}_BREAKER_COOLDOWN_S",
+                                          breaker_cooldown_s),
         )
 
 
 class LaneGuard:
     def __init__(self, config: LaneGuardConfig = None, tracer=COMPACT_TRACER,
-                 probe_fn=None):
+                 probe_fn=None, metric_prefix: str = "compact.lane"):
         self.config = config or LaneGuardConfig()
         self.tracer = tracer
+        # counter namespace: "compact.lane" for the compaction lane,
+        # "read.lane" for the serving read lane (see module docstring)
+        self.metric_prefix = metric_prefix
         # injectable half-open probe (tests); default = the watchdog's
         # liveness round-trip, lazily bound to avoid a runtime->ops import
         # at module load
@@ -110,6 +165,7 @@ class LaneGuard:
         # probe timeout against a possibly-wedged device; concurrent
         # callers keep routing to cpu meanwhile
         self._half_open_lock = threading.Lock()
+        self._idle_workers = []  # reusable deadline workers (LIFO)
         self.fallback_count = 0
         self.retry_count = 0
         self.deadline_abandon_count = 0
@@ -176,7 +232,7 @@ class LaneGuard:
                 with self._lock:
                     self._consec_failures = 0
                     self._breaker_open_until = 0.0
-                counters.number("compact.lane.breaker_open").set(0)
+                counters.number(self.metric_prefix + ".breaker_open").set(0)
                 return False
             with self._lock:
                 self._breaker_open_until = (time.monotonic()
@@ -207,8 +263,8 @@ class LaneGuard:
                     self._breaker_open_until = (
                         time.monotonic() + self.config.breaker_cooldown_s)
         if tripped:
-            counters.rate("compact.lane.breaker_trip_count").increment()
-            counters.number("compact.lane.breaker_open").set(1)
+            counters.rate(self.metric_prefix + ".breaker_trip_count").increment()
+            counters.number(self.metric_prefix + ".breaker_open").set(1)
 
     def record_device_ok(self) -> None:
         with self._lock:
@@ -216,7 +272,7 @@ class LaneGuard:
             self._consec_failures = 0
             self._breaker_open_until = 0.0
         if was_open:
-            counters.number("compact.lane.breaker_open").set(0)
+            counters.number(self.metric_prefix + ".breaker_open").set(0)
 
     # ----------------------------------------------------------------- run
 
@@ -245,7 +301,7 @@ class LaneGuard:
                 if attempt + 1 < attempts:
                     with self._lock:
                         self.retry_count += 1
-                    counters.rate("compact.lane.retry_count").increment()
+                    counters.rate(self.metric_prefix + ".retry_count").increment()
                     time.sleep(min(delay, self.config.backoff_max_s))
                     delay *= 2
                     continue
@@ -268,26 +324,25 @@ class LaneGuard:
         if not deadline_s or deadline_s <= 0:
             return fn()
         box = {}
+        done = threading.Event()
         sessions = self.tracer.propagate_sessions()
-
-        def work():
-            self.tracer.adopt_sessions(sessions)
-            try:
-                box["result"] = fn()
-            except BaseException as e:  # noqa: BLE001 - crosses the thread boundary
-                box["error"] = e
-
-        t = threading.Thread(target=work, daemon=True, name=f"lane-{op}")
-        t.start()
-        t.join(deadline_s)
-        if t.is_alive():
+        with self._lock:
+            t = self._idle_workers.pop() if self._idle_workers else None
+        if t is None:
+            t = _LaneWorker(self)
+            t.start()
+        t.submit(fn, box, done, sessions)
+        if not done.wait(deadline_s):
             # abandoned in its thread, never killed; its span stays open so
             # the watchdog keeps attributing the wedge after we move on
+            # (the worker rejoins the pool only if the stale call ever
+            # finishes — a wedged one never comes back)
             stages = self.tracer.open_stages().get(t.ident)
             stage = stages[-1] if stages else "unknown"
             with self._lock:
                 self.deadline_abandon_count += 1
-            counters.rate("compact.lane.deadline_abandon_count").increment()
+            counters.rate(
+                self.metric_prefix + ".deadline_abandon_count").increment()
             err = LaneDeadlineExceeded(
                 f"{op}: device call exceeded {deadline_s:.1f}s deadline "
                 f"(wedged at stage {stage}); worker abandoned")
@@ -302,9 +357,9 @@ class LaneGuard:
             self.fallback_count += 1
             self.last_fallback = {"op": op, "reason": reason,
                                   "ts": time.time()}
-        counters.rate("compact.lane.fallback_count").increment()
-        print(f"[lane-guard] {op}: falling back to cpu backend ({reason})",
-              flush=True)
+        counters.rate(self.metric_prefix + ".fallback_count").increment()
+        print(f"[lane-guard:{self.metric_prefix}] {op}: falling back to the "
+              f"host path ({reason})", flush=True)
         return fallback_fn()
 
     # --------------------------------------------------------------- state
@@ -335,9 +390,38 @@ class LaneGuard:
             self.device_failure_count = self._consec_failures = 0
             self._breaker_open_until = 0.0
             self.last_failure = self.last_fallback = None
-        counters.number("compact.lane.breaker_open").set(0)
+        counters.number(self.metric_prefix + ".breaker_open").set(0)
 
+
+def _warm_lane_counters() -> None:
+    """Pre-register both lanes' counter sets with literal names (the
+    guard instances increment through their metric prefix): /metrics
+    shows zeros before the first incident, and tools/check_metric_names
+    can tie each README row to a registration."""
+    counters.rate("compact.lane.fallback_count")
+    counters.rate("compact.lane.retry_count")
+    counters.rate("compact.lane.deadline_abandon_count")
+    counters.rate("compact.lane.breaker_trip_count")
+    counters.number("compact.lane.breaker_open")
+    counters.rate("read.lane.fallback_count")
+    counters.rate("read.lane.retry_count")
+    counters.rate("read.lane.deadline_abandon_count")
+    counters.rate("read.lane.breaker_trip_count")
+    counters.number("read.lane.breaker_open")
+
+
+_warm_lane_counters()
 
 # process-wide instance: every device-backed merge in this process shares
 # one breaker (one device/tunnel per process is the deployment shape)
 LANE_GUARD = LaneGuard(LaneGuardConfig.from_env())
+
+# the serving read lane (device point lookups, ops/device_lookup.py via
+# engine/db.py get_batch): its OWN breaker/totals so a wedged read probe
+# degrades reads to the host walk without routing compactions off the
+# device (and a compaction wedge doesn't blind the read path). The default
+# 30 s deadline undercuts the compact lane's 120 s floor: reads are
+# latency-sensitive and the byte-identical host walk is always ready.
+READ_LANE_GUARD = LaneGuard(
+    LaneGuardConfig.from_env("PEGASUS_READ_LANE", deadline_s=30.0),
+    metric_prefix="read.lane")

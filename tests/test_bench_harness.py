@@ -250,6 +250,40 @@ def test_ycsb_mode_smoke():
     assert not _python_procs(), "ycsb mode left processes behind"
 
 
+def test_ycsb_read_heavy_mix_smoke():
+    """PEGASUS_BENCH_YCSB_MIX=c: the read-heavy device-read A/B variant
+    (ISSUE 7) — the metric names the mix, and detail.reads carries the
+    device probe totals, the read-lane state, and the fallback-free
+    verdict (device_numbers_degraded) so a degraded read lane can never
+    pass its numbers off as clean device throughput."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PEGASUS_BENCH_MODE": "ycsb",
+        "PEGASUS_BENCH_YCSB_MIX": "c",
+        "PEGASUS_BENCH_YCSB_RECORDS": "200",
+        "PEGASUS_BENCH_YCSB_OPS": "400",
+        "PEGASUS_BENCH_YCSB_THREADS": "4",
+        "PEGASUS_BENCH_YCSB_PARTITIONS": "4",
+        "PEGASUS_BENCH_TIMEOUT_S": "150",
+    })
+    proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, timeout=170, env=env, cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert proc.returncode == 0 and len(lines) == 1, \
+        f"rc={proc.returncode} out={proc.stdout[-300:]} err={proc.stderr[-500:]}"
+    line = json.loads(lines[0])
+    assert line["metric"].startswith("YCSB-C 100/0")
+    assert line["value"] and line["value"] > 0
+    reads = line["detail"]["reads"]
+    assert reads["mix"] == "c" and reads["read_fraction"] == 1.0
+    assert set(reads["device"]) == {"lookup_count", "keys", "hits"}
+    assert "fallbacks" in reads["lane"]
+    # cpu-backend onebox: the read lane never engaged, so the device
+    # numbers are clean (zero) — NOT degraded
+    assert reads["device_numbers_degraded"] is False
+
+
 @pytest.mark.slow
 def test_ycsb_group_sweep_scaling():
     """The partition-group scaling artifact (BENCH_r06-ready): the sweep

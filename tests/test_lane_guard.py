@@ -465,6 +465,126 @@ def test_failed_manual_compact_is_not_deduped_as_finished(guard, tmp_path):
         s.close()
 
 
+# ------------------------------------------------------- the read lane
+
+
+@pytest.fixture
+def read_guard():
+    from pegasus_tpu.runtime.lane_guard import READ_LANE_GUARD
+
+    saved = READ_LANE_GUARD.config
+    READ_LANE_GUARD.config = LaneGuardConfig(
+        deadline_s=30.0, max_retries=1, backoff_base_s=0.001,
+        backoff_max_s=0.002, breaker_threshold=2, breaker_cooldown_s=60.0)
+    READ_LANE_GUARD.probe_fn = lambda: True
+    READ_LANE_GUARD.reset()
+    fp.setup()
+    yield READ_LANE_GUARD
+    fp.teardown()
+    READ_LANE_GUARD.config = saved
+    READ_LANE_GUARD.probe_fn = None
+    READ_LANE_GUARD.reset()
+
+
+def _read_engine(tmp_path):
+    from pegasus_tpu.base import key_schema
+    from pegasus_tpu.engine.db import EngineOptions, LsmEngine
+
+    eng = LsmEngine(str(tmp_path / "rdb"), EngineOptions(
+        backend="tpu", device_reads=True, device_read_min_batch=1,
+        l0_compaction_trigger=100))
+    for i in range(30):
+        eng.put(key_schema.generate_key(b"h", b"s%03d" % i),
+                b"\x82" + b"\0" * 12 + b"v%d" % i)
+    eng.flush()
+    with eng._lock:
+        ssts = eng._all_ssts_locked()
+    for s in ssts:
+        eng._device_run_budgeted(s)
+    keys = [key_schema.generate_key(b"h", b"s%03d" % i) for i in range(32)]
+    return eng, keys
+
+
+def test_wedged_device_read_abandons_and_serves_host_byte_equal(
+        guard, read_guard, tmp_path):
+    """Satellite chaos: a wedged device read is deadline-abandoned and
+    the host fallback serves the identical answers — within the read
+    deadline, not the wedge's duration."""
+    import time
+
+    read_guard.config.deadline_s = 0.25
+    eng, keys = _read_engine(tmp_path)
+    try:
+        want = [eng.get(k, now=100) for k in keys]
+        fp.cfg("read.device", "1*sleep(1500)")
+        t0 = time.perf_counter()
+        got = eng.get_batch(keys, now=100)
+        elapsed = time.perf_counter() - t0
+        assert got == want
+        st = read_guard.state()
+        assert st["deadline_abandons"] == 1
+        assert st["fallbacks"] == 1
+        assert st["retries"] == 0  # a wedge must NOT retry
+        assert elapsed < 1.2, elapsed
+    finally:
+        eng.close()
+
+
+def test_read_breaker_trips_without_opening_compact_lane(
+        guard, read_guard, tmp_path):
+    """Satellite: the read lane's breaker is ITS OWN — tripping it routes
+    reads to the host walk while the compact lane stays closed and
+    device compaction keeps running (and its counters stay untouched)."""
+    eng, keys = _read_engine(tmp_path)
+    try:
+        fp.cfg("read.device", "raise(probe hard down)")
+        # one guarded read batch = 2 attempts = threshold 2: breaker trips
+        want = [eng.get(k, now=100) for k in keys]
+        assert eng.get_batch(keys, now=100) == want
+        st = read_guard.state()
+        assert st["breaker_open"] and st["breaker_trips"] == 1
+        assert counters.number("read.lane.breaker_open").value() == 1
+        # breaker open: reads route straight to host, device NOT probed
+        failures = st["device_failures"]
+        assert eng.get_batch(keys, now=100) == want
+        assert read_guard.state()["device_failures"] == failures
+        # the COMPACT lane is untouched: breaker closed, no fallbacks,
+        # and a device compaction still runs clean
+        cst = guard.state()
+        assert not cst["breaker_open"]
+        assert cst["fallbacks"] == 0 and cst["device_failures"] == 0
+        runs = _runs(seed=23)
+        got = compact_blocks(runs, CompactOptions(
+            backend="tpu", now=100, bottommost=True))
+        want_c = compact_blocks(runs, CompactOptions(
+            backend="cpu", now=100, bottommost=True))
+        _assert_byte_equal(want_c.block, got.block)
+        assert guard.state()["fallbacks"] == 0
+    finally:
+        eng.close()
+
+
+def test_compact_breaker_does_not_block_device_reads(
+        guard, read_guard, tmp_path):
+    """The mirror isolation: a tripped COMPACT breaker must not push
+    reads off already-resident runs (the read lane judges the device
+    independently). Primes ride the compact lane's breaker, so residency
+    is established BEFORE the trip — exactly the production shape: the
+    data is on the chip, compactions degrade, reads keep serving."""
+    eng, keys = _read_engine(tmp_path)
+    guard.record_device_failure("compact", "down")
+    guard.record_device_failure("compact", "down")  # threshold 2: open
+    assert guard.state()["breaker_open"]
+    try:
+        before = counters.number("read.device.lookup_count").value()
+        want = [eng.get(k, now=100) for k in keys]
+        assert eng.get_batch(keys, now=100) == want
+        assert counters.number("read.device.lookup_count").value() > before
+        assert read_guard.state()["fallbacks"] == 0
+    finally:
+        eng.close()
+
+
 # ------------------------------------------------------------- CI wiring
 
 

@@ -226,6 +226,11 @@ class _FakeHotkeyNode:
         self.calls = []
 
     def remote_command(self, addr, command, args):
+        if command == "set-read-residency":
+            # a read verdict drives the partition's device read residency
+            # (PR 7); recorded like every other call
+            self.calls.append((addr, (command,) + tuple(args)))
+            return f"read residency {args[1]} for {args[0]}"
         assert command == "detect_hotkey"
         self.calls.append((addr, tuple(args)))
         action = args[2]
@@ -266,10 +271,16 @@ def test_hotkey_loop_state_machine():
     assert snap["collector.app.happ.hotkey.3.hot"] == 1
     assert snap["collector.app.happ.hotkey.active_detections"] == 0
     assert snap["collector.app.happ.hotkey.found_count"] > 0
+    # the read verdict drove the partition's device read residency on
+    assert ("node-a:34801", ("set-read-residency", "9.3", "on")) in fake.calls
+    assert ("happ", 3) in coll.read_residency
     # the partition calms: the verdict gauge must clear, not page forever
+    # — and the residency pin is released with it
     coll.drive_hotkey_loop("happ", 9, [], primaries)
     snap = counters.snapshot(prefix="collector.app.happ.hotkey.")
     assert snap["collector.app.happ.hotkey.3.hot"] == 0
+    assert ("node-a:34801", ("set-read-residency", "9.3", "off")) in fake.calls
+    assert ("happ", 3) not in coll.read_residency
     coll.stop()
 
 
