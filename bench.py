@@ -631,10 +631,13 @@ def _max_quantiles(dicts):
 
 
 def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
-                       read_frac: float = 0.5):
+                       read_frac: float = 0.5, during=None):
     """Shared YCSB workload driver: load `records`, run the read/update
     mix (`read_frac` reads) from `n_threads` clients. -> stats dict (the
-    sweep mode reruns this once per group count)."""
+    sweep mode reruns this once per group count). `during`, when given,
+    runs on its own thread WHILE the workers hammer the cluster (the
+    consistency audit rides here: digests must match under concurrent
+    load, not just at rest); its return value lands in stats["during"]."""
     import threading
 
     from pegasus_tpu.client import MetaResolver, PegasusClient
@@ -676,11 +679,24 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
     t0 = time.perf_counter()
     for t in threads:
         t.start()
+    during_box = [None]
+    during_thread = None
+    if during is not None:
+        def _run_during():
+            try:
+                during_box[0] = during()
+            except Exception as e:  # noqa: BLE001 - report, don't crash
+                during_box[0] = {"error": repr(e)}
+        during_thread = threading.Thread(target=_run_during)
+        during_thread.start()
     for t in threads:
         t.join()
     run_s = time.perf_counter() - t0
+    if during_thread is not None:
+        during_thread.join()
     done_ops = n_threads * (n_ops // n_threads)
     return {
+        "during": during_box[0],
         "ops_s": round(done_ops / run_s, 1),
         "run_s": round(run_s, 2),
         "load_s": round(load_s, 2),
@@ -789,8 +805,36 @@ def ycsb_main():
     box = Onebox("ycsb", partitions=partitions)
     try:
         value = os.urandom(value_size)
+
+        def audit_under_load():
+            """Decree-anchored consistency audit WHILE the workload runs
+            (ISSUE 8 acceptance): every replica must digest identical
+            state at identical decrees under concurrent YCSB traffic. A
+            mismatch fails the whole bench run — a throughput number from
+            a cluster serving divergent replicas is worthless."""
+            from pegasus_tpu.collector.cluster_doctor import \
+                run_cluster_audit
+
+            return run_cluster_audit([box.meta_addr], apps=["ycsb"],
+                                     wait_s=20.0)
+
         stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
-                                   read_frac=read_frac)
+                                   read_frac=read_frac,
+                                   during=audit_under_load)
+        audit = stats.pop("during") or {}
+        audit.pop("digests", None)  # per-node digests: bulky, summarized
+        # zero mismatches is only a PASS when the audit actually compared
+        # every partition — an errored or inconclusive audit must not
+        # pose as validation (the mismatch gate below stays the only
+        # run-failing condition, per the acceptance criterion)
+        audit["conclusive"] = (not audit.get("error")
+                               and audit.get("partitions", 0) > 0
+                               and len(audit.get("ok", []))
+                               == audit.get("partitions"))
+        if not audit["conclusive"]:
+            print(f"ycsb: consistency audit INCONCLUSIVE — zero "
+                  f"mismatches is vacuous here: {audit}",
+                  file=sys.stderr, flush=True)
 
         # ---- attribution: server-side latency percentiles per op class
         # (max across partitions, the collector's merge rule), the plog
@@ -856,12 +900,21 @@ def ycsb_main():
                 "threads": n_threads,
                 "records": records,
                 "reads": reads_detail,
+                "audit": audit,
                 "cpu_process_s": round(time.process_time() - proc_t0, 3),
                 "host": {"start": host_start, "end": _host_info()},
             },
         }
     finally:
         box.stop()
+    if audit.get("mismatches"):
+        # a digest mismatch under load is a CORRECTNESS failure: the
+        # throughput number must not stand
+        _emit(_ycsb_degraded(
+            f"consistency audit FAILED: {len(audit['mismatches'])} digest "
+            f"mismatch(es) — {audit['mismatches']}",
+            detail=result["detail"]))
+        return
     _emit(result)
 
 

@@ -1,0 +1,465 @@
+"""Cluster doctor: fold everything the cluster exports into ONE verdict.
+
+ISSUE 8's conclusion layer. PR 1-7 made the system export everything —
+stage spans, request traces, the slow-request ledger, per-partition
+inflight/backlog, lane/breaker state, HBM residency — but nothing
+*concluded* anything from it. This module holds the two consumers:
+
+- ``run_cluster_audit``: the decree-anchored consistency audit driver.
+  For every partition it fires the ``trigger-audit`` remote command on
+  the primary (a no-op mutation riding the normal PacificA prepare path,
+  so primary and every secondary compute an order-independent engine
+  digest at the SAME applied decree), then collects each secondary's
+  digest via ``query-audit`` and compares AT EQUAL DECREES ONLY. A node
+  that cannot report (dead, reconfiguring, never applied) degrades that
+  partition to *inconclusive* — never a false mismatch.
+
+- ``run_cluster_doctor``: one structured verdict
+  (``healthy | degraded | critical | inconclusive``) with named causes
+  and evidence pointers, folded from the meta's one-RPC cluster-state
+  snapshot (liveness + partition configs + beacon-folded lag/audit
+  states) plus per-node scrapes (lane/breaker state, dispatch queue
+  depth) and the cluster-wide slow-request rollup. Served as
+  ``GET /health/cluster``, the ``cluster-doctor`` remote command on the
+  collector, and the shell's ``cluster_doctor``.
+
+Both are pure functions over RPC surfaces: the collector app, the shell,
+``bench.py`` and ``tools/pressure_test.py`` all call the same code.
+"""
+
+import json
+import os
+import time
+
+from ..meta import messages as mm
+from ..meta.meta_server import RPC_CM_QUERY_CLUSTER_STATE
+from ..rpc import codec
+from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime.perf_counters import counters
+from ..runtime.remote_command import (RemoteCommandRequest,
+                                      RemoteCommandResponse)
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+CRITICAL = "critical"
+INCONCLUSIVE = "inconclusive"
+_VERDICT_GAUGE = {HEALTHY: 0, DEGRADED: 1, CRITICAL: 2, INCONCLUSIVE: -1}
+
+
+class ClusterCaller:
+    """Thin RPC helper the audit and the doctor share: meta cluster-state
+    query + remote commands against nodes. Pass an existing pool (the
+    collector's) or let it own one (shell / tools one-shots)."""
+
+    def __init__(self, meta_addrs, pool: ConnectionPool = None,
+                 timeout: float = 5.0):
+        self.meta_addrs = list(meta_addrs)
+        self._own_pool = pool is None
+        self.pool = pool or ConnectionPool()
+        self.timeout = timeout
+
+    def close(self):
+        if self._own_pool:
+            self.pool.close()
+
+    def _call(self, addr: str, code: str, body: bytes) -> bytes:
+        host, _, port = addr.rpartition(":")
+        conn = self.pool.get((host, int(port)))
+        _, out = conn.call(code, body, timeout=self.timeout)
+        return out
+
+    def meta_state(self):
+        """The meta's cluster-state snapshot, or None when no meta
+        answers (the doctor then reports inconclusive, not healthy)."""
+        body = codec.encode(mm.QueryClusterStateRequest())
+        for m in self.meta_addrs:
+            try:
+                resp = codec.decode(mm.QueryClusterStateResponse,
+                                    self._call(m, RPC_CM_QUERY_CLUSTER_STATE,
+                                               body))
+                return json.loads(resp.state_json)
+            except (RpcError, OSError, ValueError):
+                continue
+        return None
+
+    def remote_command(self, addr: str, command: str, args) -> str:
+        body = self._call(addr, "RPC_CLI_CLI_CALL", codec.encode(
+            RemoteCommandRequest(command, list(args))))
+        return codec.decode(RemoteCommandResponse, body).output
+
+
+# =========================================================== audit driver
+
+
+def run_cluster_audit(meta_addrs, pool: ConnectionPool = None,
+                      apps: list = None, wait_s: float = 5.0,
+                      caller: ClusterCaller = None) -> dict:
+    """Trigger + verify a decree-anchored consistency audit across every
+    partition of every (or the named) app. -> report dict:
+
+    ``{"partitions": N, "ok": [gpid...], "mismatches": [{app, app_id,
+    pidx, gpid, node, decree, digest, expected}...], "inconclusive":
+    [{gpid, node?, reason}...], "digests": {gpid: {node: {decree,
+    digest}}}}``
+
+    Zero mismatches with every partition in ``ok`` means every replica
+    held byte-equivalent logical state at the same applied decree —
+    the pass criterion the production-sim scenario builds on."""
+    own = caller is None
+    caller = caller or ClusterCaller(meta_addrs, pool=pool)
+    report = {"partitions": 0, "ok": [], "mismatches": [],
+              "inconclusive": [], "digests": {}}
+    try:
+        state = caller.meta_state()
+        if state is None:
+            report["inconclusive"].append(
+                {"gpid": "*", "reason": "no meta reachable"})
+            return report
+        for app_name, app in sorted(state.get("apps", {}).items()):
+            if apps and app_name not in apps:
+                continue
+            for pc in app.get("partitions", []):
+                report["partitions"] += 1
+                _audit_partition(caller, report, app_name, app["app_id"],
+                                 pc, wait_s)
+    finally:
+        if own:
+            caller.close()
+    return report
+
+
+def _audit_partition(caller, report, app_name, app_id, pc, wait_s):
+    gpid = f"{app_id}.{pc['pidx']}"
+    if not pc.get("primary"):
+        report["inconclusive"].append(
+            {"gpid": gpid, "reason": "no primary assigned"})
+        return
+    try:
+        out = caller.remote_command(pc["primary"], "trigger-audit", [gpid])
+    except (RpcError, OSError) as e:
+        report["inconclusive"].append(
+            {"gpid": gpid, "node": pc["primary"],
+             "reason": f"primary unreachable: {e}"})
+        return
+    try:
+        primary_audit = json.loads(out) if out else {}
+    except ValueError:
+        primary_audit = {}
+    if not primary_audit or primary_audit.get("error"):
+        report["inconclusive"].append(
+            {"gpid": gpid, "node": pc["primary"],
+             "reason": primary_audit.get("error", "no trigger-audit reply")})
+        return
+    decree = primary_audit["decree"]
+    expected = primary_audit["digest"]
+    digests = {pc["primary"]: {"decree": decree, "digest": expected}}
+    report["digests"][gpid] = digests
+    clean = True
+    for node in pc.get("secondaries", []):
+        got = _poll_secondary_audit(caller, node, gpid, decree, wait_s)
+        if got is None:
+            report["inconclusive"].append(
+                {"gpid": gpid, "node": node,
+                 "reason": f"no digest at decree {decree} within "
+                           f"{wait_s:.1f}s (dead / reconfiguring / "
+                           "superseded)"})
+            clean = False
+            continue
+        digests[node] = got
+        if got["digest"] != expected:
+            report["mismatches"].append(
+                {"app": app_name, "app_id": app_id, "pidx": pc["pidx"],
+                 "gpid": gpid, "node": node, "decree": decree,
+                 "digest": got["digest"], "expected": expected})
+            clean = False
+    if clean:
+        report["ok"].append(gpid)
+
+
+def _poll_secondary_audit(caller, node, gpid, decree, wait_s):
+    """-> {"decree", "digest"} once the node reports an audit AT `decree`,
+    or None on timeout/unreachable/superseded. Comparing at EQUAL decrees
+    only is what makes a group kill degrade to inconclusive instead of a
+    false mismatch."""
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            out = caller.remote_command(node, "query-audit", [gpid])
+            ent = json.loads(out).get(gpid, {})
+            audit = ent.get("audit")
+            if audit and audit.get("decree", 0) >= decree:
+                if audit["decree"] != decree:
+                    return None  # superseded by a newer audit: inconclusive
+                if not audit.get("digest"):
+                    return None  # digest computation failed: inconclusive
+                return {"decree": audit["decree"],
+                        "digest": audit["digest"]}
+        except (RpcError, OSError, ValueError):
+            pass
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.05)
+
+
+# ================================================================ doctor
+
+
+def _gap_threshold() -> int:
+    return int(os.environ.get("PEGASUS_DOCTOR_GAP_DEGRADED", "128"))
+
+
+def _queue_threshold() -> int:
+    return int(os.environ.get("PEGASUS_DOCTOR_QUEUE_DEGRADED", "64"))
+
+
+def run_cluster_doctor(meta_addrs, pool: ConnectionPool = None,
+                       scrape: bool = True, slow_last: int = 10,
+                       caller: ClusterCaller = None) -> dict:
+    """ONE structured health verdict for the whole cluster.
+
+    -> ``{"verdict": healthy|degraded|critical|inconclusive,
+          "causes": [{"severity", "cause", "evidence"}...],
+          "evidence": {nodes, partitions, lag, audit, scrapes,
+                       slow_requests}, "ts": unix_seconds}``
+
+    Severity folding: any critical cause -> ``critical``; else any
+    degraded cause -> ``degraded``; else ``healthy``. A cluster whose
+    state cannot be read at all (no meta) is ``inconclusive``. Audit
+    evidence can only come from digests at EQUAL decrees; members that
+    have not reported yet are listed under ``evidence.audit.pending``
+    and never count as mismatches."""
+    own = caller is None
+    caller = caller or ClusterCaller(meta_addrs, pool=pool)
+    causes, evidence = [], {}
+    try:
+        state = caller.meta_state()
+        if state is None:
+            verdict = {"verdict": INCONCLUSIVE,
+                       "causes": [{"severity": INCONCLUSIVE,
+                                   "cause": "no meta server reachable",
+                                   "evidence": "meta"}],
+                       "evidence": {"meta_addrs": list(meta_addrs)},
+                       "ts": time.time()}
+            _export_verdict(verdict)
+            return verdict
+        _check_nodes(state, causes, evidence)
+        _check_partitions(state, causes, evidence)
+        _check_lag(state, causes, evidence)
+        _check_audit(state, causes, evidence)
+        if scrape:
+            _scrape_nodes(caller, state, causes, evidence, slow_last)
+    finally:
+        if own:
+            caller.close()
+    verdict = CRITICAL if any(c["severity"] == CRITICAL for c in causes) \
+        else DEGRADED if causes else HEALTHY
+    out = {"verdict": verdict, "causes": causes, "evidence": evidence,
+           "ts": time.time()}
+    _export_verdict(out)
+    return out
+
+
+def _export_verdict(out: dict) -> None:
+    counters.rate("doctor.run_count").increment()
+    counters.number("doctor.verdict").set(_VERDICT_GAUGE[out["verdict"]])
+
+
+def _check_nodes(state, causes, evidence) -> None:
+    nodes = state.get("nodes", {})
+    dead = sorted(a for a, n in nodes.items() if not n["alive"])
+    evidence["nodes"] = {"total": len(nodes), "dead": dead}
+    for addr in dead:
+        causes.append({"severity": DEGRADED,
+                       "cause": f"node {addr} dead "
+                                f"(last beacon "
+                                f"{nodes[addr]['last_beacon_ago_s']:.0f}s "
+                                "ago)",
+                       "evidence": "nodes.dead"})
+
+
+def _check_partitions(state, causes, evidence) -> None:
+    nodes = state.get("nodes", {})
+    alive = {a for a, n in nodes.items() if n["alive"]}
+    unserved, under = [], []
+    for app_name, app in state.get("apps", {}).items():
+        want = app.get("replica_count", 0)
+        for pc in app.get("partitions", []):
+            gpid = f"{app['app_id']}.{pc['pidx']}"
+            members = [m for m in [pc.get("primary")]
+                       + pc.get("secondaries", []) if m]
+            live = [m for m in members if m in alive]
+            if not pc.get("primary") or pc["primary"] not in alive:
+                unserved.append({"app": app_name, "gpid": gpid,
+                                 "primary": pc.get("primary", "")})
+            elif want and len(live) < want:
+                under.append({"app": app_name, "gpid": gpid,
+                              "live": len(live), "want": want})
+    evidence["partitions"] = {"unserved": unserved,
+                              "under_replicated": under}
+    for u in unserved:
+        causes.append({"severity": CRITICAL,
+                       "cause": f"partition {u['app']}.{u['gpid']} has no "
+                                "live primary — writes are down",
+                       "evidence": "partitions.unserved"})
+    for u in under:
+        causes.append({"severity": DEGRADED,
+                       "cause": f"partition {u['app']}.{u['gpid']} "
+                                f"under-replicated ({u['live']}/{u['want']})",
+                       "evidence": "partitions.under_replicated"})
+
+
+def _check_lag(state, causes, evidence) -> None:
+    """Replication-lag plane over the beacon-folded per-replica states:
+    commit lag and apply lag are distinct causes, and BOTH are measured
+    within one replica's own snapshot — commit lag as prepared-committed
+    (decrees the replica staged but whose commit point never reached
+    it), apply lag as committed-applied (the engine behind replication).
+    Cross-node frontier compares are deliberately NOT used as causes:
+    beacons are asynchronous per node, so two nodes' committed counters
+    are sampled at different instants and any healthy cluster writing
+    faster than the beacon interval would read as degraded. (Behind on
+    PREPARE is the primary's secondary_gap_max gauge, measured at one
+    instant by the primary itself.)"""
+    nodes = state.get("nodes", {})
+    per_gpid = {}
+    for node, states in state.get("replica_states", {}).items():
+        # a dead node's states are frozen at its last beacon: folding
+        # them would report ever-growing lag forever — its death is
+        # already a cause of its own (_check_nodes)
+        if not nodes.get(node, {}).get("alive", True):
+            continue
+        for gpid, st in states.items():
+            per_gpid.setdefault(gpid, {})[node] = st
+    thr = _gap_threshold()
+    worst = {"commit_gap": 0, "apply_gap": 0}
+    offenders = []
+    for gpid, members in per_gpid.items():
+        for node, st in members.items():
+            commit_gap = st.get("prepared", 0) - st.get("committed", 0)
+            apply_gap = st.get("committed", 0) - st.get("applied", 0)
+            worst["commit_gap"] = max(worst["commit_gap"], commit_gap)
+            worst["apply_gap"] = max(worst["apply_gap"], apply_gap)
+            if commit_gap >= thr:
+                offenders.append({"gpid": gpid, "node": node,
+                                  "kind": "commit", "gap": commit_gap})
+                causes.append({"severity": DEGRADED,
+                               "cause": f"replica {gpid}@{node} behind on "
+                                        f"COMMIT by {commit_gap} decrees "
+                                        "(staged but uncommitted)",
+                               "evidence": "lag.offenders"})
+            if apply_gap >= thr:
+                offenders.append({"gpid": gpid, "node": node,
+                                  "kind": "apply", "gap": apply_gap})
+                causes.append({"severity": DEGRADED,
+                               "cause": f"replica {gpid}@{node} behind on "
+                                        f"APPLY by {apply_gap} decrees",
+                               "evidence": "lag.offenders"})
+    evidence["lag"] = {"worst": worst, "offenders": offenders,
+                       "threshold": thr}
+
+
+def _check_audit(state, causes, evidence) -> None:
+    """Compare beacon-reported digests per partition, at EQUAL decrees
+    only. The reference digest is the primary's when it reported at that
+    decree, else the majority value; every disagreeing node is named."""
+    primaries = {}
+    for app in state.get("apps", {}).values():
+        for pc in app.get("partitions", []):
+            primaries[f"{app['app_id']}.{pc['pidx']}"] = pc.get("primary")
+    nodes = state.get("nodes", {})
+    per_gpid = {}
+    for node, states in state.get("replica_states", {}).items():
+        if not nodes.get(node, {}).get("alive", True):
+            continue  # frozen states of a dead node (see _check_lag)
+        for gpid, st in states.items():
+            # a failed digest computation (empty digest / error) is not
+            # comparable evidence — it must read as pending, never as a
+            # mismatch
+            if st.get("audit", {}).get("digest"):
+                per_gpid.setdefault(gpid, {})[node] = st["audit"]
+    mismatches, pending, checked = [], [], []
+    for gpid, audits in sorted(per_gpid.items()):
+        latest = max(a["decree"] for a in audits.values())
+        at = {n: a for n, a in audits.items() if a["decree"] == latest}
+        behind = sorted(set(audits) - set(at))
+        if behind:
+            pending.append({"gpid": gpid, "decree": latest, "nodes": behind})
+        if len(at) < 2:
+            continue  # nothing to compare yet
+        prim = primaries.get(gpid)
+        if prim in at:
+            ref = at[prim]["digest"]
+        else:
+            # primary hasn't reported at this decree: a STRICT majority
+            # picks the reference; a tie (e.g. two secondaries, 1-1) is
+            # not attributable — naming either node would be iteration-
+            # order luck — so it waits for the primary's beacon
+            votes = {}
+            for a in at.values():
+                votes[a["digest"]] = votes.get(a["digest"], 0) + 1
+            ref = max(votes, key=votes.get)
+            if votes[ref] * 2 <= len(at):
+                pending.append({"gpid": gpid, "decree": latest,
+                                "nodes": sorted(at),
+                                "reason": "digests disagree with no "
+                                          "majority and no primary report "
+                                          "yet — not attributable"})
+                continue
+        checked.append(gpid)
+        for node, a in sorted(at.items()):
+            if a["digest"] != ref:
+                mismatches.append({"gpid": gpid, "node": node,
+                                   "decree": latest,
+                                   "digest": a["digest"], "expected": ref})
+    evidence["audit"] = {"checked": checked, "mismatches": mismatches,
+                         "pending": pending}
+    for m in mismatches:
+        causes.append({"severity": CRITICAL,
+                       "cause": f"consistency digest MISMATCH at partition "
+                                f"{m['gpid']} on node {m['node']} "
+                                f"(decree {m['decree']})",
+                       "evidence": "audit.mismatches"})
+
+
+def _scrape_nodes(caller, state, causes, evidence, slow_last) -> None:
+    """Per-node health scrapes: lane breakers, dispatch queue depth, and
+    the cluster-wide slow-request rollup. Scrape failures are evidence
+    (node listed under scrape_failed), not crashes."""
+    from .info_collector import rollup_slow_requests
+
+    alive = sorted(a for a, n in state.get("nodes", {}).items()
+                   if n["alive"])
+    scrapes, failed = {}, []
+    qthr = _queue_threshold()
+    for node in alive:
+        try:
+            snap = json.loads(caller.remote_command(
+                node, "perf-counters-by-substr",
+                ["lane.breaker_open", "dispatch_queue_depth"]))
+        except (RpcError, OSError, ValueError):
+            failed.append(node)
+            continue
+        scrapes[node] = snap
+        for lane in ("compact", "read"):
+            if snap.get(f"{lane}.lane.breaker_open"):
+                causes.append({"severity": DEGRADED,
+                               "cause": f"{lane} lane circuit breaker OPEN "
+                                        f"on node {node} (device lane "
+                                        "degraded to host)",
+                               "evidence": "scrapes"})
+        depth = snap.get("rpc.server.dispatch_queue_depth", 0)
+        if depth >= qthr:
+            causes.append({"severity": DEGRADED,
+                           "cause": f"dispatch queue depth {depth:.0f} on "
+                                    f"node {node} (>= {qthr}: serving "
+                                    "saturated)",
+                           "evidence": "scrapes"})
+    evidence["scrapes"] = scrapes
+    if failed:
+        evidence["scrape_failed"] = failed
+
+    def fetch(node):
+        return caller.remote_command(node, "slow-requests", [str(slow_last)])
+
+    evidence["slow_requests"] = rollup_slow_requests(fetch, alive,
+                                                     last=slow_last)

@@ -26,6 +26,29 @@ from ..runtime.perf_counters import counters
 from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
 
 
+def rollup_slow_requests(fetch, nodes, last: int = 20) -> list:
+    """Cluster-wide slow-request rollup (ISSUE 8 satellite): the ledger is
+    node-local — merge every node's `slow-requests` output (a JSON list
+    of traces with full span breakdowns; a partition-group router already
+    concatenates its workers' lists through the structural fan-out merge)
+    into ONE worst-first top-N, each trace tagged with the node it came
+    from. `fetch(node) -> str` is the transport (remote command); nodes
+    that fail to answer are skipped — a rollup must degrade, not raise."""
+    merged = []
+    for node in nodes:
+        try:
+            traces = json.loads(fetch(node))
+        except (RpcError, OSError, ValueError):
+            continue
+        if not isinstance(traces, list):
+            continue
+        for t in traces:
+            if isinstance(t, dict):
+                merged.append(dict(t, node=node))
+    merged.sort(key=lambda t: t.get("duration_us", 0), reverse=True)
+    return merged[:last]
+
+
 class InfoCollector:
     def __init__(self, meta_addrs, interval_seconds: float = 10.0,
                  hotkey_rounds: int = 3, hotkey_query_limit: int = 8):
@@ -52,6 +75,11 @@ class InfoCollector:
         # closing the loop that decides which partitions' SSTs stay
         # HBM-resident for the device read path (ISSUE 7)
         self.read_residency = {}
+        # cluster-wide observability rollups (ISSUE 8): worst-first top-N
+        # slow requests merged across nodes, and the replication-lag
+        # worst-offender summary the doctor reads
+        self.cluster_slow_requests = []
+        self.lag_stats = {}
 
     def start(self):
         self._thread.start()
@@ -129,6 +157,76 @@ class InfoCollector:
         self.compact_stats = agg
         return agg
 
+    def collect_lag_stats(self, nodes) -> dict:
+        """Replication-lag plane, aggregated (ISSUE 8): scrape every
+        node's per-partition `replica.*` decree gauges + `dup.lag.*`
+        ship-lag gauges and republish cluster-level WORST-OFFENDER series
+        (a lag quantile summed across nodes is meaningless — the signal
+        is the single worst replica, named):
+
+          collector.cluster.lag.secondary_gap_max   worst prepare lag
+          collector.cluster.lag.apply_gap_max       worst committed-applied
+          collector.cluster.lag.backlog_max         worst staged backlog
+          collector.cluster.dup.lag_max             worst duplicator lag
+
+        self.lag_stats keeps {series: {"value", "node", "name"}} so the
+        doctor (and collector-info) can point at the offender."""
+        worst = {"secondary_gap_max": (0.0, "", ""),
+                 "apply_gap_max": (0.0, "", ""),
+                 "backlog_max": (0.0, "", ""),
+                 "dup_lag_max": (0.0, "", "")}
+
+        def offer(series, value, node, name):
+            if value > worst[series][0]:
+                worst[series] = (float(value), node, name)
+
+        for node in sorted(nodes):
+            try:
+                # ONE scrape per node: perf-counters-by-prefix matches
+                # any of its arguments
+                snap = json.loads(self.remote_command(
+                    node, "perf-counters-by-prefix",
+                    ["replica.", "dup.lag."]))
+            except (RpcError, OSError, ValueError):
+                continue
+            committed, applied = {}, {}
+            for name, v in snap.items():
+                if isinstance(v, dict):
+                    continue
+                if name.startswith("dup.lag."):
+                    offer("dup_lag_max", v, node, name)
+                elif name.endswith(".secondary_gap_max"):
+                    offer("secondary_gap_max", v, node, name)
+                elif name.endswith(".backlog"):
+                    offer("backlog_max", v, node, name)
+                elif name.endswith(".committed_decree"):
+                    committed[name[:-len(".committed_decree")]] = v
+                elif name.endswith(".applied_decree"):
+                    applied[name[:-len(".applied_decree")]] = v
+            for part, c in committed.items():
+                offer("apply_gap_max", c - applied.get(part, c), node,
+                      part)
+        out = {}
+        for series, (value, node, name) in worst.items():
+            if series == "dup_lag_max":
+                counters.number("collector.cluster.dup.lag_max").set(value)
+            else:
+                counters.number("collector.cluster.lag." + series).set(value)
+            out[series] = {"value": value, "node": node, "name": name}
+        self.lag_stats = out
+        return out
+
+    def collect_slow_requests(self, nodes, last: int = 20) -> list:
+        """Cluster-wide top-N slow requests (the node-local ledger merged
+        worst-first; see rollup_slow_requests). Republishes the count as
+        collector.cluster.slow_request_count."""
+        self.cluster_slow_requests = rollup_slow_requests(
+            lambda n: self.remote_command(n, "slow-requests", [str(last)]),
+            sorted(nodes), last=last)
+        counters.number("collector.cluster.slow_request_count").set(
+            len(self.cluster_slow_requests))
+        return self.cluster_slow_requests
+
     def collect_once(self) -> dict:
         apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
                                mm.ListAppsResponse).apps
@@ -178,6 +276,8 @@ class InfoCollector:
                                    primaries, read_qps, write_qps)
             summary[app.app_name] = agg
         self.collect_compact_stats(all_nodes)
+        self.collect_lag_stats(all_nodes)
+        self.collect_slow_requests(all_nodes)
         self.app_stats = summary
         return summary
 

@@ -34,6 +34,7 @@ import heapq
 import json
 import os
 import shutil
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -42,6 +43,7 @@ import numpy as np
 
 _UNRESOLVED = object()  # LsmEngine._resolved_mesh: "not probed yet"
 
+from ..base.crc64 import crc64
 from ..base.key_schema import key_hash
 from ..base.utils import epoch_now
 from ..base.value_schema import check_if_ts_expired
@@ -618,6 +620,33 @@ class LsmEngine:
                 if d or check_if_ts_expired(now, e):
                     continue
             yield k, v, e
+
+    # ------------------------------------------------------------------ audit
+
+    def state_digest(self, now: int = None) -> dict:
+        """Order-independent digest of the LIVE logical state — the
+        consistency-audit primitive (ISSUE 8). Walks memtable + immutables
+        + every SST through the one merged recency iterator (scan: same
+        newest-wins / tombstone / TTL rules as the read path), folding one
+        crc64 per record (key, value bytes, expire_ts) into an XOR and an
+        additive sum plus a count — commutative combines, so the PHYSICAL
+        layout (what compacted where, which level holds what) cannot
+        matter, only the logical contents can.
+
+        Tombstones and expired records are EXCLUDED: per-replica
+        compaction independently drops both, so their physical presence is
+        legitimately divergent state. `now` must be the auditor-chosen
+        clock (the trigger_audit mutation carries it) so every replica
+        filters expiry against the same instant."""
+        now = epoch_now() if now is None else now
+        xor = add = n = 0
+        for k, v, e in self.scan(now=now):
+            c = crc64(struct.pack("<I", len(k)) + k
+                      + struct.pack("<q", int(e)) + v)
+            xor ^= c
+            add = (add + c) & 0xFFFFFFFFFFFFFFFF
+            n += 1
+        return {"digest": f"{xor:016x}{add:016x}", "records": n, "now": now}
 
     # ----------------------------------------------------------- flush/compact
 

@@ -44,6 +44,8 @@ WRITE_CODES = {
     server_impl.RPC_DUPLICATE: (msg.DuplicateRequest, msg.DuplicateResponse),
     server_impl.RPC_BULK_LOAD_INGEST: (msg.BulkLoadIngestRequest,
                                        msg.BulkLoadIngestResponse),
+    server_impl.RPC_TRIGGER_AUDIT: (msg.TriggerAuditRequest,
+                                    msg.TriggerAuditResponse),
 }
 
 

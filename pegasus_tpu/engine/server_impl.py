@@ -31,7 +31,8 @@ from .write_service import WriteService
 from ..rpc.task_codes import (BATCHABLE, RPC_BULK_LOAD_INGEST,  # noqa: F401
                               RPC_CHECK_AND_MUTATE, RPC_CHECK_AND_SET,
                               RPC_DUPLICATE, RPC_INCR, RPC_MULTI_PUT,
-                              RPC_MULTI_REMOVE, RPC_PUT, RPC_REMOVE)
+                              RPC_MULTI_REMOVE, RPC_PUT, RPC_REMOVE,
+                              RPC_TRIGGER_AUDIT)
 
 # short op names for the per-partition qps + latency counter pairs
 # (app.<id>.<pidx>.<op>_qps / <op>_latency_us — write-path latency parity
@@ -40,7 +41,8 @@ _OP_NAMES = {RPC_PUT: "put", RPC_REMOVE: "remove",
              RPC_MULTI_PUT: "multi_put", RPC_MULTI_REMOVE: "multi_remove",
              RPC_INCR: "incr", RPC_CHECK_AND_SET: "check_and_set",
              RPC_CHECK_AND_MUTATE: "check_and_mutate",
-             RPC_DUPLICATE: "duplicate", RPC_BULK_LOAD_INGEST: "bulk_load"}
+             RPC_DUPLICATE: "duplicate", RPC_BULK_LOAD_INGEST: "bulk_load",
+             RPC_TRIGGER_AUDIT: "trigger_audit"}
 
 
 def _hk_hash32(hash_key: bytes):
@@ -453,6 +455,8 @@ class PegasusServer:
                 resp = ws.check_and_mutate(decree, req, now=now)
             elif code == RPC_DUPLICATE:
                 resp = ws.duplicate(decree, req, now=now)
+            elif code == RPC_TRIGGER_AUDIT:
+                resp = ws.trigger_audit(decree, req)
             else:
                 resp = ws.ingestion_files(decree, req)
         counters.percentile(self._pfx + f"{op}_latency_us").set(
@@ -785,6 +789,12 @@ class PegasusServer:
         counters.percentile(self._pfx + "manual_compact_s").set(
             time.perf_counter() - t0)
         return stats
+
+    @property
+    def last_audit(self):
+        """Most recent decree-anchored consistency digest this replica
+        computed (trigger_audit apply), or None."""
+        return self.write_service.last_audit
 
     def stats(self) -> dict:
         return self.engine.stats()

@@ -57,6 +57,9 @@ class WriteService:
         self._schema = SCHEMAS[engine.data_version()]
         self._batch = None
         self.cu_calculator = None  # set by PegasusServer
+        # most recent decree-anchored consistency digest (trigger_audit);
+        # the replica stub's query-audit command + beacon states read it
+        self.last_audit = None
 
     def _hk(self, key: bytes) -> bytes:
         return key_schema.restore_key(key)[0]
@@ -347,6 +350,65 @@ class WriteService:
                        task_codes.RPC_CHECK_AND_SET: self.check_and_set,
                        task_codes.RPC_CHECK_AND_MUTATE: self.check_and_mutate}
             handler[req.task_code](decree, inner, now=now)
+        return resp
+
+    def trigger_audit(self, decree: int, req: msg.TriggerAuditRequest):
+        """Decree-anchored consistency digest (ISSUE 8): this mutation is
+        a no-op for data — it only advances the decree — but because it
+        rides the normal PacificA apply path, every replica executes it
+        with exactly the decrees < `decree` applied and nothing after, so
+        the engine digest each computes is anchored at the SAME point in
+        the mutation stream. Layout independence comes from the digest
+        itself (engine.state_digest: commutative per-record combine over
+        the recency-merged logical contents).
+
+        COST, deliberately: the digest fold is O(live records) and runs
+        IN the apply path (under the replica lock), so the partition's
+        writes stall for its duration — that stall IS the decree anchor
+        (no later decree may apply before the snapshot is taken, and the
+        fold-now-publish-later variant would have to pin SST files
+        against compaction unlinks). Audits are explicit admin ops, not
+        a background cadence; `audit.digest_us` records what each one
+        cost.
+
+        The `audit.digest` fail point corrupts THIS replica's digest when
+        armed as return(<node>) or return(<node>@<app_id>.<pidx>) — node
+        "" matches every replica — simulating silent divergence for the
+        chaos suite without touching real data."""
+        import time as _time
+
+        from ..runtime.fail_points import fail_point
+        from ..runtime.perf_counters import counters
+
+        resp = self._fill(msg.TriggerAuditResponse(), decree)
+        self.empty_put(decree)  # the decree itself advances like any write
+        t0 = _time.perf_counter()
+        try:
+            dig = self.engine.state_digest(now=req.now or None)
+        except Exception as e:  # noqa: BLE001 - an audit must never wedge
+            # the apply path; a digest failure reports as inconclusive
+            resp.error = Status.IO_ERROR
+            resp.server = f"{self.server} (digest failed: {e!r})"
+            self.last_audit = {"audit_id": req.audit_id, "decree": decree,
+                               "digest": "", "error": repr(e),
+                               "ts": _time.time()}
+            return resp
+        digest = dig["digest"]
+        fp = fail_point("audit.digest")
+        if fp is not None and fp[0] == "return":
+            node, _, gpid = fp[1].partition("@")
+            if (not node or node == self.server) and \
+                    (not gpid or gpid == f"{self.app_id}.{self.pidx}"):
+                digest = "deadbeef" + digest[8:]
+        counters.rate("audit.trigger_count").increment()
+        counters.percentile("audit.digest_us").set(
+            int((_time.perf_counter() - t0) * 1e6))
+        self.last_audit = {"audit_id": req.audit_id, "decree": decree,
+                           "digest": digest, "records": dig["records"],
+                           "now": dig["now"], "ts": _time.time()}
+        resp.decree = decree
+        resp.digest = digest
+        resp.records = dig["records"]
         return resp
 
     def _encode_with_origin(self, user_data, expire_ts, timestamp_us,

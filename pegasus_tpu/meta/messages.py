@@ -124,6 +124,11 @@ class BeaconRequest:
     # "app_id.pidx.dupid:decree" — the meta folds them into its dup entries
     # (the reference's duplication_info.progress sync)
     dup_progress: List[str] = field(default_factory=list)
+    # per-replica lag/audit state, one JSON object per hosted replica
+    # ({"gpid","status","ballot","committed","applied","prepared",
+    #   "audit":{...}}) — the meta folds these into its cluster-state view
+    # so the doctor reads lag AND decree-anchored digests from ONE place
+    replica_states: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -324,6 +329,9 @@ class ReplicaStateResponse:
     last_committed: int = 0
     last_prepared: int = 0
     last_durable: int = 0
+    # what the ENGINE applied — diverges from last_committed exactly when
+    # the replica is behind on apply (appended last: codec append-only rule)
+    last_applied: int = 0
 
 
 # --- replica <-> replica (2PC + learn) ---
@@ -491,6 +499,8 @@ class ReplicaInfo:
     last_prepared: int = 0
     last_durable: int = 0
     envs_json: str = "{}"
+    # engine-applied decree (appended last: codec append-only evolution)
+    last_applied: int = 0
 
 
 @dataclass
@@ -523,6 +533,26 @@ class DddPartitionInfo:
     reason: str = ""
     candidates: List[str] = field(default_factory=list)  # "addr ballot=N lc=N"
     action: str = ""                  # "" or "promoted <addr>"
+
+
+@dataclass
+class QueryClusterStateRequest:
+    """Cluster-observability snapshot (ISSUE 8): liveness + partition
+    configs + the beacon-folded per-replica lag/audit states, in one RPC
+    — the cluster doctor's primary input."""
+
+    pass
+
+
+@dataclass
+class QueryClusterStateResponse:
+    error: int = 0
+    # {"nodes": {addr: {"alive", "last_beacon_ago_s"}},
+    #  "apps": {name: {"app_id", "partition_count",
+    #                  "partitions": [{"pidx","ballot","primary",
+    #                                  "secondaries"}]}},
+    #  "replica_states": {addr: {gpid: state}}}
+    state_json: str = "{}"
 
 
 @dataclass

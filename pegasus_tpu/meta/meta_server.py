@@ -61,6 +61,7 @@ META_LEVELS = ("stopped", "blind", "freezed", "steady", "lively")
 # steady:  failover rebuild but no balancing
 # lively:  everything, including balance
 RPC_CM_DDD_DIAGNOSE = "RPC_CM_DDD_DIAGNOSE"
+RPC_CM_QUERY_CLUSTER_STATE = "RPC_CM_QUERY_CLUSTER_STATE"
 RPC_FD_BEACON = "RPC_FD_FAILURE_DETECTOR_PING"
 
 # meta -> replica node
@@ -86,6 +87,7 @@ class MetaServer:
         self._parts = {}         # app_id -> list[PartitionConfig]
         self._nodes = {}         # addr -> last_beacon_monotonic
         self._node_replicas = {} # addr -> ["app_id.pidx"] from the last beacon
+        self._node_states = {}   # addr -> {gpid: lag/audit state} (beacon)
         self._dups = {}          # app_id -> list[dict] duplication entries
         self._policies = {}      # name -> dict (BackupPolicyInfo fields)
         self._dropped = {}       # app_id -> {"app","parts","expire_ts"}
@@ -111,7 +113,7 @@ class MetaServer:
         RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG, RPC_CM_LIST_NODES,
         RPC_CM_QUERY_DUPLICATION, RPC_CM_LS_BACKUP_POLICY,
         RPC_CM_QUERY_BULK_LOAD, RPC_CM_QUERY_RESTORE, RPC_CM_CONTROL_META,
-        RPC_FD_BEACON,
+        RPC_CM_QUERY_CLUSTER_STATE, RPC_FD_BEACON,
     })
 
     # codes still served at level "stopped" (full lockdown): only the way
@@ -172,6 +174,7 @@ class MetaServer:
             RPC_CM_RECALL_APP: self._on_recall_app,
             RPC_CM_CONTROL_META: self._on_control_meta,
             RPC_CM_DDD_DIAGNOSE: self._on_ddd_diagnose,
+            RPC_CM_QUERY_CLUSTER_STATE: self._on_query_cluster_state,
             RPC_FD_BEACON: self._on_beacon,
         }
 
@@ -1132,6 +1135,34 @@ class MetaServer:
             out.append(info)
         return codec.encode(mm.DddDiagnoseResponse(partitions=out))
 
+    def _on_query_cluster_state(self, header, body) -> bytes:
+        """One-RPC cluster-observability snapshot (ISSUE 8): node liveness,
+        every app's partition config, and the beacon-folded per-replica
+        lag/audit states — everything the cluster doctor folds that the
+        meta already knows. Served at `blind` level too (pure query)."""
+        with self._lock:
+            now = time.monotonic()
+            nodes = {addr: {"alive": (now - last) < self.fd_grace,
+                            "last_beacon_ago_s": round(now - last, 3)}
+                     for addr, last in self._nodes.items()}
+            apps = {}
+            for app in self._apps.values():
+                apps[app.app_name] = {
+                    "app_id": app.app_id,
+                    "partition_count": app.partition_count,
+                    "replica_count": app.replica_count,
+                    "partitions": [{
+                        "pidx": pc.pidx, "ballot": pc.ballot,
+                        "primary": pc.primary,
+                        "secondaries": list(pc.secondaries)}
+                        for pc in self._parts[app.app_id]]}
+            state = {"nodes": nodes, "apps": apps,
+                     "replica_states": {n: dict(s) for n, s
+                                        in self._node_states.items()},
+                     "meta_level": self.level}
+        return codec.encode(mm.QueryClusterStateResponse(
+            state_json=json.dumps(state)))
+
     def _on_list_nodes(self, header, body) -> bytes:
         with self._lock:
             nodes = []
@@ -1154,6 +1185,16 @@ class MetaServer:
             self._nodes[req.node] = time.monotonic()
             # what the node actually holds — ddd_diagnose candidate source
             self._node_replicas[req.node] = set(req.alive_replicas)
+            # per-replica lag/audit states (the cluster doctor's input);
+            # in-memory only, like the liveness map — re-beacons rebuild it
+            states = {}
+            for item in req.replica_states:
+                try:
+                    st = json.loads(item)
+                    states[st["gpid"]] = st
+                except (ValueError, KeyError, TypeError):
+                    continue
+            self._node_states[req.node] = states
             # fold primary-reported dup confirmed decrees into the entries
             # (reference duplication progress sync); not persisted per
             # beacon — losing it on meta restart only means extra plog
@@ -1213,6 +1254,11 @@ class MetaServer:
 
     def _handle_node_death(self, node: str) -> None:
         with self._lock:
+            # drop the dead node's beacon-folded lag/audit states: frozen
+            # values would otherwise feed the doctor's lag fold forever
+            # (a rejoining node re-beacons them). _node_replicas is KEPT —
+            # ddd_diagnose hunts candidates on dead nodes through it.
+            self._node_states.pop(node, None)
             moves = []
             for app in self._apps.values():
                 for pc in self._parts[app.app_id]:

@@ -120,6 +120,14 @@ class Replica:
         self.last_committed = self.server.engine.last_committed_decree()
         self.last_prepared = self.last_committed
         self._prep_pool = None
+        # replication-lag plane (ISSUE 8): per-partition gauges resolved
+        # ONCE (the registry lock is per-lookup and these fire per window)
+        pfx = f"replica.{app_id}.{pidx}."
+        self._c_inflight = counters.number(pfx + "inflight")
+        self._c_backlog = counters.number(pfx + "backlog")
+        self._c_committed = counters.number(pfx + "committed_decree")
+        self._c_applied = counters.number(pfx + "applied_decree")
+        self._c_gap = counters.number(pfx + "secondary_gap_max")
         self._recover_from_log()
 
     def _prepare_pool(self):
@@ -247,6 +255,9 @@ class Replica:
         # quorum (incl. us) holds every decree <= d — peers ack their
         # highest CONTIGUOUS prepared decree, so coverage is monotonic
         acks = [lp for lp in peer_lps if lp is not None]
+        # worst responding secondary's prepare lag behind this window's
+        # tail (dead peers surface via meta liveness, not this gauge)
+        self._c_gap.set(max((max(0, dk - lp) for lp in acks), default=0))
         commit_d = d0 - 1
         for d in range(d0, dk + 1):
             if 1 + sum(1 for lp in acks if lp >= d) >= self.quorum:
@@ -274,12 +285,18 @@ class Replica:
                     f"quorum lost: decree {d} prepared but not committed")
 
     def _export_gauges(self):
-        """Per-partition write-path pressure: slots queued for the next
-        group commit (inflight) and prepared-but-uncommitted decrees
-        (backlog) — the two queues a slow quorum round backs up into."""
-        pfx = f"replica.{self.app_id}.{self.pidx}."
-        counters.number(pfx + "inflight").set(len(self._batch_pending))
-        counters.number(pfx + "backlog").set(len(self._uncommitted))
+        """Per-partition write-path pressure + replication-lag plane:
+        slots queued for the next group commit (inflight),
+        prepared-but-uncommitted decrees (backlog), and the
+        committed/applied decree pair — `committed_decree` is what
+        replication knows is committed HERE, `applied_decree` is what the
+        engine actually applied; they diverge exactly when a replica is
+        behind on APPLY (mid-window engine failure) rather than behind on
+        commit, which is the distinction the cluster doctor reports."""
+        self._c_inflight.set(len(self._batch_pending))
+        self._c_backlog.set(len(self._uncommitted))
+        self._c_committed.set(self.last_committed)
+        self._c_applied.set(self.server.engine.last_committed_decree())
 
     def _send_prepare_window(self, peer_name: str, ms: list):
         """Send one windowed prepare to a peer. Returns the peer's highest
@@ -336,8 +353,13 @@ class Replica:
         """Windowed prepare: stage a contiguous decree window with ONE
         plog group append and ack the highest contiguous prepared decree.
         The per-decree invariants are exactly on_prepare's — ack(d) only
-        once the log holds every decree <= d."""
-        with REQUEST_TRACER.span("replica.on_prepare", decree=ms[-1].decree,
+        once the log holds every decree <= d. An EMPTY window is a pure
+        commit-point broadcast (broadcast_commit_point): nothing stages,
+        but staged decrees covered by `committed_decree` apply — how an
+        idle partition's secondaries learn the last window committed."""
+        with REQUEST_TRACER.span("replica.on_prepare",
+                                 decree=ms[-1].decree if ms
+                                 else committed_decree,
                                  batch=len(ms)), self._lock:
             if ballot < self.ballot:
                 raise PrepareRejected("stale_ballot", self.last_prepared)
@@ -364,9 +386,33 @@ class Replica:
                     self._uncommitted[m.decree] = m
                 self.last_prepared = fresh[-1].decree
             self._apply_up_to(min(committed_decree, self.last_prepared))
+            self._export_gauges()
             if gap:
                 raise PrepareRejected("gap", self.last_prepared)
             return self.last_prepared
+
+    def broadcast_commit_point(self) -> int:
+        """Push the current commit point to every secondary as an EMPTY
+        prepare window, so decrees they hold prepared apply NOW instead
+        of waiting for the next write's piggyback. trigger_audit needs
+        this: on an idle partition the audit decree would otherwise sit
+        staged on secondaries indefinitely and the audit could never
+        conclude. -> number of peers that acked."""
+        with self._lock:
+            if self.status != PRIMARY or self.view is None:
+                return 0
+            secs = list(self.view.secondaries)
+            ballot, committed = self.ballot, self.last_committed
+        n = 0
+        for s in secs:
+            try:
+                peer = self.peers(s)
+                if hasattr(peer, "on_prepare_batch"):
+                    peer.on_prepare_batch(ballot, [], committed)
+                    n += 1
+            except (PrepareRejected, ConnectionError):
+                continue
+        return n
 
     def on_prepare(self, ballot: int, m: LogMutation, committed_decree: int):
         with REQUEST_TRACER.span("replica.on_prepare", decree=m.decree), \
@@ -443,6 +489,16 @@ class Replica:
         replay private log, SURVEY §3.5). `primary` is anything exposing
         fetch_learn_state() — a local Replica or an RPC peer proxy (the
         NFS-like learn file copy of config.ini:64-73)."""
+        learning = counters.number(
+            f"replica.{self.app_id}.{self.pidx}.learning")
+        learning.set(1)
+        try:
+            self._learn_from(primary)
+        finally:
+            learning.set(0)
+            self._export_gauges()
+
+    def _learn_from(self, primary):
         with self._lock:
             self.status = LEARNER
             self._uncommitted.clear()
@@ -538,9 +594,16 @@ class Replica:
             return []
 
     def close(self):
-        for d in self.duplicators.values():
+        for dupid, d in self.duplicators.items():
             d.stop()
+            counters.remove(f"dup.lag.{self.app_id}.{self.pidx}.{dupid}")
         self.duplicators.clear()
+        # unregister this partition's lag gauges: a closed (rebalanced
+        # away) replica's frozen values must not keep feeding the
+        # collector's cluster worst-offender series
+        for name in ("inflight", "backlog", "committed_decree",
+                     "applied_decree", "secondary_gap_max", "learning"):
+            counters.remove(f"replica.{self.app_id}.{self.pidx}.{name}")
         if self._prep_pool is not None:
             self._prep_pool.shutdown(wait=False)
             self._prep_pool = None

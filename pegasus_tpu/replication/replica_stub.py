@@ -167,6 +167,8 @@ class ReplicaStub:
         self.commands.register("set-read-residency",
                                self._cmd_set_read_residency)
         self.commands.register("flush-log", self._cmd_flush_log)
+        self.commands.register("trigger-audit", self._cmd_trigger_audit)
+        self.commands.register("query-audit", self._cmd_query_audit)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
@@ -204,22 +206,41 @@ class ReplicaStub:
     # --------------------------------------------- group-executor plumbing
 
     def _beacon_fragment_locked(self):
+        from ..runtime.perf_counters import counters
+
         alive = [f"{a}.{p}" for (a, p) in self._replicas]
-        progress = [
-            f"{a}.{p}.{dupid}:{d.last_shipped_decree}"
-            for (a, p), rep in self._replicas.items()
+        progress = []
+        states = []
+        for (a, p), rep in self._replicas.items():
             # dict() snapshot: _sync_duplications swaps the mapping
             # copy-on-write, so iteration here can never see a resize
-            for dupid, d in dict(rep.duplicators).items()]
-        return alive, progress
+            for dupid, d in dict(rep.duplicators).items():
+                progress.append(f"{a}.{p}.{dupid}:{d.last_shipped_decree}")
+                # duplicator ship-lag: decrees committed here but not yet
+                # confirmed shipped (refreshed every beacon tick)
+                counters.number(f"dup.lag.{a}.{p}.{dupid}").set(
+                    max(0, rep.last_committed - d.last_shipped_decree))
+            st = {"gpid": f"{a}.{p}", "status": rep.status,
+                  "ballot": rep.ballot,
+                  "committed": rep.last_committed,
+                  "applied": rep.server.engine.last_committed_decree(),
+                  "prepared": rep.last_prepared}
+            la = rep.server.last_audit
+            if la:
+                st["audit"] = {"audit_id": la.get("audit_id", 0),
+                               "decree": la.get("decree", 0),
+                               "digest": la.get("digest", "")}
+            states.append(json.dumps(st))
+        return alive, progress, states
 
     def _on_group_state(self, header, body) -> bytes:
         """The parent's beacon-aggregation scrape: this worker's share of
-        the node beacon (alive replicas + duplication progress)."""
+        the node beacon (alive replicas + duplication progress + the
+        per-replica lag/audit states the cluster doctor folds)."""
         with self._lock:
-            alive, progress = self._beacon_fragment_locked()
-        return json.dumps({"alive": alive,
-                           "dup_progress": progress}).encode("utf-8")
+            alive, progress, states = self._beacon_fragment_locked()
+        return json.dumps({"alive": alive, "dup_progress": progress,
+                           "states": states}).encode("utf-8")
 
     def _owns(self, app_id: int, pidx: int) -> bool:
         if not self.group_spec:
@@ -300,9 +321,9 @@ class ReplicaStub:
 
     def send_beacon(self):
         with self._lock:
-            alive, progress = self._beacon_fragment_locked()
+            alive, progress, states = self._beacon_fragment_locked()
         req = mm.BeaconRequest(node=self.address, alive_replicas=alive,
-                               dup_progress=progress)
+                               dup_progress=progress, replica_states=states)
         body = codec.encode(req)
         # beacon EVERY configured meta, not just the first reachable one:
         # follower metas absorb beacons too (meta HA — a warm liveness map
@@ -426,6 +447,9 @@ class ReplicaStub:
                 except ValueError:
                     pass
                 d.stop()
+                from ..runtime.perf_counters import counters
+
+                counters.remove(f"dup.lag.{rep.app_id}.{rep.pidx}.{dupid}")
         for dupid, e in want.items():
             d = dups.get(dupid)
             if d is None:
@@ -614,7 +638,8 @@ class ReplicaStub:
                 last_committed=rep.last_committed,
                 last_prepared=rep.last_prepared,
                 last_durable=rep.server.engine.last_durable_decree(),
-                envs_json=json.dumps(rep.server.app_envs)))
+                envs_json=json.dumps(rep.server.app_envs),
+                last_applied=rep.server.engine.last_committed_decree()))
         return codec.encode(mm.QueryReplicaInfoResponse(replicas=out))
 
     def _seed_from_restore(self, replica_path: str, restore_dir: str) -> None:
@@ -645,7 +670,8 @@ class ReplicaStub:
         return codec.encode(mm.ReplicaStateResponse(
             status=rep.status, ballot=rep.ballot,
             last_committed=rep.last_committed, last_prepared=rep.last_prepared,
-            last_durable=rep.server.engine.last_durable_decree()))
+            last_durable=rep.server.engine.last_durable_decree(),
+            last_applied=rep.server.engine.last_committed_decree()))
 
     # ------------------------------------------------------- replication RPC
 
@@ -665,8 +691,10 @@ class ReplicaStub:
             return codec.encode(mm.PrepareResponse(error=1, reason="no_replica"))
         if req.mutations:  # decree-pipelined window
             ms = [codec.decode(LogMutation, b) for b in req.mutations]
-        else:              # single-mutation frame from an older sender
+        elif req.mutation:  # single-mutation frame from an older sender
             ms = [codec.decode(LogMutation, req.mutation)]
+        else:              # empty window: pure commit-point broadcast
+            ms = []
         try:
             lp = rep.on_prepare_batch(req.ballot, ms, req.committed_decree)
             return codec.encode(mm.PrepareResponse(last_prepared=lp))
@@ -733,6 +761,7 @@ class ReplicaStub:
                         "last_committed": r.last_committed,
                         "last_prepared": r.last_prepared,
                         "last_durable": r.server.engine.last_durable_decree(),
+                        "last_applied": r.server.engine.last_committed_decree(),
                     }
                     for (a, p), r in self._replicas.items()
                 },
@@ -784,6 +813,68 @@ class ReplicaStub:
         on = args[1] == "on"
         rep.server.engine.set_read_residency(on)
         return f"read residency {'on' if on else 'off'} for {gpid}"
+
+    def _cmd_trigger_audit(self, args: list) -> str:
+        """trigger-audit <app_id.pidx> [audit_id] — ride a no-op mutation
+        through the partition's PacificA prepare path so EVERY replica
+        computes a consistency digest anchored at the same applied decree;
+        then broadcast the commit point so idle secondaries apply it now.
+        Must run on the primary. Returns the primary's digest as JSON; an
+        empty reply means the partition is not served here (so a
+        partition-group router's fan-out merge keeps the owner's reply)."""
+        from ..base.utils import epoch_now
+        from ..engine.server_impl import RPC_TRIGGER_AUDIT
+        from ..rpc import messages as rpc_msg
+
+        if not args:
+            return "usage: trigger-audit <app_id.pidx> [audit_id]"
+        a, _, p = args[0].partition(".")
+        with self._lock:
+            rep = self._replicas.get((int(a), int(p)))
+        if rep is None:
+            return ""
+        if rep.status != PRIMARY:
+            return json.dumps({"error": f"not primary ({rep.status})",
+                               "gpid": args[0], "node": self.address})
+        audit_id = int(args[1]) if len(args) > 1 else int(time.time() * 1000)
+        req = rpc_msg.TriggerAuditRequest(audit_id=audit_id, now=epoch_now())
+        try:
+            resp = rep.client_write(RPC_TRIGGER_AUDIT, req)
+        except ReplicaError as e:
+            return json.dumps({"error": str(e), "gpid": args[0],
+                               "node": self.address})
+        if resp.error or not resp.digest:
+            # a failed digest computation must surface as an ERROR the
+            # audit driver turns into inconclusive — an empty digest
+            # compared as real would fake a mismatch on every secondary
+            return json.dumps({"error": f"digest failed ({resp.server})",
+                               "gpid": args[0], "node": self.address})
+        rep.broadcast_commit_point()
+        return json.dumps({"gpid": args[0], "audit_id": audit_id,
+                           "decree": resp.decree, "digest": resp.digest,
+                           "records": resp.records, "node": self.address})
+
+    def _cmd_query_audit(self, args: list) -> str:
+        """query-audit [app_id.pidx] — each hosted (or the named) replica's
+        latest decree-anchored digest plus its committed/applied decrees,
+        keyed by gpid (JSON dict; disjoint keys merge cleanly through the
+        partition-group router's structural fan-out merge)."""
+        with self._lock:
+            targets = list(self._replicas.items())
+        out = {}
+        for (a, p), rep in targets:
+            gpid = f"{a}.{p}"
+            if args and args[0] != gpid:
+                continue
+            ent = {"status": rep.status,
+                   "committed": rep.last_committed,
+                   "applied": rep.server.engine.last_committed_decree(),
+                   "node": self.address}
+            la = rep.server.last_audit
+            if la:
+                ent["audit"] = dict(la)
+            out[gpid] = ent
+        return json.dumps(out)
 
     def _cmd_flush_log(self, args: list) -> str:
         """flush-log: fsync every hosted replica's mutation log (reference

@@ -571,7 +571,7 @@ class GroupedReplicaNode:
         replica/dup state (RPC_GROUP_STATE) under the public address."""
         from ..meta.meta_server import RPC_FD_BEACON
 
-        alive, progress = [], []
+        alive, progress, states = [], [], []
         for g in range(self.groups):
             if not self._workers[g].alive:
                 continue
@@ -581,10 +581,12 @@ class GroupedReplicaNode:
                 st = json.loads(rbody.decode("utf-8"))
                 alive.extend(st.get("alive", []))
                 progress.extend(st.get("dup_progress", []))
+                states.extend(st.get("states", []))
             except (RpcError, OSError, ConnectionError, ValueError):
                 continue
         body = codec.encode(mm.BeaconRequest(
-            node=self.address, alive_replicas=alive, dup_progress=progress))
+            node=self.address, alive_replicas=alive, dup_progress=progress,
+            replica_states=states))
         for m in self.meta_addrs:
             host, _, port = m.rpartition(":")
             try:

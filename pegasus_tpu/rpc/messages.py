@@ -303,6 +303,29 @@ class DuplicateResponse:  # duplicate_response
     error_hint: str = ""
 
 
+@dataclass
+class TriggerAuditRequest:
+    """Admin no-op mutation: every replica computes an order-independent
+    digest of its engine state at the decree this mutation applies at.
+    `now` is the expiry clock the PRIMARY chose — all replicas filter
+    TTL-expired records against the same instant, so clock skew cannot
+    fake a mismatch."""
+
+    audit_id: int = 0
+    now: int = 0
+
+
+@dataclass
+class TriggerAuditResponse:
+    error: int = 0
+    app_id: int = 0
+    partition_index: int = 0
+    decree: int = 0            # the decree the digest is anchored at
+    digest: str = ""           # 32-hex-char order-independent state digest
+    records: int = 0           # live records folded into the digest
+    server: str = ""
+
+
 def match_filter(filter_type: int, pattern: bytes, data: bytes) -> bool:
     """The anywhere/prefix/postfix matcher shared by scans and multi_get."""
     if filter_type == FilterType.NO_FILTER or not pattern:

@@ -14,6 +14,9 @@ RPC_CHECK_AND_SET = "RPC_RRDB_RRDB_CHECK_AND_SET"
 RPC_CHECK_AND_MUTATE = "RPC_RRDB_RRDB_CHECK_AND_MUTATE"
 RPC_DUPLICATE = "RPC_RRDB_RRDB_DUPLICATE"
 RPC_BULK_LOAD_INGEST = "RPC_RRDB_RRDB_BULK_LOAD"
+# admin no-op mutation: rides the PacificA prepare path so every replica
+# computes a consistency digest at the SAME applied decree (ISSUE 8)
+RPC_TRIGGER_AUDIT = "RPC_RRDB_RRDB_TRIGGER_AUDIT"
 
 RPC_GET = "RPC_RRDB_RRDB_GET"
 RPC_MULTI_GET = "RPC_RRDB_RRDB_MULTI_GET"

@@ -142,6 +142,30 @@ def _request_trace_route(path: str) -> dict:
             "slow_requests": REQUEST_TRACER.slow_requests(last)}
 
 
+def _health_cluster_route(meta_addrs):
+    """GET /health/cluster[?scrape=0][&last=N]: the cluster doctor's ONE
+    structured verdict (healthy|degraded|critical|inconclusive + named
+    causes + evidence) — the HTTP twin of the `cluster-doctor` remote
+    command and the shell's `cluster_doctor`. ?scrape=0 skips the
+    per-node breaker/queue/slow-request scrapes (meta-state fold only);
+    ?last=N bounds the slow-request rollup."""
+    from urllib.parse import parse_qs, urlparse
+
+    def route(path):
+        from ..collector.cluster_doctor import run_cluster_doctor
+
+        q = parse_qs(urlparse(path).query)
+        try:
+            last = int((q.get("last") or ["10"])[0])
+        except ValueError:
+            last = 10
+        scrape = (q.get("scrape") or ["1"])[0] not in ("0",)
+        return run_cluster_doctor(list(meta_addrs), scrape=scrape,
+                                  slow_last=last)
+
+    return route
+
+
 def _meta_http_routes(meta) -> dict:
     """The meta's rDSN-http_service analogues: /version, /meta/cluster_info,
     /meta/apps, /meta/app?name=<app>."""
@@ -256,8 +280,10 @@ class MetaApp:
             # started here, not in start(): BaseServer.shutdown() hangs
             # forever unless serve_forever ran, so a start() that dies
             # before reaching the reporter would make stop() deadlock
+            routes = _meta_http_routes(self.meta)
+            routes["/health/cluster"] = _health_cluster_route([self.address])
             self.reporter = CounterReporter(
-                port=http_port, routes=_meta_http_routes(self.meta)).start()
+                port=http_port, routes=routes).start()
 
     @property
     def address(self):
@@ -454,9 +480,34 @@ class CollectorApp:
                 "hotkeys": self.collector.hotkey_results,
                 "app_stats": self.collector.app_stats,
                 "compact_stats": self.collector.compact_stats,
+                "lag_stats": self.collector.lag_stats,
+                "slow_requests": self.collector.cluster_slow_requests,
             })
 
         self.commands.register("collector-info", info)
+
+        def cluster_doctor(args):
+            """cluster-doctor [last] — one structured cluster-health
+            verdict (the collector is the doctor's native home: it
+            already scrapes every node)."""
+            from ..collector.cluster_doctor import run_cluster_doctor
+
+            last = int(args[0]) if args else 10
+            return json.dumps(run_cluster_doctor(
+                list(self.metas), pool=self.collector.pool,
+                slow_last=last), indent=1)
+
+        def trigger_audit(args):
+            """trigger-audit [app ...] — run the decree-anchored
+            consistency audit across every (or the named) app."""
+            from ..collector.cluster_doctor import run_cluster_audit
+
+            return json.dumps(run_cluster_audit(
+                list(self.metas), pool=self.collector.pool,
+                apps=list(args) or None), indent=1)
+
+        self.commands.register("cluster-doctor", cluster_doctor)
+        self.commands.register("trigger-audit", trigger_audit)
         self.rpc.register("RPC_CLI_CLI_CALL", self.commands.rpc_handler)
         http_port = config.get_int(section, "http_port", -1)
         self.reporter = None
@@ -466,7 +517,9 @@ class CollectorApp:
             self.reporter = CounterReporter(
                 port=http_port,
                 routes={"/compact/trace": _compact_trace_route,
-                        "/requests/trace": _request_trace_route}).start()
+                        "/requests/trace": _request_trace_route,
+                        "/health/cluster":
+                            _health_cluster_route(self.metas)}).start()
 
     @property
     def address(self):

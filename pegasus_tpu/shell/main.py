@@ -93,9 +93,18 @@ class Shell:
                               "request traces (client/rpc/replication/engine "
                               "stage timelines)"),
             "slow_requests": (self.cmd_slow_requests,
-                              "slow_requests [node] [last] — the slow-request "
-                              "ledger: full stage timeline of every request "
-                              "over the slow threshold"),
+                              "slow_requests [node|--cluster] [last] — the "
+                              "slow-request ledger; --cluster merges every "
+                              "node's ledger into one worst-first top-N"),
+            "trigger_audit": (self.cmd_trigger_audit,
+                              "trigger_audit [app] — decree-anchored "
+                              "consistency audit: every replica digests its "
+                              "state at the same applied decree; mismatches "
+                              "name the exact (app, pidx, node)"),
+            "cluster_doctor": (self.cmd_cluster_doctor,
+                               "cluster_doctor [last] — ONE cluster health "
+                               "verdict (healthy|degraded|critical) with "
+                               "named causes + evidence"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
             "propose": (self.cmd_propose,
@@ -539,10 +548,48 @@ class Shell:
             self.cmd_remote_command(["all", "request-trace-dump"])
 
     def cmd_slow_requests(self, args):
-        if args:
+        if args and args[0] == "--cluster":
+            from ..collector.info_collector import rollup_slow_requests
+
+            last = int(args[1]) if len(args) > 1 else 20
+            nodes = [n.address for n in self._nodes() if n.alive]
+            merged = rollup_slow_requests(
+                lambda n: self._node_command(n, "slow-requests", [str(last)]),
+                nodes, last=last)
+            self.p(json.dumps(merged, indent=1))
+        elif args:
             self.p(self._node_command(args[0], "slow-requests", args[1:]))
         else:
             self.cmd_remote_command(["all", "slow-requests"])
+
+    def cmd_trigger_audit(self, args):
+        from ..collector.cluster_doctor import run_cluster_audit
+
+        apps = [args[0]] if args else (
+            [self.current_app] if self.current_app else None)
+        report = run_cluster_audit(self.meta_addrs, pool=self.pool,
+                                   apps=apps)
+        self.p(json.dumps(report, indent=1))
+        if report["mismatches"]:
+            self.p(f"AUDIT FAILED: {len(report['mismatches'])} digest "
+                   "mismatch(es)")
+        elif report["inconclusive"]:
+            self.p("audit inconclusive for "
+                   f"{len(report['inconclusive'])} partition(s)")
+        else:
+            self.p(f"audit OK: {len(report['ok'])} partition(s), all "
+                   "replicas identical at identical decrees")
+
+    def cmd_cluster_doctor(self, args):
+        from ..collector.cluster_doctor import run_cluster_doctor
+
+        last = int(args[0]) if args else 10
+        verdict = run_cluster_doctor(self.meta_addrs, pool=self.pool,
+                                     slow_last=last)
+        self.p(json.dumps(verdict, indent=1))
+        self.p(f"cluster verdict: {verdict['verdict'].upper()}"
+               + (f" ({len(verdict['causes'])} cause(s))"
+                  if verdict["causes"] else ""))
 
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]

@@ -379,6 +379,40 @@ def test_metric_names_lint_clean():
     assert proc.returncode == 0, proc.stderr
 
 
+def test_remote_commands_lint_clean():
+    """tools/check_remote_commands.py wired into the test run: every
+    registered remote command is documented in README.md's
+    Remote-command table, and every table row still names a registered
+    command (both directions, like the fail-point lint)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tools" / "check_remote_commands.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_remote_commands_lint_flags_undocumented(monkeypatch):
+    """Both lint directions have teeth: an unregistered README row and an
+    undocumented registration each produce an error."""
+    from tools import check_remote_commands as cc
+
+    real_src = cc.source_commands()
+    monkeypatch.setattr(cc, "source_commands",
+                        lambda: real_src | {"ghost-command"})
+    errors = cc.run_lint()
+    assert any("ghost-command" in e and "missing from README" in e
+               for e in errors)
+    monkeypatch.setattr(cc, "source_commands",
+                        lambda: real_src - {"cluster-doctor"})
+    errors = cc.run_lint()
+    assert any("cluster-doctor" in e and "no matching registration" in e
+               for e in errors)
+
+
 def test_counter_reporter_prometheus(onebox):
     from pegasus_tpu.runtime.perf_counters import counters
 

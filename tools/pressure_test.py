@@ -40,6 +40,10 @@ def main():
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--read-pct", type=int, default=50)
     ap.add_argument("--key-space", type=int, default=100_000)
+    ap.add_argument("--no-audit", action="store_true",
+                    help="skip the mid-run decree-anchored consistency "
+                         "audit (on by default; a digest mismatch fails "
+                         "the run like a verify failure)")
     args = ap.parse_args()
 
     from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
@@ -108,6 +112,19 @@ def main():
                    for t in range(args.threads)]
         for t in threads:
             t.start()
+        # consistency audit UNDER the load (ISSUE 8): partway through the
+        # run, every replica digests its state at the same applied decree;
+        # a mismatch fails the run exactly like a verify failure — the
+        # pass criterion the production-sim scenario builds on
+        audit = None
+        if not args.no_audit:
+            from pegasus_tpu.collector.cluster_doctor import \
+                run_cluster_audit
+
+            time.sleep(min(2.0, args.seconds / 2))
+            audit = run_cluster_audit([meta_addr], apps=[args.table],
+                                      wait_s=20.0)
+            audit.pop("digests", None)
         for t in threads:
             t.join()
         elapsed = time.time() - t_start
@@ -125,13 +142,27 @@ def main():
             "unit": "ops/s",
             "detail": {**stats, "elapsed_s": round(elapsed, 1),
                        "avg_ms": round(sum(lat_ms) / max(1, len(lat_ms)), 2),
-                       "p95_ms": pct(0.95), "p99_ms": pct(0.99)},
+                       "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+                       "audit": audit},
         }), flush=True)
 
     finally:
         if cluster is not None:
             cluster.stop()
-    sys.exit(1 if stats["verify_failures"] or stats["errors"] else 0)
+    audit_failed = bool(audit and audit.get("mismatches"))
+    if audit_failed:
+        print(f"pressure_test: consistency audit FAILED: "
+              f"{audit['mismatches']}", file=sys.stderr)
+    elif audit is not None and len(audit.get("ok", [])) \
+            != audit.get("partitions", 0):
+        # zero mismatches without full coverage is not a pass — say so
+        # (only a real mismatch fails the run, per the audit contract)
+        print("pressure_test: consistency audit inconclusive for "
+              f"{audit.get('partitions', 0) - len(audit.get('ok', []))} "
+              "partition(s) — zero mismatches is vacuous",
+              file=sys.stderr)
+    sys.exit(1 if stats["verify_failures"] or stats["errors"]
+             or audit_failed else 0)
 
 
 if __name__ == "__main__":
