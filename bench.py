@@ -638,10 +638,9 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
     runs on its own thread WHILE the workers hammer the cluster (the
     consistency audit rides here: digests must match under concurrent
     load, not just at rest); its return value lands in stats["during"]."""
-    import threading
-
     from pegasus_tpu.client import MetaResolver, PegasusClient
     from pegasus_tpu.runtime.perf_counters import counters
+    from pegasus_tpu.runtime.tasking import spawn_thread
 
     load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
     t0 = time.perf_counter()
@@ -674,7 +673,7 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
                 errors[0] += 1
         cli.close()
 
-    threads = [threading.Thread(target=worker, args=(t,))
+    threads = [spawn_thread(worker, t, daemon=False, start=False)
                for t in range(n_threads)]
     t0 = time.perf_counter()
     for t in threads:
@@ -687,8 +686,7 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
                 during_box[0] = during()
             except Exception as e:  # noqa: BLE001 - report, don't crash
                 during_box[0] = {"error": repr(e)}
-        during_thread = threading.Thread(target=_run_during)
-        during_thread.start()
+        during_thread = spawn_thread(_run_during, daemon=False)
     for t in threads:
         t.join()
     run_s = time.perf_counter() - t0

@@ -410,13 +410,13 @@ class PegasusClient:
     _MAX_ASYNC_WORKERS = 8
 
     def _executor(self):
-        import concurrent.futures
+        from ..runtime.tasking import tracked_executor
 
         if self._async_pool is None:
             with self._async_lock:
                 if self._async_pool is None:
-                    self._async_pool = concurrent.futures.ThreadPoolExecutor(
-                        max_workers=self._MAX_ASYNC_WORKERS,
+                    self._async_pool = tracked_executor(
+                        self._MAX_ASYNC_WORKERS,
                         thread_name_prefix="pegasus-async")
         return self._async_pool
 

@@ -13,6 +13,7 @@ import time
 from ..client import MetaResolver, PegasusClient, PegasusError
 from ..rpc.transport import RpcError
 from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
 
 
 class AvailableDetector:
@@ -22,7 +23,7 @@ class AvailableDetector:
         self.table_name = table_name
         self.interval = interval_seconds
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = spawn_thread(self._loop, daemon=True, start=False)
         self._lock = threading.Lock()
         self._window = []  # (ts, ok)
         self.client = None

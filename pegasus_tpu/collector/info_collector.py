@@ -22,8 +22,10 @@ from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG
 from ..rpc import codec
 from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime import lockrank
 from ..runtime.perf_counters import counters
 from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
+from ..runtime.tasking import spawn_thread
 
 
 def rollup_slow_requests(fetch, nodes, last: int = 20) -> list:
@@ -56,7 +58,7 @@ class InfoCollector:
         self.interval = interval_seconds
         self.pool = ConnectionPool()
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = spawn_thread(self._loop, daemon=True, start=False)
         self.hotspots = {}   # app_name -> [pidx...] flagged last round
         self.app_stats = {}  # app_name -> aggregated dict
         self.compact_stats = {}  # cluster-summed compact.*/engine.* counters
@@ -67,14 +69,25 @@ class InfoCollector:
         # collector.app.<name>.hotkey.* counters + self.hotkey_results
         self.hotkey_rounds = hotkey_rounds
         self.hotkey_query_limit = hotkey_query_limit
-        self._hot_streak = {}      # (app_name, pidx) -> consecutive rounds
-        self._detections = {}      # (app_name, pidx) -> in-flight state
-        self.hotkey_results = {}   # app_name -> {pidx: {"kind","key","ts"}}
+        # hotkey-loop bookkeeping below is driven from the collector
+        # timer thread but also reachable through remote commands /
+        # collector-info reads — one leaf lock covers it
+        self._lock = lockrank.named_lock("collector.hotkey")
+        # (app_name, pidx) -> consecutive rounds
+        self._hot_streak = {}      #: guarded_by self._lock
+        # (app_name, pidx) -> in-flight state
+        self._detections = {}      #: guarded_by self._lock
+        # app_name -> {pidx: {"kind","key","ts"}}. WRITES hold the lock;
+        # published copy-on-write (rebound wholesale, never mutated in
+        # place) so lock-free readers (collector-info on an RPC thread)
+        # always iterate a stable snapshot and never block behind a
+        # detection round's RPCs
+        self.hotkey_results = {}   #: guarded_by self._lock
         # read-residency the hotkey loop switched on: (app_name, pidx) ->
         # {"node", "gpid"} — turned off again when the partition calms,
         # closing the loop that decides which partitions' SSTs stay
         # HBM-resident for the device read path (ISSUE 7)
-        self.read_residency = {}
+        self.read_residency = {}  #: guarded_by self._lock
         # cluster-wide observability rollups (ISSUE 8): worst-first top-N
         # slow requests merged across nodes, and the replication-lag
         # worst-offender summary the doctor reads
@@ -272,8 +285,9 @@ class InfoCollector:
                 counters.number(f"collector.app.{app.app_name}.{cname}").set(v)
             flagged = hotspot_partitions(per_partition_qps)
             self.hotspots[app.app_name] = flagged
-            self.drive_hotkey_loop(app.app_name, app.app_id, flagged,
-                                   primaries, read_qps, write_qps)
+            with self._lock:
+                self.drive_hotkey_loop(app.app_name, app.app_id, flagged,
+                                       primaries, read_qps, write_qps)
             summary[app.app_name] = agg
         self.collect_compact_stats(all_nodes)
         self.collect_lag_stats(all_nodes)
@@ -284,6 +298,7 @@ class InfoCollector:
 
     # ------------------------------------------------- closed hotspot loop
 
+    #: requires self._lock
     def drive_hotkey_loop(self, app_name: str, app_id: int, flagged: list,
                           primaries: dict, read_qps: dict = None,
                           write_qps: dict = None) -> None:
@@ -356,9 +371,11 @@ class InfoCollector:
                 continue
             if "hotkey:" in out:
                 hotkey = out.split("hotkey:", 1)[1].strip()
-                self.hotkey_results.setdefault(app_name, {})[pidx] = {
-                    "kind": det["kind"], "key": hotkey,
-                    "ts": time.time()}
+                per_app = dict(self.hotkey_results.get(app_name, {}))
+                per_app[pidx] = {"kind": det["kind"], "key": hotkey,
+                                 "ts": time.time()}
+                self.hotkey_results = {**self.hotkey_results,
+                                       app_name: per_app}
                 counters.rate(
                     f"collector.app.{app_name}.hotkey.found_count").increment()
                 counters.number(
@@ -381,6 +398,7 @@ class InfoCollector:
             f"collector.app.{app_name}.hotkey.active_detections").set(
             sum(1 for k in self._detections if k[0] == app_name))
 
+    #: requires self._lock
     def _set_read_residency(self, app_name: str, pidx: int, on: bool,
                             node: str = None, gpid: str = None) -> None:
         """Flip one partition's device read residency on its primary via
@@ -404,15 +422,18 @@ class InfoCollector:
             # so the next calm round resends the release — the server's
             # flag must not stay hot because one RPC was dropped
             return
+        # copy-on-write publish (see hotkey_results): readers are free
+        rr = dict(self.read_residency)
         if on:
-            self.read_residency[key] = target
+            rr[key] = target
         else:
-            self.read_residency.pop(key, None)
+            rr.pop(key, None)
+        self.read_residency = rr
         counters.number(
             f"collector.app.{app_name}.hotkey.{pidx}.device_resident").set(
             1 if on else 0)
 
-    def _finish_detection(self, key, det, stop: bool = True) -> None:
+    def _finish_detection(self, key, det, stop: bool = True) -> None:  #: requires self._lock
         self._detections.pop(key, None)
         self._hot_streak.pop(key, None)
         if stop:

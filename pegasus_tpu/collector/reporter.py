@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -98,8 +99,8 @@ class CounterReporter:
 
         self._srv = ThreadingHTTPServer((host, port), Handler)
         self.address = self._srv.server_address
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        self._thread = spawn_thread(self._srv.serve_forever, daemon=True,
+                                    start=False)
 
     def start(self):
         self._thread.start()

@@ -48,6 +48,7 @@ from ..base.key_schema import key_hash
 from ..base.utils import epoch_now
 from ..base.value_schema import check_if_ts_expired
 from ..runtime.fail_points import fail_point
+from ..runtime import lockrank
 from ..ops.compact import CompactOptions, compact_blocks, sort_block
 from .block import KVBlock
 from .memtable import Memtable
@@ -145,10 +146,11 @@ class _HbmGauges:
     Leaf lock: never takes an engine lock (callers may hold theirs)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._per_engine = {}  # id(engine) -> (budget, used_bytes, ssts)
+        self._lock = lockrank.named_lock("engine.hbm_gauges")
+        # id(engine) -> (budget, used_bytes, ssts)
+        self._per_engine = {}  #: guarded_by self._lock
 
-    def _publish_locked(self):
+    def _publish_locked(self):  #: requires self._lock
         from ..runtime.perf_counters import counters
 
         vals = list(self._per_engine.values())
@@ -194,42 +196,52 @@ class LsmEngine:
     def __init__(self, path: str, options: EngineOptions = None):
         self.path = path
         self.opts = options or EngineOptions()
-        self._lock = threading.RLock()
-        self._mem = Memtable()
-        self._imm = []          # immutable memtables pending flush, newest first
-        self._l0 = []           # list[SSTable], newest first
-        self._levels = {}       # level(int>=1) -> list[SSTable] sorted by min_key
-        self._meta = {}         # the meta-CF equivalent (live, unflushed view)
-        self._next_file = 1
-        self._last_committed_decree = 0
-        self._durable_decree = 0
-        self._compact_round = {}  # level -> round-robin cursor for cascades
+        self._lock = lockrank.named_rlock("engine.lock")
+        self._mem = Memtable()  #: guarded_by self._lock
+        # immutable memtables pending flush, newest first
+        self._imm = []          #: guarded_by self._lock
+        # list[SSTable], newest first
+        self._l0 = []           #: guarded_by self._lock
+        # level(int>=1) -> list[SSTable] sorted by min_key
+        self._levels = {}       #: guarded_by self._lock
+        # the meta-CF equivalent (live, unflushed view)
+        self._meta = {}         #: guarded_by self._lock
+        self._next_file = 1     #: guarded_by self._lock
+        self._last_committed_decree = 0  #: guarded_by self._lock
+        self._durable_decree = 0         #: guarded_by self._lock
+        # level -> round-robin cursor for cascades
+        self._compact_round = {}  #: guarded_by self._lock
         # serializes checkpoint create/rename/GC (the shared checkpoint.tmp
         # dir would otherwise race between the maintenance timer and RPC
         # threads); RLock so callers can hold it across create+consume
-        self.checkpoint_lock = threading.RLock()
-        self._flush_lock = threading.Lock()  # one flush drainer at a time
+        self.checkpoint_lock = lockrank.named_rlock("engine.checkpoint")
+        # one flush drainer at a time
+        self._flush_lock = lockrank.named_lock("engine.flush")
         # serializes compact()/_maybe_cascade()/manual_compact() merge
         # phases: two concurrent merges over overlapping input snapshots
         # would write the same records into two output sets and double-
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
-        self._compaction_lock = threading.RLock()
-        self._device_cache_used = 0  # bytes of HBM pinned by resident runs
-        self._device_resident_ssts = 0  # files currently holding a run
+        self._compaction_lock = lockrank.named_rlock("engine.compaction")
+        # bytes of HBM pinned by resident runs
+        self._device_cache_used = 0  #: guarded_by self._lock
+        # files currently holding a run
+        self._device_resident_ssts = 0  #: guarded_by self._lock
         # read-residency policy flag (collector hotkey loop drives it via
         # the set-read-residency remote command): hot partitions keep
         # their SSTs primed so point reads hit the device path
-        self._read_hot = False
+        self._read_hot = False  #: guarded_by self._lock
         # same-SST prime coordination (see _device_run_budgeted): waiters
         # block on this until the in-flight prime finishes and notifies
-        self._prime_cv = threading.Condition(self._lock)
+        self._prime_cv = lockrank.named_condition("engine.prime_cv",
+                                                  self._lock)
         # deferred (pipelined) installs: futures for in-flight pool work,
         # consumed-input files awaiting unlink, and the manifest-write
         # debt (see _install_merge_deferred for the durability invariant)
-        self._pending_installs = []
-        self._pending_unlinks = []
-        self._manifest_dirty = False
-        self._resolved_mesh = _UNRESOLVED  # lazy sharded-compaction mesh
+        self._pending_installs = []  #: guarded_by self._lock
+        self._pending_unlinks = []   #: guarded_by self._lock
+        self._manifest_dirty = False  #: guarded_by self._lock
+        # lazy sharded-compaction mesh
+        self._resolved_mesh = _UNRESOLVED  #: guarded_by self._compaction_lock
         # device-read knobs resolved ONCE (the coalescer consults them on
         # every point read — no per-get environ parse); the backend check
         # stays dynamic because app-envs can flip it at runtime
@@ -250,17 +262,17 @@ class LsmEngine:
 
     @property
     def meta_store(self) -> dict:
-        return self._meta
+        return self._meta  #: unguarded_ok ref snapshot: callers get the live dict by design (reference meta-CF semantics)
 
     def last_durable_decree(self) -> int:
         """Decree covered by on-disk SSTs (manifest's last_flushed_decree)."""
-        return int(self._durable_meta.get(META_LAST_FLUSHED_DECREE, 0))
+        return int(self._durable_meta.get(META_LAST_FLUSHED_DECREE, 0))  #: unguarded_ok ref snapshot of a dict REPLACED wholesale under the lock; monotone durable watermark
 
     def last_committed_decree(self) -> int:
-        return self._last_committed_decree
+        return self._last_committed_decree  #: unguarded_ok racy read of a monotone int (gauges, decree hints)
 
     def data_version(self) -> int:
-        return int(self._meta.get(META_DATA_VERSION, self.opts.data_version))
+        return int(self._meta.get(META_DATA_VERSION, self.opts.data_version))  #: unguarded_ok data_version is written once at open
 
     # ----------------------------------------------------------------- write
 
@@ -346,11 +358,11 @@ class LsmEngine:
             self._drain_imms()
 
     def put(self, key: bytes, value: bytes, expire_ts: int = 0, decree: int = None):
-        d = decree if decree is not None else self._last_committed_decree + 1
+        d = decree if decree is not None else self._last_committed_decree + 1  #: unguarded_ok single-writer convenience path (tests/tools); replication always passes the decree
         self.write(WriteBatch().put(key, value, expire_ts), d)
 
     def delete(self, key: bytes, decree: int = None):
-        d = decree if decree is not None else self._last_committed_decree + 1
+        d = decree if decree is not None else self._last_committed_decree + 1  #: unguarded_ok single-writer convenience path (tests/tools); replication always passes the decree
         self.write(WriteBatch().delete(key), d)
 
     # ------------------------------------------------------------------ read
@@ -409,12 +421,16 @@ class LsmEngine:
         this pin claims; see _device_run_budgeted). Off only clears the
         flag: resident runs stay (compaction still wants them) and age
         out through the normal merge lifecycle."""
-        self._read_hot = bool(on)
-        if on and self.opts.backend == "tpu":
-            with self._lock:
-                ssts = self._all_ssts_locked()
-            for sst in ssts:
-                self._prime_async(sst)
+        with self._lock:
+            # under the engine lock: _device_run_budgeted reads the flag
+            # to size the prime budget, and an unlocked flip could let a
+            # cold prime claim the reserved read-hot headroom mid-check
+            # (caught by tools/analyze lock_discipline)
+            self._read_hot = bool(on)
+            ssts = self._all_ssts_locked() \
+                if on and self.opts.backend == "tpu" else []
+        for sst in ssts:
+            self._prime_async(sst)
 
     def get_batch(self, keys, now=None) -> list:
         """Batched point lookup, semantically identical to
@@ -665,16 +681,29 @@ class LsmEngine:
         """Flush pending immutables oldest-first. The flush lock serializes
         concurrent drainers (writer threads + explicit flush calls): without
         it two threads could flush the same memtable, or a newer one could
-        reach disk first and falsely advance the durable decree."""
+        reach disk first and falsely advance the durable decree.
+
+        The L0 compaction trigger fires AFTER the flush lock is released:
+        lockrank caught the inversion — compaction under the flush lock
+        orders flush->compaction, while batched_manual_compact flushes
+        engine i+1 with engine i's compaction lock held
+        (compaction->flush), a deadlock waiting for the interleaving —
+        and holding the flush lock across a whole compaction convoyed
+        every writer behind it anyway."""
+        drained = False
         with self._flush_lock:
             while True:
                 with self._lock:
                     if not self._imm:
-                        return
+                        break
                     imm = self._imm[-1]  # list is newest-first: take oldest
                 self._flush_one(imm)
+                drained = True
+        if drained and \
+                len(self._l0) >= self.opts.l0_compaction_trigger:  #: unguarded_ok racy trigger check: compact() re-snapshots under its locks; worst case is one early/late compaction
+            self.compact()
 
-    def _rotate_memtable_locked(self):
+    def _rotate_memtable_locked(self):  #: requires self._lock
         if len(self._mem) == 0:
             return
         self._imm.insert(0, self._mem)
@@ -712,8 +741,6 @@ class LsmEngine:
             # hold strictly later decrees (ADVICE r1 high)
             self._durable_decree = max(self._durable_decree, imm.last_decree)
             self._write_manifest_locked()
-        if len(self._l0) >= self.opts.l0_compaction_trigger:
-            self.compact()
 
     def _prime_async(self, sst):
         """Fire-and-forget device-residency prime on the pipeline pool.
@@ -828,7 +855,7 @@ class LsmEngine:
 
     def _bottommost(self, target_level: int) -> bool:
         """Tombstones may only drop when no lower level could hold the key."""
-        deeper = any(self._levels.get(lv) for lv in
+        deeper = any(self._levels.get(lv) for lv in  #: unguarded_ok level membership only changes under the compaction lock, which every caller holds; flush only touches L0
                      range(target_level + 1, self.opts.max_levels + 1))
         return not deeper
 
@@ -855,7 +882,7 @@ class LsmEngine:
             self._drain_pending_installs()
             return stats
 
-    def _overlapping_locked(self, level: int, lo: bytes, hi: bytes):
+    def _overlapping_locked(self, level: int, lo: bytes, hi: bytes):  #: requires self._lock
         out = []
         for f in self._levels.get(level, []):
             if f.n == 0 or lo is None:
@@ -889,13 +916,13 @@ class LsmEngine:
                                          now=now, deferred=True)
             self._drain_pending_installs()
 
-    def _level_bytes(self, lv: int) -> int:
+    def _level_bytes(self, lv: int) -> int:  #: requires self._lock
         return sum(s.data_bytes for s in self._levels.get(lv, []))
 
     def _level_budget(self, lv: int) -> int:
         return self.opts.level_base_bytes * (self.opts.level_size_ratio ** (lv - 1))
 
-    def _sharded_mesh(self):
+    def _sharded_mesh(self):  #: requires self._compaction_lock
         """Mesh for multi-chip manual compaction, or None when the engine
         should stay single-chip (knob off, or <2 devices visible)."""
         if self.opts.compaction_mesh is not None:
@@ -925,7 +952,7 @@ class LsmEngine:
 
     def _merge_to_level(self, newer_files, older_files, target_level: int,
                         bottommost: bool, now=None, sharded: bool = False,
-                        deferred: bool = False) -> dict:
+                        deferred: bool = False) -> dict:  #: requires self._compaction_lock
         """Merge newer_files (recency order) over older_files into
         target_level, splitting output at target_file_size_bytes.
         sharded=True (manual_compact only) routes through the multi-chip
@@ -971,7 +998,7 @@ class LsmEngine:
 
     def _install_merge_output(self, newer_files, older_files, out_block,
                               target_level: int,
-                              deferred: bool = False) -> None:
+                              deferred: bool = False) -> None:  #: requires self._compaction_lock
         """Write + atomically swap a merge's output over its inputs —
         shared by _merge_to_level and the node-level batched compaction
         (replica_stub.batched_manual_compact). Caller holds the engine's
@@ -989,7 +1016,7 @@ class LsmEngine:
             with self._lock:
                 path = os.path.join(self.path, self._alloc_file_locked())
             write_sst(path, ob, {"level": target_level,
-                                 "last_flushed_decree": self._durable_decree},
+                                 "last_flushed_decree": self._durable_decree},  #: unguarded_ok monotone watermark snapshot; the manifest (written under the lock) is authoritative
                       compression=self.opts.compression)
             sst = SSTable(path)
             sst._block = ob  # already in memory: skip the disk re-read
@@ -1011,7 +1038,7 @@ class LsmEngine:
             except OSError:
                 pass
 
-    def _swap_levels_locked(self, inputs, new_ssts, target_level: int):
+    def _swap_levels_locked(self, inputs, new_ssts, target_level: int):  #: requires self._lock
         """Swap the new files in and every input file out atomically —
         inputs may come from L0 and any level (manual compact); readers
         that snapshotted before this keep their (cached) SSTables."""
@@ -1028,7 +1055,7 @@ class LsmEngine:
                                     if id(f) not in gone]
 
     def _install_merge_deferred(self, inputs, out_blocks,
-                                target_level: int) -> None:
+                                target_level: int) -> None:  #: requires self._compaction_lock
         """Pipelined install: swap the outputs into the level structure
         NOW (in-memory SSTables serving reads from their cached blocks)
         and move the disk work — write_sst, the device-residency prime,
@@ -1043,7 +1070,7 @@ class LsmEngine:
         from ..ops.pipeline import submit_install
 
         meta = {"level": target_level,
-                "last_flushed_decree": self._durable_decree}
+                "last_flushed_decree": self._durable_decree}  #: unguarded_ok monotone watermark snapshot; the manifest (written under the lock) is authoritative
         new_ssts = []
         for ob in out_blocks:
             with self._lock:
@@ -1196,8 +1223,12 @@ class LsmEngine:
                                                  bottommost=bottommost,
                                                  now=now, sharded=True)
                 stats = dict(stats, trace=sess.summary())
-        self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = int(time.time())
         with self._lock:
+            # under the engine lock: concurrent writers update _meta's
+            # decree key through write()/write_batch() (caught by
+            # tools/analyze lock_discipline)
+            self._meta[META_LAST_MANUAL_COMPACT_FINISH_TIME] = \
+                int(time.time())
             self._write_manifest_locked()
         return stats
 
@@ -1211,12 +1242,12 @@ class LsmEngine:
         with self._lock:
             path = os.path.join(self.path, self._alloc_file_locked())
         write_sst(path, block, {"level": 0, "ingested": True,
-                                "last_flushed_decree": self._durable_decree},
+                                "last_flushed_decree": self._durable_decree},  #: unguarded_ok monotone watermark snapshot; the manifest (written under the lock) is authoritative
                   compression=self.opts.compression)
         with self._lock:
             self._l0.insert(0, SSTable(path))
             self._write_manifest_locked()
-        if len(self._l0) >= self.opts.l0_compaction_trigger:
+        if len(self._l0) >= self.opts.l0_compaction_trigger:  #: unguarded_ok racy trigger check: compact() re-snapshots under its locks; worst case is one early/late compaction
             self.compact()
 
     # ------------------------------------------------------------- checkpoint
@@ -1279,9 +1310,9 @@ class LsmEngine:
         if not self.checkpoint_lock.acquire(blocking=False):
             return None  # a checkpoint is already in flight
         self.checkpoint_lock.release()
-        t = threading.Thread(target=self.sync_checkpoint, kwargs={"flush": False},
-                             daemon=True)
-        t.start()
+        from ..runtime.tasking import spawn_thread
+
+        t = spawn_thread(self.sync_checkpoint, flush=False, daemon=True)
         return t
 
     def list_checkpoints(self) -> list:
@@ -1344,18 +1375,18 @@ class LsmEngine:
 
     # -------------------------------------------------------------- manifest
 
-    def _all_ssts_locked(self):
+    def _all_ssts_locked(self):  #: requires self._lock
         out = list(self._l0)
         for lv in sorted(self._levels):
             out.extend(self._levels[lv])
         return out
 
-    def _alloc_file_locked(self) -> str:
+    def _alloc_file_locked(self) -> str:  #: requires self._lock
         name = f"{self._next_file:06d}.sst"
         self._next_file += 1
         return name
 
-    def _manifest_dict_locked(self) -> dict:
+    def _manifest_dict_locked(self) -> dict:  #: requires self._lock
         meta = {k: v for k, v in self._meta.items()}
         meta[META_LAST_FLUSHED_DECREE] = self._durable_decree
         return {
@@ -1366,7 +1397,7 @@ class LsmEngine:
             "meta": meta,
         }
 
-    def _write_manifest_locked(self):
+    def _write_manifest_locked(self):  #: requires self._lock
         if any(not s._on_disk for s in self._all_ssts_locked()):
             # deferred installs in flight: the manifest must never
             # reference a file that has not fully landed — the last
@@ -1381,9 +1412,9 @@ class LsmEngine:
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.path, MANIFEST))
         self._manifest_dirty = False  # only after the replace landed
-        self._durable_meta = dict(data["meta"])
+        self._durable_meta = dict(data["meta"])  #: guarded_by self._lock
 
-    def _load_manifest(self):
+    def _load_manifest(self):  #: unguarded_ok construction-time: called only from __init__, before the engine is published to any other thread
         mpath = os.path.join(self.path, MANIFEST)
         if not os.path.exists(mpath):
             self._meta = {META_DATA_VERSION: self.opts.data_version}

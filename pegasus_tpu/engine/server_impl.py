@@ -18,6 +18,7 @@ import time
 from ..base import consts, key_schema
 from ..base.utils import epoch_now
 from ..base.value_schema import SCHEMAS
+from ..runtime import lockrank
 from ..runtime.perf_counters import counters
 from ..runtime.tracing import REQUEST_TRACER
 from ..rpc import messages as msg
@@ -89,9 +90,9 @@ class _ReadCoalescer:
         self.engine = engine
         self.max_batch = max_batch if max_batch is not None else \
             max(1, int(os.environ.get("PEGASUS_READ_BATCH_N", "64")))
-        self._lock = threading.Lock()
-        self._queue = []
-        self._draining = False
+        self._lock = lockrank.named_lock("read.coalescer")
+        self._queue = []        #: guarded_by self._lock
+        self._draining = False  #: guarded_by self._lock
         # hot-path counter resolved once (PR 6's rule: the registry lock
         # is per-lookup, and this fires on every point read)
         self._c_batch_size = counters.percentile("read.batch.size")

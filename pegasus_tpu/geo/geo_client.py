@@ -62,7 +62,9 @@ class GeoClient:
         if self._pool is None:
             with self._pool_lock:
                 if self._pool is None:
-                    self._pool = ThreadPoolExecutor(
+                    from ..runtime.tasking import tracked_executor
+
+                    self._pool = tracked_executor(
                         self.scan_threads, thread_name_prefix="geo-scan")
         return self._pool
 

@@ -37,6 +37,8 @@ import os
 import threading
 import time
 
+from ..runtime.tasking import spawn_thread
+
 
 class MetaElection:
     def __init__(self, lock_path: str, my_addr: str,
@@ -62,8 +64,8 @@ class MetaElection:
         self.epoch = 0  # fencing token: the epoch we claimed under
         self._stop = threading.Event()
         self._started = False
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"meta-election:{my_addr}")
+        self._thread = spawn_thread(self._loop, daemon=True, start=False,
+                                    name=f"meta-election:{my_addr}")
 
     # ------------------------------------------------------------- queries
 

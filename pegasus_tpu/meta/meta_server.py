@@ -19,6 +19,7 @@ import threading
 import time
 
 from ..rpc import codec
+from ..runtime.tasking import spawn_thread
 from ..rpc.transport import (ConnectionPool, ERR_FORWARD_TO_PRIMARY,
                              ERR_INVALID_STATE, ERR_OBJECT_NOT_FOUND, RpcError)
 from . import messages as mm
@@ -544,8 +545,7 @@ class MetaServer:
         with self._lock:
             self._bulk_loads[app.app_id] = sess
         if req.async_start:
-            threading.Thread(target=self._bulk_load_worker,
-                             args=(app, sess), daemon=True).start()
+            spawn_thread(self._bulk_load_worker, app, sess, daemon=True)
             return codec.encode(mm.StartBulkLoadResponse())
         self._bulk_load_worker(app, sess)
         if sess["status"] != "succeed":

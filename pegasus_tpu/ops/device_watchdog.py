@@ -110,7 +110,11 @@ class DeviceHealthWatchdog:
             except Exception as e:  # noqa: BLE001 - a probe error IS the signal
                 result["error"] = repr(e)
 
-        t = threading.Thread(target=run, daemon=True, name="device-probe")
+        from ..runtime.tasking import spawn_thread
+
+        # never joined on timeout by design: a wedged TPU-attached probe
+        # is abandoned, not killed (the registry still tracks it)
+        t = spawn_thread(run, daemon=True, name="device-probe", start=False)
         with self._lock:
             self._probe_thread = t
         t0 = time.perf_counter()
@@ -187,9 +191,12 @@ class DeviceHealthWatchdog:
         with self._lock:
             if self._loop_thread is not None and self._loop_thread.is_alive():
                 return self
+            from ..runtime.tasking import spawn_thread
+
             self._stop.clear()
-            self._loop_thread = threading.Thread(
-                target=self._loop, daemon=True, name="device-watchdog")
+            self._loop_thread = spawn_thread(
+                self._loop, daemon=True, name="device-watchdog",
+                start=False)
         self._loop_thread.start()
         return self
 

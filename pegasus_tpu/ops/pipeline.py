@@ -48,6 +48,7 @@ import os
 import threading
 import time
 
+from ..runtime import lockrank
 from ..runtime.fail_points import inject as _inject
 from ..runtime.perf_counters import counters
 from ..runtime.tasking import ThreadPool
@@ -69,9 +70,9 @@ def pipeline_depth() -> int:
     return max(1, d)
 
 
-_POOL = None
-_IO_POOL = None
-_POOL_LOCK = threading.Lock()
+_POOL = None     #: guarded_by _POOL_LOCK
+_IO_POOL = None  #: guarded_by _POOL_LOCK
+_POOL_LOCK = lockrank.named_lock("pipeline.pool_global")
 
 
 def pipeline_pool() -> ThreadPool:

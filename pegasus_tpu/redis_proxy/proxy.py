@@ -14,6 +14,7 @@ import threading
 
 from ..client import PegasusClient, PegasusError
 from ..geo.geo_client import GeoClient
+from ..runtime.tasking import spawn_thread
 
 EMPTY_SK = b""
 
@@ -116,8 +117,8 @@ class RedisProxy:
 
         self._srv = Server((host, port), Handler)
         self.address = self._srv.server_address
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        self._thread = spawn_thread(self._srv.serve_forever, daemon=True,
+                                    start=False)
 
     def start(self):
         self._thread.start()

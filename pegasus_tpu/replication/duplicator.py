@@ -21,6 +21,7 @@ from ..engine.server_impl import RPC_DUPLICATE
 from ..rpc import codec
 from ..rpc import messages as msg
 from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime.tasking import spawn_thread
 from .mutation_log import LogMutation
 
 
@@ -58,8 +59,7 @@ class MutationDuplicator:
         self.last_shipped_decree = max(self._load_progress(), confirmed_floor)
         self._saved_decree = self.last_shipped_decree
         self._saved_at = 0.0
-        self._thread = threading.Thread(target=self._ship_loop, daemon=True)
-        self._thread.start()
+        self._thread = spawn_thread(self._ship_loop, daemon=True)
 
     # ------------------------------------------------------------- progress
 

@@ -72,6 +72,7 @@ from dataclasses import dataclass, field
 from typing import List
 
 from ..rpc import codec
+from ..runtime import lockrank
 from ..runtime.fail_points import inject
 from ..runtime.perf_counters import counters
 from ..runtime.tracing import REQUEST_TRACER
@@ -126,17 +127,22 @@ class MutationLog:
         # hanging the partition
         self._stall_s = float(
             os.environ.get("PEGASUS_PLOG_GROUP_STALL_MS", 500)) / 1e3
-        self._lock = threading.Lock()
-        self._gcv = threading.Condition()
-        self._gbuf = []            # unclaimed _GroupEntry, submit order
-        self._gleader = False      # a leader is writing a group
-        self._degraded_until = 0.0  # monotonic ts; bypass grouping until
-        self.append_count = 0      # monotonic totals (instance-level, so
-        self.flush_count = 0       # tests can assert the grouping ratio)
-        self._file = None
-        self._file_start = None
-        self._file_bytes = 0
-        self.last_decree = 0
+        self._lock = lockrank.named_lock("plog.file")
+        self._gcv = lockrank.named_condition("plog.group")
+        # unclaimed _GroupEntry, submit order
+        self._gbuf = []            #: guarded_by self._gcv
+        # a leader is writing a group
+        self._gleader = False      #: guarded_by self._gcv
+        # monotonic ts; bypass grouping until
+        self._degraded_until = 0.0  #: guarded_by self._gcv
+        # monotonic totals (instance-level, so tests can assert the
+        # grouping ratio)
+        self.append_count = 0      #: guarded_by self._lock
+        self.flush_count = 0       #: guarded_by self._lock
+        self._file = None          #: guarded_by self._lock
+        self._file_start = None    #: guarded_by self._lock
+        self._file_bytes = 0       #: guarded_by self._lock
+        self.last_decree = 0       #: guarded_by self._lock
         os.makedirs(log_dir, exist_ok=True)
         self._segments = self._scan_segments()
         if self._segments:
@@ -169,7 +175,7 @@ class MutationLog:
         nbytes = sum(len(f) for f in entry.frames)
         with REQUEST_TRACER.span("plog.append", decree=entry.decrees[-1],
                                  bytes=nbytes, batch=len(entry.frames)):
-            if time.monotonic() < self._degraded_until:
+            if time.monotonic() < self._degraded_until:  #: unguarded_ok racy read of a monotonic degrade hint: worst case one extra grouped (or degraded) append
                 # a recent group leader wedged: per-append fallback keeps
                 # the partition moving (groups resume after the cooldown)
                 self._write_group([entry])
@@ -231,7 +237,7 @@ class MutationLog:
                         b.done = True
                     self._gcv.notify_all()
 
-    def _claim_locked(self, batch: list) -> list:
+    def _claim_locked(self, batch: list) -> list:  #: requires self._gcv
         """Move buffered entries into `batch` up to the group_n cap.
         Caller holds self._gcv."""
         total = sum(len(b.frames) for b in batch)
@@ -279,7 +285,7 @@ class MutationLog:
         counters.rate("plog.append.flush_count").increment()
         counters.percentile("plog.append.group_size").set(n_frames)
 
-    def _roll_locked(self, start_decree: int) -> None:
+    def _roll_locked(self, start_decree: int) -> None:  #: requires self._lock
         if self._file:
             self._file.close()
         name = f"log.{start_decree}"

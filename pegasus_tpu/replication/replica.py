@@ -37,6 +37,7 @@ from ..engine import EngineOptions
 from ..engine.replica_service import WRITE_CODES
 from ..engine.server_impl import PegasusServer
 from ..rpc import codec
+from ..runtime import lockrank
 from ..runtime.perf_counters import counters
 from ..runtime.tracing import REQUEST_TRACER
 from .mutation_log import LogMutation, MutationLog
@@ -102,23 +103,25 @@ class Replica:
         self.pidx = pidx
         self.quorum = quorum
         self.peers = peers or (lambda n: (_ for _ in ()).throw(ConnectionError(n)))
-        self._lock = threading.RLock()
-        self.status = INACTIVE
-        self.ballot = 0
-        self.view = None
+        self._lock = lockrank.named_rlock("replica.lock")
+        self.status = INACTIVE  #: guarded_by self._lock
+        self.ballot = 0         #: guarded_by self._lock
+        self.view = None        #: guarded_by self._lock
         self.server = PegasusServer(os.path.join(path, "data"), app_id=app_id,
                                     pidx=pidx, options=options, server=name)
         self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
-        self._uncommitted = {}   # decree -> LogMutation (prepared, not applied)
-        self._batch_cv = threading.Condition()
-        self._batch_pending = []      # _WriteSlots awaiting a group commit
-        self._batch_leader_active = False
+        # decree -> LogMutation (prepared, not applied)
+        self._uncommitted = {}   #: guarded_by self._lock
+        self._batch_cv = lockrank.named_condition("replica.batch")
+        # _WriteSlots awaiting a group commit
+        self._batch_pending = []  #: guarded_by self._batch_cv
+        self._batch_leader_active = False  #: guarded_by self._batch_cv
         self.commit_hooks = []   # fn(LogMutation) after commit (duplication)
         self.duplicators = {}    # dupid -> MutationDuplicator (stub-managed)
         self.app_name = ""       # set by the stub at open
         self.partition_count = 0
-        self.last_committed = self.server.engine.last_committed_decree()
-        self.last_prepared = self.last_committed
+        self.last_committed = self.server.engine.last_committed_decree()  #: guarded_by self._lock
+        self.last_prepared = self.last_committed  #: guarded_by self._lock
         self._prep_pool = None
         # replication-lag plane (ISSUE 8): per-partition gauges resolved
         # ONCE (the registry lock is per-lookup and these fire per window)
@@ -132,15 +135,15 @@ class Replica:
 
     def _prepare_pool(self):
         if self._prep_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+            from ..runtime.tasking import tracked_executor
 
-            self._prep_pool = ThreadPoolExecutor(
+            self._prep_pool = tracked_executor(
                 4, thread_name_prefix=f"prep-{self.name}")
         return self._prep_pool
 
     # ----------------------------------------------------------- recovery
 
-    def _recover_from_log(self):
+    def _recover_from_log(self):  #: unguarded_ok construction-time: called only from __init__, before the replica is published to any other thread
         """Re-stage every logged mutation after the engine's committed point.
         They stay uncommitted until a view tells us our role (a new primary
         commits them all; a learner discards and re-seeds)."""
@@ -212,7 +215,7 @@ class Replica:
             raise slot.err
         return slot.resp
 
-    def _commit_window(self, slots, now=None):
+    def _commit_window(self, slots, now=None):  #: requires self._lock
         """One contiguous decree window for `slots` (one decree each);
         caller holds self._lock. Fills each slot's resp/err in place."""
         if self.status != PRIMARY:
@@ -284,7 +287,7 @@ class Replica:
                 s.err = ReplicaError(
                     f"quorum lost: decree {d} prepared but not committed")
 
-    def _export_gauges(self):
+    def _export_gauges(self):  #: requires self._lock
         """Per-partition write-path pressure + replication-lag plane:
         slots queued for the next group commit (inflight),
         prepared-but-uncommitted decrees (backlog), and the
@@ -293,7 +296,7 @@ class Replica:
         engine actually applied; they diverge exactly when a replica is
         behind on APPLY (mid-window engine failure) rather than behind on
         commit, which is the distinction the cluster doctor reports."""
-        self._c_inflight.set(len(self._batch_pending))
+        self._c_inflight.set(len(self._batch_pending))  #: unguarded_ok gauge snapshot of the queue length; the cv would add contention to every write for a stat
         self._c_backlog.set(len(self._uncommitted))
         self._c_committed.set(self.last_committed)
         self._c_applied.set(self.server.engine.last_committed_decree())
@@ -317,9 +320,9 @@ class Replica:
         """One prepare round against a peer object: windowed when the peer
         supports it, per-mutation for a legacy peer. -> acked decree."""
         if hasattr(peer, "on_prepare_batch"):
-            return peer.on_prepare_batch(self.ballot, ms, self.last_committed)
+            return peer.on_prepare_batch(self.ballot, ms, self.last_committed)  #: unguarded_ok stable during the fan-out: every ballot/commit-point writer needs self._lock, which the window leader holds until all prepare workers return
         for m in ms:
-            peer.on_prepare(self.ballot, m, self.last_committed)
+            peer.on_prepare(self.ballot, m, self.last_committed)  #: unguarded_ok stable during the fan-out (see on_prepare_batch above)
         return ms[-1].decree
 
     def _catch_up_peer(self, peer, peer_prepared: int, ms: list):
@@ -338,8 +341,8 @@ class Replica:
                 chunks = [ordered[i:i + 64]
                           for i in range(0, len(ordered), 64)] + [ms]
             if hasattr(peer, "on_prepare_windows"):
-                return peer.on_prepare_windows(self.ballot, chunks,
-                                               self.last_committed)
+                return peer.on_prepare_windows(
+                    self.ballot, chunks, self.last_committed)  #: unguarded_ok stable during the fan-out (see on_prepare_batch above)
             lp = None
             for chunk in chunks:
                 lp = self._peer_prepare(peer, chunk)
@@ -438,7 +441,7 @@ class Replica:
 
     # ---------------------------------------------------------------- apply
 
-    def _apply_up_to(self, decree: int, now: int = None):
+    def _apply_up_to(self, decree: int, now: int = None):  #: requires self._lock
         """Commit staged mutations in order through the storage engine —
         the whole contiguous window in ONE batched engine call
         (on_batched_write_window: consecutive batchable decrees share one

@@ -22,6 +22,7 @@ from ..meta.meta_server import (RPC_CLOSE_REPLICA, RPC_FD_BEACON,
 from ..rpc import codec
 from ..rpc.transport import (ConnectionPool, ERR_INVALID_STATE,
                              ERR_OBJECT_NOT_FOUND, RpcError, RpcServer)
+from ..runtime.tasking import spawn_thread
 from .mutation_log import LogMutation
 from .replica import GroupView, PRIMARY, PrepareRejected, Replica, ReplicaError
 
@@ -185,13 +186,13 @@ class ReplicaStub:
             srv.bind(self.group_spec["control_path"])
             srv.listen(2)
             self._adoption_srv = srv
-            threading.Thread(target=self._adoption_loop, daemon=True).start()
+            spawn_thread(self._adoption_loop, daemon=True)
         self._stop = threading.Event()
         self._beacon_threads = {}  # meta addr -> in-flight ping thread
-        self._beacon_thread = threading.Thread(target=self._beacon_loop,
-                                               daemon=True)
-        self._maint_thread = threading.Thread(target=self._maintenance_loop,
-                                              daemon=True)
+        self._beacon_thread = spawn_thread(self._beacon_loop, daemon=True,
+                                           start=False)
+        self._maint_thread = spawn_thread(self._maintenance_loop,
+                                          daemon=True, start=False)
 
     def start(self, beacon_interval: float = 1.0,
               maintenance_interval: float = 60.0) -> "ReplicaStub":
@@ -350,8 +351,8 @@ class ReplicaStub:
             prev = self._beacon_threads.get(m)
             if prev is not None and prev.is_alive():
                 continue
-            t = threading.Thread(target=ping, args=(m,), daemon=True,
-                                 name=f"beacon:{self.address}->{m}")
+            t = spawn_thread(ping, m, daemon=True, start=False,
+                             name=f"beacon:{self.address}->{m}")
             self._beacon_threads[m] = t
             threads.append(t)
         for t in threads:

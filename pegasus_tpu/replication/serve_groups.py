@@ -56,7 +56,9 @@ from ..meta import messages as mm
 from ..rpc import codec
 from ..rpc.transport import (ConnectionPool, ERR_NETWORK_FAILURE,
                              RpcConnection, RpcError, RpcHeader, _send_frame)
+from ..runtime import lockrank
 from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
 
 RPC_GROUP_STATE = "RPC_GROUP_STATE"  # worker -> parent beacon fragment
 
@@ -137,7 +139,7 @@ class _Worker:
         self.proc = None
         self.port = 0          # worker's real localhost RPC port
         self.ctrl = None       # unix-socket control conn (handoffs ride it)
-        self.ctrl_lock = threading.Lock()
+        self.ctrl_lock = lockrank.named_lock("serve_groups.ctrl")
         self.ctrl_ok = True    # False after a failed/timed-out handoff:
         # the channel may be desynced, so no further handoffs — relay
         # still serves everything; restart_group builds a fresh channel
@@ -184,8 +186,9 @@ class GroupedReplicaNode:
                         f"{self._listener.getsockname()[1]}")
         self._ctrl_dir = tempfile.mkdtemp(prefix="pegasus_grp_")
         self._workers = [_Worker(g) for g in range(self.groups)]
-        self._open_cache = {}     # (app_id, pidx) -> open-replica body bytes
-        self._lock = threading.Lock()
+        self._lock = lockrank.named_lock("serve_groups.node")
+        # (app_id, pidx) -> open-replica body bytes
+        self._open_cache = {}     #: guarded_by self._lock
         self.pool = ConnectionPool()   # beacons to the metas
         self._stop = threading.Event()
         self._threads = []
@@ -204,7 +207,7 @@ class GroupedReplicaNode:
     def start(self, beacon_interval: float = 1.0,
               maintenance_interval: float = 60.0) -> "GroupedReplicaNode":
         self._beacon_interval = beacon_interval
-        threads = [threading.Thread(target=self._spawn_checked, args=(g,))
+        threads = [spawn_thread(self._spawn_checked, g, start=False)
                    for g in range(self.groups)]
         for t in threads:
             t.start()
@@ -216,9 +219,7 @@ class GroupedReplicaNode:
             raise RuntimeError(f"group executors failed to start: {dead}")
         self._c_active.set(sum(w.alive for w in self._workers))
         for target in (self._accept_loop, self._beacon_loop):
-            t = threading.Thread(target=target, daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(spawn_thread(target, daemon=True))
         self.send_beacon()
         return self
 
@@ -266,7 +267,7 @@ class GroupedReplicaNode:
                     print(f"[group{g}] {line}", flush=True)
             ready.set()  # EOF: unblock the waiter (alive check fails below)
 
-        threading.Thread(target=drain, daemon=True).start()
+        spawn_thread(drain, daemon=True)
         if not ready.wait(self.spawn_timeout) or not port_box[0]:
             proc.kill()
             raise RuntimeError(f"group {g} produced no GROUP_READY "
@@ -355,8 +356,7 @@ class GroupedReplicaNode:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            threading.Thread(target=self._router_conn, args=(conn,),
-                             daemon=True).start()
+            spawn_thread(self._router_conn, conn, daemon=True)
 
     @staticmethod
     def _read_first_frame(conn):

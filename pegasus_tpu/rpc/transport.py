@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from . import codec
 from ..runtime.fail_points import FailPointError, fail_point
 from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
 from ..runtime.tracing import REQUEST_TRACER, TraceContext
 
 
@@ -209,10 +210,10 @@ class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers = {}
         self._middlewares = []   # fn(code, header, body, next) -> body
-        from concurrent.futures import ThreadPoolExecutor
+        from ..runtime.tasking import tracked_executor
 
-        self._pool = ThreadPoolExecutor(self.POOL_WORKERS,
-                                        thread_name_prefix="rpc-serve")
+        self._pool = tracked_executor(self.POOL_WORKERS,
+                                      thread_name_prefix="rpc-serve")
         self._busy = 0
         self._busy_lock = threading.Lock()
         self._depth_gauge = counters.number("rpc.server.dispatch_queue_depth")
@@ -228,8 +229,8 @@ class RpcServer:
 
         self._srv = _Server((host, port), _Handler)
         self.address = self._srv.server_address  # (host, actual_port)
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        self._thread = spawn_thread(self._srv.serve_forever, daemon=True,
+                                    start=False)
 
     def serve_connection(self, sock, initial: bytes = b"") -> None:
         """Serve one connection to exhaustion: drain pipelined frame waves
@@ -262,8 +263,7 @@ class RpcServer:
                 except OSError:
                     pass
 
-        threading.Thread(target=run, daemon=True,
-                         name="rpc-adopted").start()
+        spawn_thread(run, daemon=True, name="rpc-adopted")
 
     def register(self, code: str, handler) -> None:
         self._handlers[code] = handler
@@ -310,9 +310,8 @@ class RpcServer:
             if overflow:
                 # liveness escape: replication/lifecycle must never queue
                 # behind a pool full of work that is WAITING on them
-                threading.Thread(target=self._serve_one,
-                                 args=(sock, wlock, header, body),
-                                 daemon=True).start()
+                spawn_thread(self._serve_one, sock, wlock, header, body,
+                             daemon=True)
                 return
         with self._busy_lock:
             self._busy += 1
@@ -393,8 +392,7 @@ class RpcConnection:
         self._seq = 0
         self._dead = None
         self._ev_pool = []   # recycled Events (success path only)
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
+        self._reader = spawn_thread(self._read_loop, daemon=True)
 
     def _read_loop(self):
         try:

@@ -63,11 +63,12 @@ import threading
 import time
 from dataclasses import dataclass
 
+from . import lockrank
 from .perf_counters import counters
 from .tracing import COMPACT_TRACER
 
 
-class _LaneWorker(threading.Thread):
+class _LaneWorker(threading.Thread):  #: untracked_ok abandoned-by-design deadline workers: a wedged TPU-attached thread is never joined/killed, so the tracked registry's join_all must not see it
     """Reusable deadline worker: the guard hands it one call at a time
     and waits with a timeout. On timeout the caller ABANDONS it (never
     killed — a TPU-attached thread must not be killed) and the worker
@@ -160,21 +161,25 @@ class LaneGuard:
         # liveness round-trip, lazily bound to avoid a runtime->ops import
         # at module load
         self.probe_fn = probe_fn
-        self._lock = threading.Lock()
+        self._lock = lockrank.named_lock(f"laneguard.{metric_prefix}")
         # serializes the half-open re-probe: exactly one thread pays the
         # probe timeout against a possibly-wedged device; concurrent
         # callers keep routing to cpu meanwhile
-        self._half_open_lock = threading.Lock()
-        self._idle_workers = []  # reusable deadline workers (LIFO)
-        self.fallback_count = 0
-        self.retry_count = 0
-        self.deadline_abandon_count = 0
-        self.breaker_trip_count = 0
-        self.device_failure_count = 0
-        self._consec_failures = 0
-        self._breaker_open_until = 0.0  # monotonic
-        self.last_failure = None   # {"op", "error", "stage", "ts"}
-        self.last_fallback = None  # {"op", "reason", "ts"}
+        self._half_open_lock = lockrank.named_lock(
+            f"laneguard.half_open.{metric_prefix}")
+        # reusable deadline workers (LIFO)
+        self._idle_workers = []  #: guarded_by self._lock
+        self.fallback_count = 0  #: guarded_by self._lock
+        self.retry_count = 0     #: guarded_by self._lock
+        self.deadline_abandon_count = 0  #: guarded_by self._lock
+        self.breaker_trip_count = 0      #: guarded_by self._lock
+        self.device_failure_count = 0    #: guarded_by self._lock
+        self._consec_failures = 0        #: guarded_by self._lock
+        self._breaker_open_until = 0.0   # monotonic  #: guarded_by self._lock
+        # {"op", "error", "stage", "ts"}
+        self.last_failure = None   #: guarded_by self._lock
+        # {"op", "reason", "ts"}
+        self.last_fallback = None  #: guarded_by self._lock
 
     # ------------------------------------------------------------ plumbing
 
@@ -289,7 +294,7 @@ class LaneGuard:
         delay = self.config.backoff_base_s
         last_err = None
         for attempt in range(attempts):
-            failures_before = self.device_failure_count
+            failures_before = self.device_failure_count  #: unguarded_ok racy snapshot: compared against itself below to detect NESTED failures; a concurrent lane's failure only makes the breaker-reset more conservative
             try:
                 result = self._attempt(device_fn, deadline, op)
             except LaneDeadlineExceeded as e:
@@ -312,7 +317,7 @@ class LaneGuard:
                 # compact_blocks) may have "succeeded" via its own cpu
                 # fallback, and crediting that as device health would
                 # keep a dead device's breaker from ever accumulating
-                if self.device_failure_count == failures_before:
+                if self.device_failure_count == failures_before:  #: unguarded_ok racy snapshot compare (see failures_before above)
                     self.record_device_ok()
                 return result
         if fallback_fn is None:

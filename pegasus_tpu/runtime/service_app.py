@@ -567,8 +567,9 @@ class CollectorApp:
     def start(self):
         self._stopping = False
         self.rpc.start()
-        threading.Thread(target=self._ensure_probe_table_loop,
-                         daemon=True).start()
+        from .tasking import spawn_thread
+
+        spawn_thread(self._ensure_probe_table_loop, daemon=True)
         self.collector.start()
         self.detector.start()
         print(f"[pegasus-tpu] collector rpc on {self.address}", flush=True)

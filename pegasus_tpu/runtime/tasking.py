@@ -16,7 +16,10 @@ import heapq
 import itertools
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
+
+from . import lockrank
 
 
 @dataclass(frozen=True)
@@ -32,6 +35,98 @@ class TaskCode:
 
 
 _task_codes = {}
+
+
+class _TrackedRegistry:
+    """Process-wide ledger of every thread/executor the tracked spawn
+    helpers created — the fix-class for the PR 5 rc=134 shutdown abort:
+    a daemon thread nobody registered could not be joined at teardown
+    because nothing knew it existed. Holds weakrefs only (a finished
+    thread must be collectable); `join_all` is the bounded backstop the
+    test harness (and any embedding process) can call before interpreter
+    finalization. The static pass tools/analyze/thread_lifecycle.py
+    enforces that raw spawns route through here."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # leaf lock: nothing nests inside
+        self._threads = []    #: guarded_by self._lock
+        self._executors = []  #: guarded_by self._lock
+
+    def _prune_locked(self, refs) -> list:  #: requires self._lock
+        # deref each weakref ONCE: a referent collected between a guard
+        # deref and a value deref would put None into the result
+        pairs = [(r, r()) for r in refs]
+        refs[:] = [r for r, obj in pairs if obj is not None]
+        return [obj for _, obj in pairs if obj is not None]
+
+    def register_thread(self, t) -> None:
+        with self._lock:
+            self._threads.append(weakref.ref(t))
+            self._prune_locked(self._threads)
+
+    def register_executor(self, ex) -> None:
+        with self._lock:
+            self._executors.append(weakref.ref(ex))
+            self._prune_locked(self._executors)
+
+    def live_threads(self) -> list:
+        with self._lock:
+            return [t for t in self._prune_locked(self._threads)
+                    if t.is_alive()]
+
+    def live_executors(self) -> list:
+        with self._lock:
+            return self._prune_locked(self._executors)
+
+    def join_all(self, timeout_s: float = 5.0) -> list:
+        """Shut down tracked executors (no wait) and join tracked
+        threads against ONE shared deadline. Returns the threads still
+        alive at the deadline (wedged daemons a caller may want to name
+        before abandoning them)."""
+        for ex in self.live_executors():
+            try:
+                ex.shutdown(wait=False)
+            except Exception:  # noqa: BLE001 - teardown must keep going
+                pass
+        deadline = time.monotonic() + timeout_s
+        leftover = []
+        for t in self.live_threads():
+            if t is threading.current_thread() or not t.daemon:
+                continue
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                leftover.append(t)
+        return leftover
+
+
+TRACKED = _TrackedRegistry()
+
+
+def spawn_thread(target, *args, name: str = None, daemon: bool = True,
+                 start: bool = True, **kwargs):
+    """The ONE way to create a thread outside this module
+    (tools/analyze/thread_lifecycle.py flags raw ``Thread(...)`` calls):
+    same signature spirit as threading.Thread, but every spawn lands in
+    TRACKED so teardown can enumerate and join it. start=False returns
+    an unstarted (but already registered) thread for create-then-start
+    call sites."""
+    t = threading.Thread(target=target, args=args, kwargs=kwargs or None,
+                         name=name, daemon=daemon)
+    TRACKED.register_thread(t)
+    if start:
+        t.start()
+    return t
+
+
+def tracked_executor(max_workers: int, thread_name_prefix: str = ""):
+    """concurrent.futures.ThreadPoolExecutor, registered in TRACKED so
+    join_all can shut it down at teardown."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers,
+                            thread_name_prefix=thread_name_prefix)
+    TRACKED.register_executor(ex)
+    return ex
 
 
 def define_task_code(name, pool="THREAD_POOL_DEFAULT", priority=1, is_write=False,
@@ -56,14 +151,19 @@ class ThreadPool:
 
     def __init__(self, name: str, worker_count: int = 1):
         self.name = name
-        self._delayed = []  # (ready_at, seq, priority, fn, args)
-        self._ready = []    # (-priority, seq, fn, args)
+        # one lock RANK for every pool ("taskpool"): pools never nest
+        # their locks (workers run tasks outside the lock)
+        self._lock = lockrank.named_lock("taskpool")
+        # _delayed: (ready_at, seq, priority, fn, args); _ready:
+        # (-priority, seq, fn, args)
+        self._delayed = []  #: guarded_by self._lock
+        self._ready = []    #: guarded_by self._lock
         self._counter = itertools.count()
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._shutdown = False
+        self._not_empty = lockrank.named_condition("taskpool", self._lock)
+        self._shutdown = False  #: guarded_by self._lock
         self._workers = [
-            threading.Thread(target=self._run, name=f"{name}.{i}", daemon=True)
+            spawn_thread(self._run, name=f"{name}.{i}", daemon=True,
+                         start=False)
             for i in range(worker_count)
         ]
         for w in self._workers:

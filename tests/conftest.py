@@ -7,6 +7,34 @@ multi-node behavior on one machine (onebox, run.sh:480).
 
 import os
 
+# arm the lock-order deadlock detector for the WHOLE suite (ISSUE 9):
+# every named lock records its acquisition graph, a cycle = a deadlock
+# waiting for the right interleaving, and pytest_sessionfinish below
+# fails the run on any recorded violation — so every onebox /
+# group-worker / chaos test doubles as a lock-order regression test.
+# Must happen before any pegasus_tpu import (locks are created at class
+# init with the env read per factory call); subprocesses (group workers,
+# killed-node oneboxes, bench children) inherit both knobs and report
+# violations into the shared file.
+os.environ.setdefault("PEGASUS_LOCKRANK", "1")
+_LOCKRANK_FILE_PRESET = "PEGASUS_LOCKRANK_FILE" in os.environ
+_LOCKRANK_FILE = os.environ.setdefault(
+    "PEGASUS_LOCKRANK_FILE", f"/tmp/pegasus_lockrank_{os.getpid()}.jsonl")
+if not _LOCKRANK_FILE_PRESET:
+    # OUR file (pid-named): drop any leftover from a crashed prior run
+    # with a recycled pid so stale violations can't fail a green session
+    try:
+        os.unlink(_LOCKRANK_FILE)
+    except OSError:
+        pass
+# an externally-owned file is never deleted and only NEW lines count:
+# remember how many were already there when the session began
+try:
+    with open(_LOCKRANK_FILE) as _f:
+        _LOCKRANK_BASELINE_LINES = sum(1 for line in _f if line.strip())
+except OSError:
+    _LOCKRANK_BASELINE_LINES = 0
+
 # the image pre-sets JAX_PLATFORMS=axon (the real TPU tunnel); tests always
 # run on the virtual CPU mesh unless explicitly opted onto hardware
 flags = os.environ.get("XLA_FLAGS", "")
@@ -87,9 +115,71 @@ def pytest_sessionfinish(session, exitstatus):
                      if p is not None]
         for p in pools:
             p.stop()
+        # the tracked-spawn registry is the GENERAL backstop for the
+        # same bug class: shut down every tracked executor and join
+        # every tracked daemon (bounded) so no thread the registry knows
+        # about can die inside an XLA dispatch during Py_Finalize
+        from pegasus_tpu.runtime.tasking import TRACKED
+
+        leftover = TRACKED.join_all(timeout_s=5.0)
+        if leftover:
+            print(f"[conftest] {len(leftover)} tracked thread(s) still "
+                  f"alive at teardown: "
+                  f"{sorted(t.name for t in leftover)[:10]}")
     except Exception as e:  # teardown must never mask the run's outcome
         print(f"[conftest] executor teardown: {e!r}")
     try:
         _reap_group_workers()
     except Exception as e:  # the reaper is best-effort
         print(f"[conftest] group-worker reap: {e!r}")
+    try:
+        _check_lockrank(session)
+    except Exception as e:  # the gate must never mask the run's outcome
+        print(f"[conftest] lockrank gate: {e!r}")
+
+
+def _check_lockrank(session):
+    """Fail the session on any lock-order cycle recorded this run — in
+    THIS process (GRAPH.violations) or by any subprocess (group workers,
+    chaos-killed oneboxes) that appended to the shared violation file."""
+    from pegasus_tpu.runtime import lockrank
+
+    import json
+
+    violations = list(lockrank.GRAPH.violations)
+    try:
+        with open(_LOCKRANK_FILE) as f:
+            file_lines = [line.strip() for line in f if line.strip()]
+    except OSError:
+        file_lines = []
+    # only lines THIS session appended count (an externally-owned file
+    # may carry history)...
+    file_lines = file_lines[_LOCKRANK_BASELINE_LINES:]
+
+    # ...and in-process violations land in BOTH the graph and the file;
+    # count the file only for other pids (subprocess reports)
+    def _other_pid(line):
+        try:
+            return json.loads(line).get("pid") != os.getpid()
+        except ValueError:
+            return True
+    file_lines = [line for line in file_lines if _other_pid(line)]
+    if not _LOCKRANK_FILE_PRESET:
+        # our pid-named file; an externally-owned one stays for its owner
+        try:
+            os.unlink(_LOCKRANK_FILE)
+        except OSError:
+            pass
+    n = len(violations) + len(file_lines)
+    if not n:
+        return
+    print(f"\n[conftest] LOCKRANK: {n} lock-order violation(s) recorded "
+          f"this session — each is a deadlock waiting for the right "
+          f"interleaving:")
+    for v in violations:
+        print(f"  in-process: {' -> '.join(v['cycle'])} "
+              f"({v['held_site']} vs {v['acquire_site']})")
+    for line in file_lines:
+        print(f"  subprocess: {line}")
+    if session.exitstatus == 0:
+        session.exitstatus = 1

@@ -1,0 +1,54 @@
+"""`python -m tools.analyze` — run the full static-analysis plane.
+
+Exit 0: every pass clean (modulo the committed baseline, which must
+itself stay exact — a stale entry fails). Human-readable findings on
+stderr; `--json` prints the full machine-readable report on stdout.
+
+Options:
+  --json            machine-readable report to stdout
+  --pass NAME       run only NAME (repeatable; default: all passes)
+  --root PATH       analyze a different repo root (tests)
+  --no-baseline     ignore the committed baseline (show ALL findings)
+  --list            list registered passes and exit
+"""
+
+import argparse
+import json
+import sys
+
+from . import BASELINE_PATH, Repo, load_baseline, pass_names, run_all
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--pass", dest="passes", action="append")
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list:
+        print("\n".join(pass_names()))
+        return 0
+    repo = Repo(args.root) if args.root else Repo()
+    baseline = {} if args.no_baseline else load_baseline()
+    report = run_all(repo, passes=args.passes, baseline=baseline)
+    if args.json:
+        print(json.dumps(report.as_dict(), indent=2))
+    for f in report.findings:
+        print(f"analyze: {f.render()}", file=sys.stderr)
+    for pass_name, key in report.stale_baseline:
+        print(f"analyze: [{pass_name}] STALE baseline entry {key!r} — "
+              f"the finding is gone; delete it from {BASELINE_PATH.name}",
+              file=sys.stderr)
+    if not args.json:
+        n_gf = len(report.grandfathered)
+        status = "OK" if report.clean else "FAIL"
+        print(f"analyze: {status} — {len(report.ran)} passes, "
+              f"{len(report.findings)} findings"
+              + (f", {n_gf} grandfathered" if n_gf else ""))
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

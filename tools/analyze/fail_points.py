@@ -1,0 +1,89 @@
+"""Fail-point cross-check pass (migrated from tools/check_fail_points.py;
+that file remains as a thin CLI shim).
+
+  1. every fail-point name ARMED in tests (``cfg("name", ...)``) must
+     exist as a hook in source (``fail_point("name")`` / ``inject(...)``/
+     ``_fail(...)`` / ``_inject(...)``) — a test arming a point that no
+     code evaluates silently tests nothing;
+  2. every fail-point hook in source must be DOCUMENTED in README.md
+     (the Robustness section's fail-point table) — chaos hooks nobody can
+     discover rot.
+
+Dynamic names (``fail_point(f"rpc.{code}")``) become prefix wildcards
+(``rpc.*``): a test may arm any name under the prefix, and the README
+must mention the prefix.
+"""
+
+import re
+
+from . import Finding, Repo, register
+
+_CALL_RE = re.compile(
+    r"\b(?:fail_point|_fail|inject|_inject|_stage_fail)\(\s*(f?)\"([^\"]+)\"")
+_CFG_RE = re.compile(r"\bcfg\(\s*\"([^\"]+)\"")
+
+
+def _points_in(files) -> set:
+    names = set()
+    for sf in files:
+        for m in _CALL_RE.finditer(sf.text):
+            name = m.group(2)
+            if m.group(1):  # f-string: every {expr} hole becomes a wildcard
+                name = re.sub(r"\{[^}]*\}", "*", name)
+            names.add(name)
+    return names
+
+
+def source_points(repo: Repo) -> set:
+    return _points_in(repo.package_files())
+
+
+def test_local_points(repo: Repo) -> set:
+    """Hooks evaluated INSIDE tests (the fail-point mini-language unit
+    tests arm and evaluate throwaway names like 'p1' in the same file) —
+    legitimate, but they need no README documentation."""
+    return _points_in(repo.test_files())
+
+
+def test_armed_points(repo: Repo) -> set:
+    names = set()
+    for sf in repo.test_files():
+        names.update(_CFG_RE.findall(sf.text))
+    return names
+
+
+def _matches(name: str, source: set) -> bool:
+    if name in source:
+        return True
+    return any(s.endswith("*") and name.startswith(s[:-1]) for s in source)
+
+
+def lint_findings(src: set, armed: set, hooks: set, readme: str) -> list:
+    """Parameterized core (the CLI shim feeds its own — possibly
+    monkeypatched — collectors through here)."""
+    out = []
+    for name in sorted(armed):
+        if not _matches(name, hooks):
+            out.append(Finding(
+                "fail_points", "", 0,
+                f"tests arm fail point {name!r} but no source hook "
+                f"evaluates it (known: {sorted(hooks)})",
+                key=f"armed:{name}"))
+    for name in sorted(src):
+        probe = name.split("*")[0] if "*" in name else name
+        if probe not in readme:
+            out.append(Finding(
+                "fail_points", "", 0,
+                f"source fail point {name!r} is undocumented — add it to "
+                f"README.md's Robustness fail-point table",
+                key=f"undoc:{name}"))
+    return out
+
+
+@register("fail_points")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    src = source_points(repo)
+    armed = test_armed_points(repo)
+    hooks = src | test_local_points(repo)
+    return lint_findings(src, armed, hooks, repo.readme)

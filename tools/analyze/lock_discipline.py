@@ -1,0 +1,265 @@
+"""Lock-discipline pass: `#: guarded_by` fields must only be touched
+under their lock.
+
+The static half of the concurrency lint plane (runtime/lockrank.py is
+the runtime half): mutable shared state is ANNOTATED with the lock that
+guards it, and this pass AST-checks every access. The annotation
+grammar (also in README.md's "Static analysis" section):
+
+  self._l0 = []            #: guarded_by self._lock
+      Declares an instance attribute guarded by a lock expression
+      (usually another attribute of the same object). The comment rides
+      the declaring assignment's line, or the line directly above it.
+      Module-level names work the same way::
+
+          _POOL = None     #: guarded_by _POOL_LOCK
+
+  def _alloc_file_locked(self):  #: requires self._lock
+      Declares a method (or module function) that is only ever called
+      with the lock already held — its guarded accesses are trusted, not
+      flagged. The annotation is an ASSUMPTION about callers (v1 does
+      not verify call sites); name such methods `*_locked` by
+      convention so reviewers see the contract at the call site too.
+
+  d = self._last_committed_decree + 1  #: unguarded_ok racy-read: ...
+      Suppresses findings on one line, with a MANDATORY reason — a
+      deliberate lock-free read (monotonic hint, gauge snapshot) is
+      fine, an undocumented one is a finding. On a `def` line the
+      escape covers the whole method (single-threaded recovery helpers
+      called only from __init__).
+
+Checking rules:
+  * `with <lockexpr>:` opens a guarded scope for that expression (all
+    context items of the with count; `with a, b:` holds both).
+  * a Condition constructed over a lock aliases it:
+    `self._cv = threading.Condition(self._lock)` (or
+    `lockrank.named_condition(name, self._lock)`) means holding
+    `self._cv` implies holding `self._lock`.
+  * `__init__` is exempt (construction happens-before publication).
+  * nested functions/lambdas do NOT inherit the enclosing `with` scope:
+    a closure handed to a pool runs on another thread after the lock is
+    long gone — its guarded accesses must re-acquire or be escaped.
+  * only `self.<attr>` accesses are checked against instance guards
+    (cross-object accesses are out of scope for v1), plus bare-name
+    accesses for module-level guards.
+"""
+
+import ast
+
+from . import Finding, Repo, register
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - any unparse failure = no match
+        return ""
+
+
+def _target_attr(node):
+    """'self.X' assignment target -> X, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _annotation_for(sf, line: int, kind: str):
+    """Annotation of `kind` bound to `line`: same line, or a STANDALONE
+    comment line directly above (long declarations put the comment on
+    its own line — a trailing comment on the previous statement binds to
+    THAT statement, never leaks downward)."""
+    arg = sf.annotation(line, kind)
+    if arg is None and line >= 2 \
+            and sf.lines[line - 2].lstrip().startswith("#"):
+        arg = sf.annotation(line - 1, kind)
+    return arg
+
+
+def _cond_alias(value):
+    """If `value` constructs a Condition over a lock expression, return
+    that lock expression string, else None. Recognizes
+    threading.Condition(lock) / Condition(lock) /
+    lockrank.named_condition(name, lock) / named_condition(name, lock)."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    if name == "Condition" and value.args:
+        return _unparse(value.args[0])
+    if name == "named_condition":
+        if len(value.args) >= 2:
+            return _unparse(value.args[1])
+        for kw in value.keywords:
+            if kw.arg == "lock":
+                return _unparse(kw.value)
+    return None
+
+
+class _ClassGuards:
+    """Per-class guard declarations harvested from annotated
+    assignments anywhere in the class body (usually __init__)."""
+
+    def __init__(self):
+        self.fields = {}   # attr -> lock expr string
+        self.aliases = {}  # cond attr expr ("self._cv") -> lock expr
+
+    def implied(self, held: set) -> set:
+        """Close the held-set over condition aliases."""
+        out = set(held)
+        for cv, lk in self.aliases.items():
+            if cv in out:
+                out.add(lk)
+        return out
+
+
+def _harvest_class(sf, cls: ast.ClassDef) -> _ClassGuards:
+    g = _ClassGuards()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _target_attr(t)
+                if attr is None:
+                    continue
+                lock = _annotation_for(sf, node.lineno, "guarded_by")
+                if lock:
+                    g.fields[attr] = lock
+                alias = _cond_alias(node.value) if node.value else None
+                if alias:
+                    g.aliases[f"self.{attr}"] = alias
+    return g
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one function body tracking the set of held lock expressions;
+    flag guarded accesses made without the guard held."""
+
+    def __init__(self, sf, guards, held, findings, scope_name,
+                 module_guards=None):
+        self.sf = sf
+        self.guards = guards          # _ClassGuards or None (module fn)
+        self.module_guards = module_guards or {}
+        self.held = set(held)
+        self.findings = findings
+        self.scope = scope_name
+
+    # ------------------------------------------------------------- scopes
+
+    def visit_With(self, node: ast.With):
+        added = []
+        for item in node.items:
+            expr = _unparse(item.context_expr)
+            if expr:
+                added.append(expr)
+        saved = self.held
+        self.held = self.held | set(added)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    def _nested(self, body):
+        # a closure runs whenever its caller decides — usually another
+        # thread; it inherits NOTHING
+        checker = _MethodChecker(self.sf, self.guards, set(),
+                                 self.findings, self.scope,
+                                 self.module_guards)
+        for stmt in body:
+            checker.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        self._nested(node.body)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._nested([node.body])
+
+    # ------------------------------------------------------------ accesses
+
+    def _flag(self, node, name: str, lock: str):
+        reason = self.sf.annotation(node.lineno, "unguarded_ok")
+        if reason is not None and reason.strip():
+            return  # documented escape; an EMPTY reason does not count
+        self.findings.append(Finding(
+            "lock_discipline", self.sf.rel, node.lineno,
+            f"{self.scope}: access to {name} (guarded by {lock}) "
+            f"outside `with {lock}` — wrap it, annotate the method "
+            f"`#: requires {lock}`, or escape the line with "
+            f"`#: unguarded_ok <reason>`",
+            key=f"{self.sf.rel}:{self.scope}:{name}"))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _target_attr(node)
+        if attr is not None and self.guards is not None \
+                and attr in self.guards.fields:
+            lock = self.guards.fields[attr]
+            if lock not in self.guards.implied(self.held):
+                self._flag(node, f"self.{attr}", lock)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        lock = self.module_guards.get(node.id)
+        if lock is not None and lock not in self.held:
+            self._flag(node, node.id, lock)
+        self.generic_visit(node)
+
+
+def _check_function(sf, fn, guards, module_guards, findings,
+                    scope: str) -> None:
+    if fn.name == "__init__":
+        return
+    method_escape = _annotation_for(sf, fn.lineno, "unguarded_ok")
+    if method_escape is not None and method_escape.strip():
+        return
+    held = set()
+    required = _annotation_for(sf, fn.lineno, "requires")
+    if required:
+        held.update(r.strip() for r in required.split(",") if r.strip())
+    checker = _MethodChecker(sf, guards, held, findings, scope,
+                             module_guards)
+    for stmt in fn.body:
+        checker.visit(stmt)
+
+
+def check_file(sf, findings: list) -> None:
+    # module-level guards: `_POOL = None  #: guarded_by _POOL_LOCK`
+    module_guards = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            lock = _annotation_for(sf, node.lineno, "guarded_by")
+            if lock:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_guards[t.id] = lock
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            guards = _harvest_class(sf, node)
+            # nested-class guard declarations also register (one level)
+            for fn in node.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _check_function(sf, fn, guards, module_guards,
+                                    findings, f"{node.name}.{fn.name}")
+                elif isinstance(fn, ast.ClassDef):
+                    inner = _harvest_class(sf, fn)
+                    for ifn in fn.body:
+                        if isinstance(ifn, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            _check_function(
+                                sf, ifn, inner, module_guards, findings,
+                                f"{node.name}.{fn.name}.{ifn.name}")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_function(sf, node, None, module_guards, findings,
+                            node.name)
+
+
+@register("lock_discipline")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    findings = []
+    for sf in repo.package_files():
+        if "guarded_by" in sf.text or "#: requires" in sf.text:
+            check_file(sf, findings)
+    return findings

@@ -1,0 +1,130 @@
+"""Metric-name cross-check pass (migrated from
+tools/check_metric_names.py; that file remains as a thin CLI shim).
+
+Every perf-counter name registered in source
+(``counters.rate/percentile/number/volatile_number("name")``) must be
+DOCUMENTED in README.md's Observability metric tables, and every row of
+README's '### Metric-name table' must still have a matching counter
+registration (both directions — see the shim's docstring for the full
+rationale and the wildcard rules for dynamic names).
+"""
+
+import re
+
+from . import Finding, Repo, register
+
+# a counter registration call; the name argument is parsed from here on
+_KIND_RE = re.compile(
+    r"counters\.(?:rate|percentile|number|volatile_number)\(")
+# <prefix-expr> +  (e.g. self._pfx + "put_qps") -> leading wildcard
+_PFX_RE = re.compile(r"\s*[A-Za-z_][\w.]*\s*\+\s*")
+# one (f-)string literal; `\s*` spans newlines, so adjacent literals in a
+# multi-line implicit concatenation chain all parse
+_STR_RE = re.compile(r"\s*(f?)\"([^\"]*)\"")
+_JOIN_RE = re.compile(r"\s*\+\s*")
+
+
+def _wildcard(is_fstring: str, name: str) -> str:
+    if is_fstring:
+        name = re.sub(r"\{[^}]*\}", "*", name)
+    return name
+
+
+def _name_at(text: str, pos: int) -> str:
+    """Parse the counter-name expression starting at `pos` (just past the
+    opening paren) into a wildcard pattern: f-string holes and non-literal
+    sub-expressions become '*', adjacent/'+'-joined literals concatenate.
+    Returns '' when the argument holds no string literal at all."""
+    prefix = ""
+    mp = _PFX_RE.match(text, pos)
+    if mp:
+        prefix, pos = "*", mp.end()
+    parts = []
+    while True:
+        ms = _STR_RE.match(text, pos)
+        if not ms:
+            break
+        parts.append(_wildcard(ms.group(1), ms.group(2)))
+        pos = ms.end()
+        mj = _JOIN_RE.match(text, pos)
+        if mj:
+            if _STR_RE.match(text, mj.end()):
+                pos = mj.end()
+            else:  # '+ expr' with a non-literal tail
+                parts.append("*")
+                break
+    return prefix + "".join(parts) if parts else ""
+
+
+def source_metric_names(repo: Repo) -> set:
+    names = set()
+    for sf in repo.package_files():
+        for m in _KIND_RE.finditer(sf.text):
+            name = _name_at(sf.text, m.end())
+            if name:
+                names.add(name)
+    return names
+
+
+def _probe(name: str) -> str:
+    """Longest wildcard-free segment of the name (dots trimmed) — what
+    must literally appear in the README's metric tables."""
+    segments = [s.strip(".") for s in name.split("*")]
+    segments = [s for s in segments if s]
+    return max(segments, key=len, default="")
+
+
+def readme_metric_rows(repo: Repo) -> list:
+    """Counter-name variants from README's '### Metric-name table'
+    section: one entry per backticked span in each row's first cell,
+    split on ' / ' and '\\|' alternations, `<placeholder>` -> '*'."""
+    rows = []
+    for cells in repo.readme_table_rows("Metric-name table"):
+        for span in re.findall(r"`([^`]+)`", cells[0]):
+            for variant in re.split(r"\\\||/", span):
+                variant = variant.strip()
+                if variant:
+                    rows.append(re.sub(r"<[^>]*>", "*", variant))
+    return rows
+
+
+def lint_findings(src: set, rows: list, readme: str) -> list:
+    """Parameterized core shared with the CLI shim."""
+    out = []
+    for name in sorted(src):
+        probe = _probe(name)
+        if probe and probe not in readme:
+            out.append(Finding(
+                "metric_names", "", 0,
+                f"source counter {name!r} is undocumented — add it to "
+                f"README.md's Observability metric tables "
+                f"(probe segment {probe!r} not found)",
+                key=f"undoc:{name}"))
+    # reverse pass: a README row must still name a registered counter.
+    # A row may also be covered by a FULLY-dynamic registration of the
+    # shape `f"{base}.count"` -> `*.count` (the tracing stage family):
+    # only that narrow leading-wildcard + dot-suffix shape is accepted
+    # as coverage — broader wildcards like `**_qps` would quietly cover
+    # ANY `<ghost>_qps` row and gut the lint.
+    haystack = "\n".join(sorted(src))
+    suffixes = [s[1:] for s in src
+                if re.fullmatch(r"\*(\.[A-Za-z0-9_]+)+", s)]
+    for row in rows:
+        probe = _probe(row)
+        resolved = row.replace("*", "X")
+        if probe and probe not in haystack \
+                and not any(resolved.endswith(sfx) for sfx in suffixes):
+            out.append(Finding(
+                "metric_names", "", 0,
+                f"README metric row {row!r} has no matching counter "
+                f"registration in source (probe segment {probe!r}) — "
+                f"delete the row or restore the counter",
+                key=f"stale-row:{row}"))
+    return out
+
+
+@register("metric_names")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    return lint_findings(source_metric_names(repo),
+                         readme_metric_rows(repo), repo.readme)

@@ -1,0 +1,73 @@
+"""Remote-command cross-check pass (migrated from
+tools/check_remote_commands.py; that file remains as a thin CLI shim).
+
+Every remote command registered in source (``commands.register("name")``
+on a RemoteCommandService, or ``self.register("name")`` inside
+runtime/remote_command.py's register_defaults) must be DOCUMENTED in
+README.md's '### Remote-command table', and every table row must still
+name a registered command (both directions).
+"""
+
+import re
+
+from . import Finding, Repo, register
+
+# Deliberately NOT a bare `.register(` — RpcServer task-code
+# registrations share that shape.
+_CMDS_RE = re.compile(r"\bcommands\.register\(\s*\"([^\"]+)\"")
+_SELF_RE = re.compile(r"\bself\.register\(\s*\"([^\"]+)\"")
+
+
+def source_commands(repo: Repo) -> set:
+    names = set()
+    for sf in repo.package_files():
+        names.update(_CMDS_RE.findall(sf.text))
+        if sf.path.name == "remote_command.py":
+            names.update(_SELF_RE.findall(sf.text))
+    return names
+
+
+def readme_command_rows(repo: Repo) -> list:
+    """Command names from README's '### Remote-command table' section:
+    each row's first backticked token (the rest of the span is usage —
+    usage strings legitimately contain escaped `\\|` alternations, which
+    the shared cell splitter already treats as cell text)."""
+    rows = []
+    for cells in repo.readme_table_rows("Remote-command table"):
+        first = re.search(r"`([^`\s]+)", cells[0])
+        if first:
+            rows.append(first.group(1))
+    return rows
+
+
+def lint_findings(src: set, rows: list) -> list:
+    """Parameterized core shared with the CLI shim."""
+    if not rows:
+        return [Finding(
+            "remote_commands", "", 0,
+            "README.md has no '### Remote-command table' section "
+            "(or it is empty) — every registered remote command must "
+            "be documented there", key="no-table")]
+    out = []
+    documented = set(rows)
+    for name in sorted(src):
+        if name not in documented:
+            out.append(Finding(
+                "remote_commands", "", 0,
+                f"remote command {name!r} is registered in source but "
+                f"missing from README.md's Remote-command table",
+                key=f"undoc:{name}"))
+    for name in sorted(documented):
+        if name not in src:
+            out.append(Finding(
+                "remote_commands", "", 0,
+                f"README Remote-command table row {name!r} has no matching "
+                f"registration in source — delete the row or restore the "
+                f"command", key=f"stale-row:{name}"))
+    return out
+
+
+@register("remote_commands")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    return lint_findings(source_commands(repo), readme_command_rows(repo))

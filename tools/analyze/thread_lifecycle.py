@@ -1,0 +1,103 @@
+"""Thread/executor-lifecycle pass: no raw spawns outside the tracked
+helpers.
+
+The PR 5 rc=134 shutdown abort was EXACTLY this bug class: daemon
+threads nobody registered anywhere died inside XLA dispatches at
+interpreter finalization, and nothing could have joined them because
+nothing knew they existed. runtime/tasking.py now provides tracked
+spawn helpers — ``spawn_thread(...)`` and ``tracked_executor(...)`` —
+that register every thread/pool in a process-wide registry with a
+bounded ``TRACKED.join_all()`` teardown (tests/conftest.py calls it at
+session finish). This pass makes the discipline machine-checked:
+
+  * every direct ``Thread(...)`` / ``threading.Thread(...)`` /
+    ``ThreadPoolExecutor(...)`` / ``concurrent.futures.
+    ThreadPoolExecutor(...)`` CALL outside runtime/tasking.py is a
+    finding;
+  * so is defining a ``threading.Thread`` SUBCLASS (a spawn factory in
+    disguise) — lane_guard's deliberately-abandoned deadline workers
+    carry the escape hatch;
+  * the escape is ``#: untracked_ok <reason>`` on the call (or class)
+    line, reason mandatory: a thread the registry cannot see must say
+    why its lifecycle is safe.
+"""
+
+import ast
+
+from . import Finding, Repo, register
+
+# the helper module itself (and only it) may touch the raw primitives
+_HELPER_FILES = {"pegasus_tpu/runtime/tasking.py"}
+
+_SPAWN_CALLEES = {
+    "Thread", "threading.Thread",
+    "ThreadPoolExecutor", "futures.ThreadPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+}
+
+
+def _callee(node: ast.Call) -> str:
+    try:
+        return ast.unparse(node.func)
+    except Exception:  # noqa: BLE001 - no name = no match
+        return ""
+
+
+def _thread_base(base) -> bool:
+    try:
+        return ast.unparse(base) in ("Thread", "threading.Thread")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def check_file(sf, findings: list) -> None:
+    scope = [sf.path.stem]
+
+    def visit(node):
+        name = getattr(node, "name", None)
+        pushed = False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and name:
+            scope.append(name)
+            pushed = True
+        if isinstance(node, ast.ClassDef) and \
+                any(_thread_base(b) for b in node.bases):
+            reason = sf.annotation(node.lineno, "untracked_ok")
+            if reason is None or not reason.strip():
+                findings.append(Finding(
+                    "thread_lifecycle", sf.rel, node.lineno,
+                    f"class {node.name} subclasses threading.Thread — a "
+                    f"spawn factory the tracked registry cannot see; "
+                    f"route instances through runtime/tasking.spawn_thread "
+                    f"or escape the class line with "
+                    f"`#: untracked_ok <reason>`",
+                    key=f"{sf.rel}:class:{node.name}"))
+        if isinstance(node, ast.Call) and _callee(node) in _SPAWN_CALLEES:
+            reason = sf.annotation(node.lineno, "untracked_ok")
+            if reason is None or not reason.strip():
+                where = ".".join(scope[1:]) or "<module>"
+                findings.append(Finding(
+                    "thread_lifecycle", sf.rel, node.lineno,
+                    f"raw {_callee(node)}(...) in {where} — use "
+                    f"runtime/tasking.spawn_thread / tracked_executor "
+                    f"(registers join/shutdown) or escape the line with "
+                    f"`#: untracked_ok <reason>`",
+                    key=f"{sf.rel}:{where}:{_callee(node)}"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if pushed:
+            scope.pop()
+
+    visit(sf.tree)
+
+
+@register("thread_lifecycle")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    findings = []
+    for sf in repo.package_files():
+        if sf.rel in _HELPER_FILES:
+            continue
+        if "Thread" in sf.text:  # cheap pre-filter
+            check_file(sf, findings)
+    return findings

@@ -1,88 +1,41 @@
 #!/usr/bin/env python
-"""Fail-point cross-check lint (wired into the test run via
-tests/test_lane_guard.py):
+"""Thin CLI shim over tools/analyze/fail_points.py (the fail-point
+cross-check now lives in the shared static-analysis framework; run
+`python -m tools.analyze` for the whole plane). Kept so existing
+invocations — tests/test_lane_guard.py runs this script — and the
+historical `run_lint()` surface keep working."""
 
-  1. every fail-point name ARMED in tests (``cfg("name", ...)``) must
-     exist as a hook in source (``fail_point("name")`` / ``inject(...)``/
-     ``_fail(...)`` / ``_inject(...)``) — a test arming a point that no
-     code evaluates silently tests nothing;
-  2. every fail-point hook in source must be DOCUMENTED in README.md
-     (the Robustness section's fail-point table) — chaos hooks nobody can
-     discover rot.
-
-Dynamic names (``fail_point(f"rpc.{code}")``) become prefix wildcards
-(``rpc.*``): a test may arm any name under the prefix, and the README
-must mention the prefix.
-"""
-
-import re
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-_CALL_RE = re.compile(
-    r"\b(?:fail_point|_fail|inject|_inject|_stage_fail)\(\s*(f?)\"([^\"]+)\"")
-_CFG_RE = re.compile(r"\bcfg\(\s*\"([^\"]+)\"")
+from tools.analyze import Repo  # noqa: E402
+from tools.analyze import fail_points as _pass  # noqa: E402
 
-
-def _points_in(files) -> set:
-    names = set()
-    for p in files:
-        text = p.read_text()
-        for m in _CALL_RE.finditer(text):
-            name = m.group(2)
-            if m.group(1):  # f-string: every {expr} hole becomes a wildcard
-                name = re.sub(r"\{[^}]*\}", "*", name)
-            names.add(name)
-    return names
+_REPO = Repo()
 
 
 def source_points() -> set:
-    return _points_in(list((REPO / "pegasus_tpu").rglob("*.py"))
-                      + [REPO / "bench.py"])
+    return _pass.source_points(_REPO)
 
 
 def test_local_points() -> set:
-    """Hooks evaluated INSIDE tests (the fail-point mini-language unit
-    tests arm and evaluate throwaway names like 'p1' in the same file) —
-    legitimate, but they need no README documentation."""
-    return _points_in((REPO / "tests").rglob("*.py"))
+    return _pass.test_local_points(_REPO)
 
 
 def test_armed_points() -> set:
-    names = set()
-    for p in (REPO / "tests").rglob("*.py"):
-        names.update(_CFG_RE.findall(p.read_text()))
-    return names
-
-
-def _matches(name: str, source: set) -> bool:
-    if name in source:
-        return True
-    return any(s.endswith("*") and name.startswith(s[:-1])
-               for s in source)
+    return _pass.test_armed_points(_REPO)
 
 
 def run_lint() -> list:
-    """-> list of error strings (empty = clean)."""
+    """-> list of error strings (empty = clean). Reads the collectors
+    through THIS module so monkeypatched tests keep their teeth."""
     src = source_points()
     armed = test_armed_points()
     hooks = src | test_local_points()
-    readme = (REPO / "README.md").read_text()
-    errors = []
-    for name in sorted(armed):
-        if not _matches(name, hooks):
-            errors.append(
-                f"tests arm fail point {name!r} but no source hook "
-                f"evaluates it (known: {sorted(hooks)})")
-    for name in sorted(src):
-        probe = name.split("*")[0] if "*" in name else name
-        if probe not in readme:
-            errors.append(
-                f"source fail point {name!r} is undocumented — add it to "
-                f"README.md's Robustness fail-point table")
-    return errors
+    return [f.message for f in
+            _pass.lint_findings(src, armed, hooks, _REPO.readme)]
 
 
 def main() -> int:

@@ -1,145 +1,35 @@
 #!/usr/bin/env python
-"""Metric-name cross-check lint (wired into the test run via
-tests/test_tools.py), the counter-registry twin of check_fail_points.py:
+"""Thin CLI shim over tools/analyze/metric_names.py (the metric-name
+cross-check now lives in the shared static-analysis framework; run
+`python -m tools.analyze` for the whole plane). Kept so existing
+invocations — tests/test_tools.py runs this script and monkeypatches
+`source_metric_names` / `readme_metric_rows` — keep working."""
 
-every perf-counter name registered in source
-(``counters.rate/percentile/number/volatile_number("name")``) must be
-DOCUMENTED in README.md's Observability metric tables — counters nobody
-can discover rot, and a renamed counter silently breaks every dashboard
-scraping the old name.
-
-The REVERSE direction is linted too: every row of README's metric-name
-table must still have a matching counter registration in source — a
-deleted or renamed counter whose row stays behind documents a metric no
-scrape will ever return, which is worse than no documentation. Row names
-normalize `<placeholder>` holes to wildcards and split ``a / b`` and
-``a\|b`` cells into variants; each variant's longest literal segment is
-probed against the set of registered names (the mirror of the forward
-probe).
-
-Dynamic names become wildcards: f-string holes
-(``f"profiler.{code}.qps"`` -> ``profiler.*.qps``) and concatenated
-prefixes (``self._pfx + "put_qps"`` -> ``*.put_qps``). For each name the
-longest literal segment (dots trimmed) is probed against README.md, so
-``*.put_qps`` requires ``put_qps`` to appear and
-``collector.app.*.hotkey.*`` requires ``collector.app.`` or ``hotkey``
-(whichever is longer) to appear.
-"""
-
-import re
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# a counter registration call; the name argument is parsed from here on
-_KIND_RE = re.compile(
-    r"counters\.(?:rate|percentile|number|volatile_number)\(")
-# <prefix-expr> +  (e.g. self._pfx + "put_qps") -> leading wildcard
-_PFX_RE = re.compile(r"\s*[A-Za-z_][\w.]*\s*\+\s*")
-# one (f-)string literal; `\s*` spans newlines, so adjacent literals in a
-# multi-line implicit concatenation chain all parse
-_STR_RE = re.compile(r"\s*(f?)\"([^\"]*)\"")
-_JOIN_RE = re.compile(r"\s*\+\s*")
+from tools.analyze import Repo  # noqa: E402
+from tools.analyze import metric_names as _pass  # noqa: E402
 
-
-def _wildcard(is_fstring: str, name: str) -> str:
-    if is_fstring:
-        name = re.sub(r"\{[^}]*\}", "*", name)
-    return name
-
-
-def _name_at(text: str, pos: int) -> str:
-    """Parse the counter-name expression starting at `pos` (just past the
-    opening paren) into a wildcard pattern: f-string holes and non-literal
-    sub-expressions become '*', adjacent/'+'-joined literals concatenate.
-    Returns '' when the argument holds no string literal at all."""
-    prefix = ""
-    mp = _PFX_RE.match(text, pos)
-    if mp:
-        prefix, pos = "*", mp.end()
-    parts = []
-    while True:
-        ms = _STR_RE.match(text, pos)
-        if not ms:
-            break
-        parts.append(_wildcard(ms.group(1), ms.group(2)))
-        pos = ms.end()
-        mj = _JOIN_RE.match(text, pos)
-        if mj:
-            if _STR_RE.match(text, mj.end()):
-                pos = mj.end()
-            else:  # '+ expr' with a non-literal tail
-                parts.append("*")
-                break
-    return prefix + "".join(parts) if parts else ""
+_REPO = Repo()
 
 
 def source_metric_names() -> set:
-    names = set()
-    files = list((REPO / "pegasus_tpu").rglob("*.py")) + [REPO / "bench.py"]
-    for p in files:
-        text = p.read_text()
-        for m in _KIND_RE.finditer(text):
-            name = _name_at(text, m.end())
-            if name:
-                names.add(name)
-    return names
-
-
-def _probe(name: str) -> str:
-    """Longest wildcard-free segment of the name (dots trimmed) — what
-    must literally appear in the README's metric tables."""
-    segments = [s.strip(".") for s in name.split("*")]
-    segments = [s for s in segments if s]
-    return max(segments, key=len, default="")
+    return _pass.source_metric_names(_REPO)
 
 
 def readme_metric_rows() -> list:
-    """Counter-name variants from README's '### Metric-name table'
-    section: one entry per backticked span in each row's first cell,
-    split on ' / ' and '\\|' alternations, `<placeholder>` -> '*'."""
-    text = (REPO / "README.md").read_text()
-    m = re.search(r"^### Metric-name table$(.*?)^## ", text,
-                  re.MULTILINE | re.DOTALL)
-    section = m.group(1) if m else ""
-    rows = []
-    for line in section.splitlines():
-        if not line.startswith("|"):
-            continue
-        cells = line.split("|")
-        if len(cells) < 3 or set(cells[1].strip()) <= {"-", " "}:
-            continue  # separator / malformed row
-        for span in re.findall(r"`([^`]+)`", cells[1]):
-            for variant in re.split(r"\\\||/", span):
-                variant = variant.strip()
-                if variant:
-                    rows.append(re.sub(r"<[^>]*>", "*", variant))
-    return rows
+    return _pass.readme_metric_rows(_REPO)
 
 
 def run_lint() -> list:
-    """-> list of error strings (empty = clean)."""
-    readme = (REPO / "README.md").read_text()
-    errors = []
-    src = source_metric_names()
-    for name in sorted(src):
-        probe = _probe(name)
-        if probe and probe not in readme:
-            errors.append(
-                f"source counter {name!r} is undocumented — add it to "
-                f"README.md's Observability metric tables "
-                f"(probe segment {probe!r} not found)")
-    # reverse pass: a README row must still name a registered counter
-    haystack = "\n".join(sorted(src))
-    for row in readme_metric_rows():
-        probe = _probe(row)
-        if probe and probe not in haystack:
-            errors.append(
-                f"README metric row {row!r} has no matching counter "
-                f"registration in source (probe segment {probe!r}) — "
-                f"delete the row or restore the counter")
-    return errors
+    """-> list of error strings (empty = clean). Reads the collectors
+    through THIS module so monkeypatched tests keep their teeth."""
+    return [f.message for f in
+            _pass.lint_findings(source_metric_names(),
+                                readme_metric_rows(), _REPO.readme)]
 
 
 def main() -> int:

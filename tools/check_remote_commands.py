@@ -1,85 +1,34 @@
 #!/usr/bin/env python
-"""Remote-command cross-check lint (wired into the test run via
-tests/test_tools.py), the admin-surface twin of check_fail_points.py /
-check_metric_names.py:
+"""Thin CLI shim over tools/analyze/remote_commands.py (the
+remote-command cross-check now lives in the shared static-analysis
+framework; run `python -m tools.analyze` for the whole plane). Kept so
+existing invocations — tests/test_tools.py runs this script and
+monkeypatches `source_commands` — keep working."""
 
-every remote command registered in source
-(``commands.register("name", ...)`` on a RemoteCommandService, or
-``self.register("name", ...)`` inside runtime/remote_command.py's
-register_defaults) must be DOCUMENTED in README.md's
-'### Remote-command table' — admin commands nobody can discover rot, and
-an operator runbook pointing at a renamed command silently breaks.
-
-The REVERSE direction is linted too: every row of the README table must
-still name a registered command — a row for a deleted command documents
-an admin surface no node will ever answer.
-"""
-
-import re
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# RemoteCommandService registrations: `<...>commands.register("name"` in
-# any source file, plus `self.register("name"` in remote_command.py itself
-# (register_defaults). Deliberately NOT a bare `.register(` — RpcServer
-# task-code registrations share that shape.
-_CMDS_RE = re.compile(r"\bcommands\.register\(\s*\"([^\"]+)\"")
-_SELF_RE = re.compile(r"\bself\.register\(\s*\"([^\"]+)\"")
+from tools.analyze import Repo  # noqa: E402
+from tools.analyze import remote_commands as _pass  # noqa: E402
+
+_REPO = Repo()
 
 
 def source_commands() -> set:
-    names = set()
-    for p in (REPO / "pegasus_tpu").rglob("*.py"):
-        text = p.read_text()
-        names.update(_CMDS_RE.findall(text))
-        if p.name == "remote_command.py":
-            names.update(_SELF_RE.findall(text))
-    return names
+    return _pass.source_commands(_REPO)
 
 
 def readme_command_rows() -> list:
-    """Command names from README's '### Remote-command table' section:
-    each row's first backticked token (the rest of the span is usage —
-    parsed from the whole line, not a naive '|' cell split, because
-    usage strings legitimately contain escaped `\\|` alternations)."""
-    text = (REPO / "README.md").read_text()
-    m = re.search(r"^### Remote-command table$(.*?)^## ", text,
-                  re.MULTILINE | re.DOTALL)
-    section = m.group(1) if m else ""
-    rows = []
-    for line in section.splitlines():
-        if not line.startswith("| `"):
-            continue  # header / separator / prose
-        first = re.search(r"`([^`\s]+)", line)
-        if first:
-            rows.append(first.group(1))
-    return rows
+    return _pass.readme_command_rows(_REPO)
 
 
 def run_lint() -> list:
-    """-> list of error strings (empty = clean)."""
-    src = source_commands()
-    rows = readme_command_rows()
-    errors = []
-    if not rows:
-        return ["README.md has no '### Remote-command table' section "
-                "(or it is empty) — every registered remote command must "
-                "be documented there"]
-    documented = set(rows)
-    for name in sorted(src):
-        if name not in documented:
-            errors.append(
-                f"remote command {name!r} is registered in source but "
-                "missing from README.md's Remote-command table")
-    for name in sorted(documented):
-        if name not in src:
-            errors.append(
-                f"README Remote-command table row {name!r} has no matching "
-                "registration in source — delete the row or restore the "
-                "command")
-    return errors
+    """-> list of error strings (empty = clean). Reads the collectors
+    through THIS module so monkeypatched tests keep their teeth."""
+    return [f.message for f in
+            _pass.lint_findings(source_commands(), readme_command_rows())]
 
 
 def main() -> int:
