@@ -898,6 +898,22 @@ def ycsb_main():
                 "threads": n_threads,
                 "records": records,
                 "reads": reads_detail,
+                # debt-driven admission control (ISSUE 10): whether the
+                # graduated backpressure engaged during the run — a
+                # nonzero delay count with zero rejects is the designed
+                # "measured slowdown instead of a stall" shape
+                "throttle": {
+                    "debt_delay_count": snap.get(
+                        "engine.throttle.debt_delay_count", 0),
+                    "debt_reject_count": snap.get(
+                        "engine.throttle.debt_reject_count", 0),
+                    "debt_delay_ms": snap.get(
+                        "engine.throttle.debt_delay_ms"),
+                    "sched_deferred_count": snap.get(
+                        "engine.compact.sched.deferred_count", 0),
+                    "sched_urgent_count": snap.get(
+                        "engine.compact.sched.urgent_count", 0),
+                },
                 "audit": audit,
                 "cpu_process_s": round(time.process_time() - proc_t0, 3),
                 "host": {"start": host_start, "end": _host_info()},

@@ -1,0 +1,339 @@
+"""Cluster-wide compaction scheduler: debt-driven timing, placement,
+and admission control (ISSUE 10).
+
+RESYSTANCE (PAPERS.md) shows most LSM compaction headroom is
+*scheduling*, not kernels. PR 1-9 made every signal a scheduler needs
+exportable — per-partition compaction debt (beacon-folded into the
+meta's one-RPC ``RPC_CM_QUERY_CLUSTER_STATE`` snapshot), hotkey
+verdicts / read-residency pins, per-partition committed/applied lag,
+``compact.lane.*`` breaker state — and this module *concludes* from
+them, the way the cluster doctor (PR 8) folds the same snapshot into a
+verdict:
+
+- ``fold_decisions``: the pure, deterministic CLUSTER-level fold — per
+  partition one of ``defer | normal | urgent`` with the reasons that
+  drove it:
+
+    * L0 debt at/over the hard ceiling -> **urgent** (``debt_ceiling``;
+      the engine-local trigger fires there regardless — the scheduler
+      merely agrees);
+    * confirmed hot READ traffic (hotkey verdict pinned the partition
+      device-resident) -> **defer** (``hot_read``): compacting a
+      read-hot partition evicts the resident runs its device reads
+      serve from, for no urgency;
+    * committed-vs-applied backlog over the threshold -> **urgent**
+      (``apply_backlog``, plus ``slow_requests`` when the cluster
+      slow-request rollup is non-empty — backlog is what drives the
+      slow ledger);
+    * L0 debt at/over the urgent threshold -> **urgent** (``l0_debt``).
+
+- ``localize_decisions``: the per-NODE half, applied at delivery for
+  each receiving node (every replica compacts independently):
+
+    * a receiver whose compact-lane breaker is open never gets an
+      urgent token (``breaker_open``): its device lane is degraded to
+      host — never promote work onto it;
+    * per-node urgent budget: at most ``max_urgent_per_node``
+      non-ceiling urgents per receiver (highest debt first; the rest
+      demote to ``node_cap``) so promotions cannot convoy one node's
+      TPU lane;
+    * defer tokens land on the PRIMARY only
+      (``defer_primary_only``): the read-residency pin that justifies
+      holding compaction lives on the primary's engine — a deferring
+      secondary would pay the debt for zero read benefit.
+
+- ``run_scheduler_tick``: one control-loop round over the live RPC
+  surfaces — snapshot + breaker scrapes in, decisions delivered to every
+  alive node over the ``compact-sched-policy`` remote command.
+
+- ``CompactScheduler``: the collector-hosted loop (armed by
+  ``PEGASUS_SCHED=1``), wiring the info collector's hotkey verdicts and
+  slow-request rollup into the fold.
+
+Failure semantics: decisions are *leases*. Each delivered token expires
+after ``ttl_s`` back to ``normal`` inside the engine, and the hard debt
+ceiling overrides ``defer`` engine-side — so a wedged, crashed or
+partitioned scheduler degrades the cluster to exactly the engine-local
+trigger behavior it had before this module existed (the ``compact.sched``
+fail point + chaos test pin that). The scheduler can only ever *shape*
+compaction timing, never block it.
+"""
+
+import json
+import os
+import threading
+
+from ..rpc.transport import RpcError
+from ..runtime import lockrank
+from ..runtime.fail_points import inject
+from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
+from .cluster_doctor import ClusterCaller
+
+
+def _knobs() -> dict:
+    """Scheduler policy knobs, re-read per tick (cheap; lets tests and
+    operators retune a live scheduler without a restart)."""
+    return {
+        # L0 files at/over which a partition promotes to urgent
+        "urgent_l0": int(os.environ.get("PEGASUS_SCHED_URGENT_L0", "4")),
+        # committed-applied decree gap that promotes to urgent
+        "backlog_urgent": int(os.environ.get(
+            "PEGASUS_SCHED_BACKLOG_URGENT", "64")),
+        # urgent budget per node (0 = unbounded)
+        "max_urgent_per_node": int(os.environ.get(
+            "PEGASUS_SCHED_MAX_URGENT_PER_NODE", "2")),
+        # per-node concurrent device-compaction cap delivered with the
+        # decisions (0 = leave the node's gate alone)
+        "max_device": int(os.environ.get(
+            "PEGASUS_SCHED_MAX_DEVICE_COMPACT", "0")),
+        # decision lease: engines revert to local triggers this many
+        # seconds after the last delivery
+        "ttl_s": float(os.environ.get("PEGASUS_SCHED_TTL_S", "30")),
+    }
+
+
+def fold_decisions(parts: dict, hot=(), slow_count: int = 0,
+                   knobs: dict = None) -> dict:
+    """The deterministic CLUSTER-level decision fold — what each
+    partition needs, independent of which node serves it. Pure: no RPC,
+    no clock. Per-NODE bounding (breaker-open skip, the urgent budget)
+    happens at delivery in ``localize_decisions``, per receiving node:
+    every replica compacts independently, so those rules must bind at
+    each receiver, not at the primary the fold would otherwise key on.
+
+    ``parts``: {gpid: {"node", "l0_files", "debt_bytes",
+    "pending_installs", "apply_gap", "ceiling_files"}} — the primary's
+    beacon-reported debt/lag state. ``hot``: gpids with a confirmed
+    read-hot verdict. ``slow_count``: size of the cluster slow-request
+    rollup. -> {gpid: {"policy", "reasons", "node", "l0_files",
+    "debt_bytes"}}."""
+    k = dict(_knobs(), **(knobs or {}))
+    hot = set(hot)
+    out = {}
+    for gpid, st in sorted(parts.items()):
+        l0 = int(st.get("l0_files", 0))
+        ceiling = int(st.get("ceiling_files", 0)) or max(
+            1, k["urgent_l0"] * 3)
+        reasons = []
+        if l0 >= ceiling:
+            # the engine-local trigger fires here no matter what the
+            # scheduler says; agreeing keeps the status surface truthful
+            # and lets manual compactions jump the queue
+            policy = "urgent"
+            reasons.append("debt_ceiling")
+        elif gpid in hot:
+            policy = "defer"
+            reasons.append("hot_read")
+        else:
+            policy = "normal"
+            if int(st.get("apply_gap", 0)) >= k["backlog_urgent"]:
+                policy = "urgent"
+                reasons.append("apply_backlog")
+                if slow_count > 0:
+                    reasons.append("slow_requests")
+            if l0 >= k["urgent_l0"]:
+                policy = "urgent"
+                reasons.append("l0_debt")
+        out[gpid] = {"policy": policy, "reasons": reasons,
+                     "node": st.get("node", ""), "l0_files": l0,
+                     "debt_bytes": int(st.get("debt_bytes", 0))}
+    return out
+
+
+def localize_decisions(decisions: dict, hosts: dict, node: str,
+                       breaker_open: bool = False, cap: int = 0) -> dict:
+    """Per-receiving-node half of the decision pipeline: the fold says
+    what each partition needs; this bounds what ONE node is asked to do.
+    Urgent tokens demote to normal (reason appended) for a breaker-open
+    receiver (never promote onto a degraded device lane) and past the
+    receiver's urgent budget of `cap` non-ceiling urgents (highest debt
+    first, deterministic gpid tie-break); ceiling urgents pass through
+    untouched (the engine-local trigger fires there regardless). A
+    healthy receiver with free budget keeps every promotion — the
+    demotions are per node, never global. DEFER tokens land on the
+    PRIMARY only (the fold's `node`): the read-residency pin that
+    justifies holding compaction lives on the primary's engine, so a
+    secondary deferring would ride its debt to the ceiling's inline
+    apply-path stall for zero read benefit (`defer_primary_only`).
+    -> {gpid: {"policy", "reasons"}} for the partitions `node` hosts."""
+    order = sorted((g for g in decisions if node in hosts.get(g, ())),
+                   key=lambda g: (decisions[g]["debt_bytes"],
+                                  decisions[g]["l0_files"], g),
+                   reverse=True)
+    mine = {}
+    urgent_sent = 0
+    for g in order:
+        d = decisions[g]
+        policy, reasons = d["policy"], list(d["reasons"])
+        if policy == "urgent" and "debt_ceiling" not in reasons:
+            if breaker_open:
+                policy = "normal"
+                reasons.append("breaker_open")
+            elif cap > 0 and urgent_sent >= cap:
+                policy = "normal"
+                reasons.append("node_cap")
+            else:
+                urgent_sent += 1
+        elif policy == "defer" and d.get("node") and node != d["node"]:
+            policy = "normal"
+            reasons.append("defer_primary_only")
+        mine[g] = {"policy": policy, "reasons": reasons}
+    return mine
+
+
+def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
+                       slow_count: int = 0, caller: ClusterCaller = None,
+                       deliver: bool = True, knobs: dict = None) -> dict:
+    """One scheduler round over the live cluster. -> report dict:
+    ``{"decisions": {gpid: {...}}, "delivered": {node: {gpid: policy}},
+    "nodes": N, "errors": [...]}``.
+
+    Folds the meta's cluster-state snapshot (partition configs + the
+    beacon-carried per-replica ``compact`` debt and committed/applied
+    decrees) with per-node compact-lane breaker scrapes, then delivers
+    each alive node the decisions for every partition it hosts (primary
+    AND secondaries — each replica compacts independently) over
+    ``compact-sched-policy``. Every failure is an entry in ``errors``,
+    never an exception: a half-delivered round is strictly better than
+    none, and undelivered tokens simply expire."""
+    inject("compact.sched")  # chaos seam: a wedged/crashed tick must
+    # never block writes or compactions (engine-local triggers + token
+    # expiry are the fallback; see tests/test_compact_scheduler.py)
+    counters.rate("sched.tick_count").increment()
+    own = caller is None
+    caller = caller or ClusterCaller(meta_addrs, pool=pool)
+    report = {"decisions": {}, "delivered": {}, "nodes": 0, "errors": []}
+    k = dict(_knobs(), **(knobs or {}))
+    try:
+        state = caller.meta_state()
+        if state is None:
+            report["errors"].append("no meta reachable")
+            return report
+        nodes = state.get("nodes", {})
+        alive = sorted(a for a, n in nodes.items() if n.get("alive"))
+        report["nodes"] = len(alive)
+        breakers = {}
+        for node in alive:
+            try:
+                snap = json.loads(caller.remote_command(
+                    node, "perf-counters-by-substr",
+                    ["compact.lane.breaker_open"]))
+                breakers[node] = bool(snap.get("compact.lane.breaker_open"))
+            except (RpcError, OSError, ValueError):
+                # unknown lane state: treat as healthy — a scrape hiccup
+                # must not strip a node of promotions it may need
+                breakers[node] = False
+        parts, hosts = {}, {}
+        rs = state.get("replica_states", {})
+        for app in state.get("apps", {}).values():
+            for pc in app.get("partitions", []):
+                gpid = f"{app['app_id']}.{pc['pidx']}"
+                members = [m for m in [pc.get("primary")]
+                           + pc.get("secondaries", []) if m and m in alive]
+                primary = pc.get("primary")
+                st = rs.get(primary, {}).get(gpid) if primary else None
+                if not members or not st:
+                    continue  # unserved / not yet beaconed: nothing to say
+                debt = st.get("compact") or {}
+                parts[gpid] = {
+                    "node": primary,
+                    "l0_files": debt.get("l0_files", 0),
+                    "debt_bytes": debt.get("debt_bytes", 0),
+                    "pending_installs": debt.get("pending_installs", 0),
+                    "ceiling_files": debt.get("ceiling_files", 0),
+                    "apply_gap": max(0, st.get("committed", 0)
+                                     - st.get("applied", 0)),
+                }
+                hosts[gpid] = members
+        decisions = fold_decisions(parts, hot=hot_gpids or (),
+                                   slow_count=slow_count, knobs=k)
+        report["decisions"] = decisions
+        counters.number("sched.decisions.defer").set(
+            sum(1 for d in decisions.values() if d["policy"] == "defer"))
+        counters.number("sched.decisions.urgent").set(
+            sum(1 for d in decisions.values() if d["policy"] == "urgent"))
+        if not deliver:
+            return report
+        for node in alive:
+            mine = localize_decisions(decisions, hosts, node,
+                                      breaker_open=breakers.get(node, False),
+                                      cap=k["max_urgent_per_node"])
+            if not mine:
+                continue
+            body = {"ttl_s": k["ttl_s"], "decisions": mine}
+            if k["max_device"] > 0:
+                body["max_device"] = k["max_device"]
+            try:
+                out = caller.remote_command(node, "compact-sched-policy",
+                                            [json.dumps(body)])
+                report["delivered"][node] = json.loads(out)
+            except (RpcError, OSError, ValueError) as e:
+                counters.rate("sched.deliver_errors").increment()
+                report["errors"].append(f"{node}: {e}")
+    finally:
+        if own:
+            caller.close()
+    return report
+
+
+class CompactScheduler:
+    """The collector-hosted control loop: one ``run_scheduler_tick`` per
+    interval, the info collector's read-residency pins and slow-request
+    rollup wired into the fold. Armed by ``PEGASUS_SCHED=1`` (the
+    CollectorApp constructs it); ``compact-sched-status`` on the
+    collector and collector-info's ``compact_sched`` key expose the last
+    round's decisions."""
+
+    def __init__(self, meta_addrs, pool=None, interval_seconds: float = None,
+                 hot_fn=None, slow_fn=None):
+        self.meta_addrs = list(meta_addrs)
+        self.pool = pool
+        self.interval = (float(os.environ.get("PEGASUS_SCHED_INTERVAL_S",
+                                              "5"))
+                         if interval_seconds is None else interval_seconds)
+        self.hot_fn = hot_fn or (lambda: ())
+        self.slow_fn = slow_fn or (lambda: 0)
+        self._stop = threading.Event()
+        # leaf lock over the published report (the loop writes, the
+        # status command reads on an RPC thread)
+        self._lock = lockrank.named_lock("sched.state")
+        self._last = {}  #: guarded_by self._lock
+        self._thread = spawn_thread(self._loop, daemon=True, start=False,
+                                    name="compact-sched")
+
+    def start(self) -> "CompactScheduler":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the loop and JOIN it (bounded): the caller closes the
+        shared pool next, and an in-flight tick racing that close would
+        spray false tick/deliver errors through every clean shutdown."""
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception as e:  # a failed tick must never kill the
+                # loop — the next interval retries, and engine tokens
+                # expiring is the designed degradation
+                counters.rate("sched.tick_errors").increment()
+                print(f"[compact-sched] tick failed: {e!r}", flush=True)
+
+    def tick(self) -> dict:
+        report = run_scheduler_tick(self.meta_addrs, pool=self.pool,
+                                    hot_gpids=self.hot_fn(),
+                                    slow_count=self.slow_fn())
+        with self._lock:
+            self._last = report
+        return report
+
+    def status(self) -> dict:
+        """The last round's report (decisions with reasons, delivery map,
+        errors) — JSON-ready."""
+        with self._lock:
+            return dict(self._last)

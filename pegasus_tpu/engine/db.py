@@ -178,6 +178,75 @@ class _HbmGauges:
 HBM_GAUGES = _HbmGauges()
 
 
+class _SchedGate:
+    """Per-node concurrent device-compaction cap (ISSUE 10): the cluster
+    compaction scheduler bounds how many device merges run at once on
+    one node so the TPU lane never convoys behind a burst of L0
+    triggers. Elective (trigger-path) compactions defer at the cap;
+    urgent/ceiling compactions and manual compacts always proceed — the
+    cap shapes timing, never availability. max=0 (the default, knob
+    PEGASUS_SCHED_MAX_DEVICE_COMPACT) disables the gate entirely, so the
+    scheduler-off behavior is byte-identical to the pre-gate engine.
+    Leaf lock: never takes an engine lock (callers hold theirs)."""
+
+    def __init__(self):
+        from ..runtime.perf_counters import counters
+
+        self._lock = lockrank.named_lock("engine.sched_gate")
+        # resolved once: enter/exit run under self._lock on every device
+        # compaction, and a per-call registry lookup would nest the
+        # registry lock under the gate lock each time
+        self._c_running = counters.number(
+            "engine.compact.sched.device_running")
+        self._default = int(os.environ.get(
+            "PEGASUS_SCHED_MAX_DEVICE_COMPACT", "0"))
+        self._ttl_default = float(os.environ.get("PEGASUS_SCHED_TTL_S",
+                                                 "30"))
+        self._max = self._default      #: guarded_by self._lock
+        # set caps are LEASES like the policy tokens: expiry reverts to
+        # the env default, so a dead scheduler (or a one-off hand
+        # delivery) can never leave a node capped forever
+        self._max_expire = None        #: guarded_by self._lock
+        self._running = 0              #: guarded_by self._lock
+
+    def set_max(self, n, ttl_s: float = None) -> None:
+        """Install a cap lease (ttl_s default PEGASUS_SCHED_TTL_S —
+        every set expires; only the env default is permanent)."""
+        with self._lock:
+            self._max = max(0, int(n))
+            self._max_expire = time.monotonic() + (
+                self._ttl_default if ttl_s is None else float(ttl_s))
+
+    def _max_locked(self) -> int:  #: requires self._lock
+        if self._max_expire is not None \
+                and time.monotonic() >= self._max_expire:
+            self._max, self._max_expire = self._default, None
+        return self._max
+
+    def at_cap(self) -> bool:
+        with self._lock:
+            m = self._max_locked()
+            return m > 0 and self._running >= m
+
+    def enter(self) -> None:
+        with self._lock:
+            self._running += 1
+            self._c_running.set(self._running)
+
+    def exit(self) -> None:
+        with self._lock:
+            self._running -= 1
+            self._c_running.set(self._running)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"max": self._max_locked(), "default": self._default,
+                    "running": self._running}
+
+
+SCHED_GATE = _SchedGate()
+
+
 def _fail(name: str):
     """FAIL_POINT_INJECT_F call-site helper: only the 'return' verb injects
     a failure; 'print' logs and continues (ADVICE r1: a print-armed point
@@ -242,6 +311,32 @@ class LsmEngine:
         self._manifest_dirty = False  #: guarded_by self._lock
         # lazy sharded-compaction mesh
         self._resolved_mesh = _UNRESOLVED  #: guarded_by self._compaction_lock
+        # cluster compaction scheduler (ISSUE 10): the per-partition
+        # policy token the scheduler delivers over compact-sched-policy.
+        # Tokens EXPIRE (ttl) back to "normal": a dead scheduler reverts
+        # the engine to its local triggers, never wedges them
+        self._sched_policy = "normal"  #: guarded_by self._lock
+        self._sched_reasons = ()       #: guarded_by self._lock
+        self._sched_expire = 0.0       #: guarded_by self._lock
+        # hard debt ceiling (L0 files) above which the engine-local
+        # trigger ALWAYS wins, defer token or not — the availability
+        # floor under any scheduler decision. 0 = 3x the L0 trigger.
+        ceil = int(os.environ.get("PEGASUS_SCHED_DEBT_CEILING_FILES", "0"))
+        self._sched_ceiling = ceil if ceil > 0 else max(
+            1, self.opts.l0_compaction_trigger * 3)
+        self._sched_ttl_s = float(os.environ.get("PEGASUS_SCHED_TTL_S",
+                                                 "30"))
+        # trigger-path counters resolved ONCE (the L0 gate runs on every
+        # flush drain and maintenance poke — no per-call registry lookup)
+        from ..runtime.perf_counters import counters
+        self._c_sched_ceiling = counters.rate(
+            "engine.compact.sched.ceiling_override_count")
+        self._c_sched_deferred = counters.rate(
+            "engine.compact.sched.deferred_count")
+        self._c_sched_urgent = counters.rate(
+            "engine.compact.sched.urgent_count")
+        self._c_sched_gate_deferred = counters.rate(
+            "engine.compact.sched.gate_deferred_count")
         # device-read knobs resolved ONCE (the coalescer consults them on
         # every point read — no per-get environ parse); the backend check
         # stays dynamic because app-envs can flip it at runtime
@@ -699,9 +794,8 @@ class LsmEngine:
                     imm = self._imm[-1]  # list is newest-first: take oldest
                 self._flush_one(imm)
                 drained = True
-        if drained and \
-                len(self._l0) >= self.opts.l0_compaction_trigger:  #: unguarded_ok racy trigger check: compact() re-snapshots under its locks; worst case is one early/late compaction
-            self.compact()
+        if drained:
+            self._maybe_trigger_l0()
 
     def _rotate_memtable_locked(self):  #: requires self._lock
         if len(self._mem) == 0:
@@ -853,6 +947,123 @@ class LsmEngine:
             sst._device_budgeted = False
             sst._device_run = None
 
+    # ------------------------------------------------- compaction scheduling
+
+    def set_compact_policy(self, policy: str, reasons=(),
+                           ttl_s: float = None) -> None:
+        """Install the cluster scheduler's per-partition policy token
+        (ISSUE 10): 'defer' holds the elective L0 trigger (below the hard
+        debt ceiling), 'urgent' fires it at half the normal threshold and
+        lets manual compactions jump the concurrency queue, 'normal' is
+        the engine-local behavior. The token expires after ttl_s (default
+        PEGASUS_SCHED_TTL_S) back to 'normal' — a dead scheduler can
+        never wedge compaction."""
+        if policy not in ("defer", "normal", "urgent"):
+            raise ValueError(f"bad compaction policy {policy!r}")
+        with self._lock:
+            self._sched_policy = policy
+            self._sched_reasons = tuple(reasons)
+            self._sched_expire = time.monotonic() + (
+                self._sched_ttl_s if ttl_s is None else float(ttl_s))
+
+    def compact_policy(self) -> tuple:
+        """-> (policy, reasons, expires_in_s); an expired token reads —
+        and resets — as ('normal', [], 0.0)."""
+        with self._lock:
+            now = time.monotonic()
+            if self._sched_policy != "normal" and now >= self._sched_expire:
+                self._sched_policy, self._sched_reasons = "normal", ()
+            return (self._sched_policy, list(self._sched_reasons),
+                    max(0.0, self._sched_expire - now)
+                    if self._sched_policy != "normal" else 0.0)
+
+    def compact_policy_fast(self) -> str:
+        """Lock-free policy peek for the per-write admission path (the
+        debt throttle keys its slope on whether a defer token is
+        deliberately accumulating this debt). Expiry is NOT checked: a
+        just-lapsed defer reads as defer until the next trigger-path
+        compact_policy() call resets it — at most one extra lenient
+        admission window, never a correctness issue."""
+        return self._sched_policy  #: unguarded_ok racy admission peek of an atomically-assigned str; compact_policy() under the lock is authoritative
+
+    def compaction_debt(self) -> dict:
+        """Compaction-debt fold (ISSUE 10): what the scheduler, the
+        beacon gauges, db.stats() and the admission throttle all read —
+        L0 file count, debt bytes (L0 bytes + every level's over-budget
+        overflow, i.e. the pending-cascade work), and the deferred-
+        install depth still riding the pipeline pool."""
+        with self._lock:
+            over = 0
+            for lv in self._levels:
+                if self._levels[lv]:
+                    over += max(0,
+                                self._level_bytes(lv) - self._level_budget(lv))
+            return {"l0_files": len(self._l0),
+                    "debt_bytes": sum(s.data_bytes for s in self._l0) + over,
+                    "pending_installs": sum(
+                        1 for f in self._pending_installs if not f.done()),
+                    "ceiling_files": self._sched_ceiling}
+
+    def compact_debt_ratio(self) -> float:
+        """L0 debt as a fraction of the hard ceiling — the admission
+        throttle charges this on EVERY write, so it is a deliberately
+        lock-free racy read (a one-file-stale ratio only shifts a delay
+        by one write)."""
+        return len(self._l0) / float(self._sched_ceiling)  #: unguarded_ok racy admission gauge: len() of a list the trigger path re-snapshots under its locks
+
+    def _maybe_trigger_l0(self) -> bool:
+        """Post-flush/ingest L0 trigger behind the scheduler gate
+        (ISSUE 10). With no (or an expired) policy token this is exactly
+        the old `len(l0) >= trigger -> compact()` — the byte-identical
+        engine-local fallback a dead scheduler degrades to. A 'defer'
+        token holds the elective trigger until the hard debt ceiling,
+        where the engine-local trigger always wins; an 'urgent' token
+        fires at half the normal threshold; an elective trigger defers
+        while the per-node device gate is at its cap. -> True when a
+        compaction actually ran (poke_compaction bounds its per-tick
+        work on this)."""
+        l0 = len(self._l0)  #: unguarded_ok racy trigger check: compact() re-snapshots under its locks; worst case is one early/late compaction
+        policy, _, _ = self.compact_policy()
+        if l0 >= self._sched_ceiling:
+            # availability floor: the engine-local trigger overrides any
+            # defer once debt hits the ceiling (a wedged/dead scheduler
+            # can never stall compaction into a write cliff)
+            if policy == "defer":
+                self._c_sched_ceiling.increment()
+            self.compact()
+            return True
+        if policy == "defer":
+            if l0 >= self.opts.l0_compaction_trigger:
+                self._c_sched_deferred.increment()
+            return False
+        if policy == "urgent":
+            if l0 >= max(1, self.opts.l0_compaction_trigger // 2):
+                self._c_sched_urgent.increment()
+                self.compact()
+                return True
+            return False
+        if l0 >= self.opts.l0_compaction_trigger:
+            if self.opts.backend == "tpu" and SCHED_GATE.at_cap():
+                # the node's device lanes are saturated: hold this
+                # elective merge (debt stays; the next flush, the
+                # maintenance poke, or the ceiling retries) instead of
+                # convoying the TPU lane
+                self._c_sched_gate_deferred.increment()
+                return False
+            self.compact()
+            return True
+        return False
+
+    def poke_compaction(self) -> bool:
+        """Idle retry of the L0 trigger gate (the replica maintenance
+        timer calls this): debt a since-expired defer token or a
+        since-freed device gate left above the trigger compacts without
+        waiting for the next flush — an idle engine must not carry
+        trigger-level read amplification forever. -> True when a
+        compaction ran (the caller limits pokes per tick so one
+        synchronous merge cannot stall its siblings' maintenance)."""
+        return self._maybe_trigger_l0()
+
     def _bottommost(self, target_level: int) -> bool:
         """Tombstones may only drop when no lower level could hold the key."""
         deeper = any(self._levels.get(lv) for lv in  #: unguarded_ok level membership only changes under the compaction lock, which every caller holds; flush only touches L0
@@ -875,10 +1086,17 @@ class LsmEngine:
                 hi = max(s.max_key for s in nonzero)
                 overlap = self._overlapping_locked(1, lo, hi)
             bm = self._bottommost(1) if bottommost is None else bottommost
-            stats = self._merge_to_level(inputs, overlap, target_level=1,
-                                         bottommost=bm, now=now,
-                                         deferred=True)
-            self._maybe_cascade(now)
+            gated = self.opts.backend == "tpu"
+            if gated:  # device-compaction concurrency accounting (ISSUE 10)
+                SCHED_GATE.enter()
+            try:
+                stats = self._merge_to_level(inputs, overlap, target_level=1,
+                                             bottommost=bm, now=now,
+                                             deferred=True)
+                self._maybe_cascade(now)
+            finally:
+                if gated:
+                    SCHED_GATE.exit()
             self._drain_pending_installs()
             return stats
 
@@ -1217,11 +1435,18 @@ class LsmEngine:
                 # The session records the per-stage breakdown (pack / h2d /
                 # device / gather / sst_write) into the stats the manual-
                 # compact service and shell report.
-                with COMPACT_TRACER.session() as sess:
-                    stats = self._merge_to_level(newer, older,
-                                                 target_level=tl,
-                                                 bottommost=bottommost,
-                                                 now=now, sharded=True)
+                gated = self.opts.backend == "tpu"
+                if gated:  # device-compaction concurrency accounting
+                    SCHED_GATE.enter()
+                try:
+                    with COMPACT_TRACER.session() as sess:
+                        stats = self._merge_to_level(newer, older,
+                                                     target_level=tl,
+                                                     bottommost=bottommost,
+                                                     now=now, sharded=True)
+                finally:
+                    if gated:
+                        SCHED_GATE.exit()
                 stats = dict(stats, trace=sess.summary())
         with self._lock:
             # under the engine lock: concurrent writers update _meta's
@@ -1247,8 +1472,7 @@ class LsmEngine:
         with self._lock:
             self._l0.insert(0, SSTable(path))
             self._write_manifest_locked()
-        if len(self._l0) >= self.opts.l0_compaction_trigger:  #: unguarded_ok racy trigger check: compact() re-snapshots under its locks; worst case is one early/late compaction
-            self.compact()
+        self._maybe_trigger_l0()
 
     # ------------------------------------------------------------- checkpoint
 
@@ -1470,7 +1694,14 @@ class LsmEngine:
 
     def stats(self) -> dict:
         with self._lock:
+            debt = self.compaction_debt()  # RLock: nested re-acquire
+            policy, reasons, _ = self.compact_policy()
             return {
+                "compact_debt_bytes": debt["debt_bytes"],
+                "pending_installs": debt["pending_installs"],
+                "compact_ceiling_files": debt["ceiling_files"],
+                "compact_policy": policy,
+                "compact_policy_reasons": reasons,
                 "memtable_records": len(self._mem),
                 "memtable_bytes": self._mem.approximate_bytes,
                 "immutable_memtables": len(self._imm),

@@ -35,16 +35,23 @@ _IDLE = "idle"
 
 class _ConcurrencyGate:
     """Process-wide running-count cap (cluster-wide in the reference,
-    enforced by meta-spread envs; one process hosts many replicas here)."""
+    enforced by meta-spread envs; one process hosts many replicas here).
+    urgent=True bypasses the cap: a partition the cluster compaction
+    scheduler marked urgent (slow-request-driving debt) jumps the queue
+    instead of waiting behind elective compactions (ISSUE 10)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.running = 0
 
-    def try_acquire(self, limit: int) -> bool:
+    def try_acquire(self, limit: int, urgent: bool = False) -> bool:
         with self._lock:
             if limit > 0 and self.running >= limit:
-                return False
+                if not urgent:
+                    return False
+                # counted HERE, under the lock that decided it: this
+                # acquire really did pass a cap that would have blocked
+                counters.rate("manual_compact.queue_jump_count").increment()
             self.running += 1
             return True
 
@@ -99,10 +106,15 @@ class ManualCompactService:
             return False
         limit = int(envs.get(
             consts.MANUAL_COMPACT_MAX_CONCURRENT_RUNNING_COUNT_KEY, 0))
+        # urgent scheduler token (ISSUE 10): this partition's debt is
+        # driving slow requests — jump the concurrency queue instead of
+        # waiting a round behind elective compactions (the gate counts
+        # real jumps as manual_compact.queue_jump_count)
+        urgent = self.server.engine.compact_policy()[0] == "urgent"
         with self._lock:
             if self._state != _IDLE:
                 return False
-            if not GATE.try_acquire(limit):
+            if not GATE.try_acquire(limit, urgent=urgent):
                 return False
             self._state = _QUEUED
             self._enqueue_ms = self.now_ms()

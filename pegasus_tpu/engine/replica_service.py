@@ -162,6 +162,11 @@ class ReplicaService:
                   + srv.write_size_throttler.delayed_count)
             srv.write_qps_throttler.consume(1)
             srv.write_size_throttler.consume(len(body))
+            # compaction-debt admission control (ISSUE 10): graduated
+            # delay as L0 debt approaches the stall cliff, reject past
+            # the configured ratio — counted on its own
+            # engine.throttle.debt_* series by the throttle itself
+            srv.debt_throttler.consume()
             if (srv.write_qps_throttler.delayed_count
                     + srv.write_size_throttler.delayed_count) > d0:
                 counters.rate(

@@ -203,11 +203,15 @@ class PegasusServer:
 
         self.read_hotkey = HotkeyCollector("read")
         self.write_hotkey = HotkeyCollector("write")
-        from .throttling import ThrottlingController
+        from .throttling import DebtThrottle, ThrottlingController
 
         self.write_qps_throttler = ThrottlingController()
         self.write_size_throttler = ThrottlingController()
         self.read_qps_throttler = ThrottlingController()
+        # compaction-debt admission control (ISSUE 10): graduated
+        # backpressure keyed on the engine's L0 debt, charged alongside
+        # the env throttles on every write
+        self.debt_throttler = DebtThrottle(self.engine)
         self.cu_calculator = CapacityUnitCalculator(
             app_id, pidx, read_hotkey=self.read_hotkey,
             write_hotkey=self.write_hotkey)

@@ -13,8 +13,16 @@ Env value grammar (the reference's parse_from_env):
 Units are requests for `replica.write_throttling`, request-body bytes for
 `replica.write_throttling_by_size`. Accounting is a per-second tumbling
 window, like the reference's token-refresh-per-second controller.
+
+ISSUE 10 adds ``DebtThrottle``: compaction-debt-driven admission control.
+The env throttles above bound *rates* an operator configured; the debt
+throttle bounds the *engine's* backlog — as L0 debt approaches the hard
+ceiling where the engine-local trigger compacts inline on the writer
+thread (the stall cliff), writes pick up a graduated, metric-visible
+delay so the cliff becomes a measured slope instead of an accident.
 """
 
+import os
 import threading
 import time
 
@@ -96,3 +104,81 @@ class ThrottlingController:
                 f"write throttled: {total} units/s > {self.reject_units}")
         if delay and pause:
             time.sleep(pause)
+
+
+class DebtThrottle:
+    """Compaction-debt admission control (ISSUE 10): charge every write
+    against the engine's L0-debt ratio (debt files / hard ceiling, a
+    lock-free racy read — see LsmEngine.compact_debt_ratio) and apply
+    graduated backpressure BEFORE the engine hits the stall cliff where
+    the ceiling trigger compacts inline on the writer thread:
+
+      ratio < soft                 free
+      soft <= ratio < 1.0          delay scaling linearly up to max_ms
+      ratio >= reject (if set)     ThrottleReject -> ERR_BUSY
+
+    Knobs (resolved once at construction): PEGASUS_SCHED_THROTTLE
+    (``0`` disables — byte-identical admission to the pre-throttle
+    engine), PEGASUS_SCHED_THROTTLE_SOFT (ratio where delay starts),
+    PEGASUS_SCHED_THROTTLE_MAX_MS (delay at the ceiling edge),
+    PEGASUS_SCHED_THROTTLE_REJECT (ratio that rejects; 0 = never).
+    Counters: engine.throttle.debt_delay_count / debt_reject_count
+    rates + the engine.throttle.debt_delay_ms percentile."""
+
+    def __init__(self, engine):
+        from ..runtime.perf_counters import counters
+
+        self.engine = engine
+        self.enabled = os.environ.get("PEGASUS_SCHED_THROTTLE", "1") != "0"
+        self.soft = float(os.environ.get("PEGASUS_SCHED_THROTTLE_SOFT",
+                                         "0.5"))
+        self.max_ms = float(os.environ.get("PEGASUS_SCHED_THROTTLE_MAX_MS",
+                                           "50"))
+        self.reject_ratio = float(os.environ.get(
+            "PEGASUS_SCHED_THROTTLE_REJECT", "0"))
+        # plain monotone counters for tests; the registry rates are the
+        # operator surface (resolved once — the admission path is per-write)
+        self.delayed_count = 0
+        self.rejected_count = 0
+        self._c_delay = counters.rate("engine.throttle.debt_delay_count")
+        self._c_reject = counters.rate("engine.throttle.debt_reject_count")
+        self._c_delay_ms = counters.percentile(
+            "engine.throttle.debt_delay_ms")
+
+    # a DEFER token means the scheduler is deliberately accumulating
+    # this debt (a read-hot partition holding its compaction): charging
+    # the normal slope there would collapse write throughput as a side
+    # effect of a read-side optimization. The throttle instead engages
+    # only in the last eighth before the ceiling cliff (the same 7/8
+    # convention as the HBM read-hot headroom) — close enough that the
+    # imminent ceiling-override compaction still gets its measured
+    # slowdown, far enough that the defer window itself is free.
+    DEFER_SOFT = 0.875
+
+    def consume(self) -> None:
+        """Charge one write; sleeps for the graduated delay, raises
+        ThrottleReject past the reject ratio. Called OUTSIDE any engine
+        lock (the sleep must never convoy other writers)."""
+        if not self.enabled:
+            return
+        ratio = self.engine.compact_debt_ratio()
+        soft = self.soft
+        if ratio >= soft \
+                and self.engine.compact_policy_fast() == "defer":
+            soft = max(soft, self.DEFER_SOFT)
+        if ratio < soft:
+            return
+        if self.reject_ratio and ratio >= self.reject_ratio:
+            self.rejected_count += 1
+            self._c_reject.increment()
+            raise ThrottleReject(
+                f"write throttled: compaction debt {ratio:.2f}x of the "
+                f"ceiling >= reject ratio {self.reject_ratio:.2f}")
+        frac = min(1.0, (ratio - self.soft) / max(1e-9, 1.0 - self.soft))
+        delay_ms = self.max_ms * frac
+        if delay_ms <= 0:
+            return
+        self.delayed_count += 1
+        self._c_delay.increment()
+        self._c_delay_ms.set(delay_ms)
+        time.sleep(delay_ms / 1000.0)

@@ -131,6 +131,13 @@ class Replica:
         self._c_committed = counters.number(pfx + "committed_decree")
         self._c_applied = counters.number(pfx + "applied_decree")
         self._c_gap = counters.number(pfx + "secondary_gap_max")
+        # compaction-debt plane (ISSUE 10): per-partition gauges the
+        # scheduler, doctor and collector read — refreshed per beacon
+        # tick from the same engine fold the beacon state carries
+        cpfx = f"engine.compact.{app_id}.{pidx}."
+        self._c_debt_l0 = counters.number(cpfx + "l0_files")
+        self._c_debt_bytes = counters.number(cpfx + "debt_bytes")
+        self._c_debt_pending = counters.number(cpfx + "pending_installs")
         self._recover_from_log()
 
     def _prepare_pool(self):
@@ -300,6 +307,18 @@ class Replica:
         self._c_backlog.set(len(self._uncommitted))
         self._c_committed.set(self.last_committed)
         self._c_applied.set(self.server.engine.last_committed_decree())
+
+    def compact_debt(self) -> dict:
+        """Per-partition compaction-debt snapshot (ISSUE 10): one engine
+        fold feeding the `engine.compact.<a>.<p>.*` gauges, the beacon
+        state the meta snapshot republishes, and db.stats() — the
+        scheduler, the doctor and the collector all read the same
+        series. Refreshed per beacon tick."""
+        debt = self.server.engine.compaction_debt()
+        self._c_debt_l0.set(debt["l0_files"])
+        self._c_debt_bytes.set(debt["debt_bytes"])
+        self._c_debt_pending.set(debt["pending_installs"])
+        return debt
 
     def _send_prepare_window(self, peer_name: str, ms: list):
         """Send one windowed prepare to a peer. Returns the peer's highest
@@ -607,6 +626,9 @@ class Replica:
         for name in ("inflight", "backlog", "committed_decree",
                      "applied_decree", "secondary_gap_max", "learning"):
             counters.remove(f"replica.{self.app_id}.{self.pidx}.{name}")
+        for name in ("l0_files", "debt_bytes", "pending_installs"):
+            counters.remove(
+                f"engine.compact.{self.app_id}.{self.pidx}.{name}")
         if self._prep_pool is not None:
             self._prep_pool.shutdown(wait=False)
             self._prep_pool = None

@@ -170,6 +170,10 @@ class ReplicaStub:
         self.commands.register("flush-log", self._cmd_flush_log)
         self.commands.register("trigger-audit", self._cmd_trigger_audit)
         self.commands.register("query-audit", self._cmd_query_audit)
+        self.commands.register("compact-sched-policy",
+                               self._cmd_compact_sched_policy)
+        self.commands.register("compact-sched-status",
+                               self._cmd_compact_sched_status)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
@@ -225,7 +229,12 @@ class ReplicaStub:
                   "ballot": rep.ballot,
                   "committed": rep.last_committed,
                   "applied": rep.server.engine.last_committed_decree(),
-                  "prepared": rep.last_prepared}
+                  "prepared": rep.last_prepared,
+                  # compaction-debt plane (ISSUE 10): the scheduler folds
+                  # this out of the meta's cluster-state snapshot; the
+                  # call also refreshes the engine.compact.<a>.<p>.*
+                  # gauges so every surface reads the same fold
+                  "compact": rep.compact_debt()}
             la = rep.server.last_audit
             if la:
                 st["audit"] = {"audit_id": la.get("audit_id", 0),
@@ -308,6 +317,18 @@ class ReplicaStub:
                     rep.server.manual_compact_service \
                         .start_manual_compact_if_needed(rep.server.app_envs)
                 except Exception as e:  # keep the timer alive
+                    print(f"[maintenance] {rep.name}: {e!r}", flush=True)
+            # idle retry of a scheduler-held L0 trigger: debt a lapsed
+            # defer token or a freed device gate left above the trigger
+            # must compact without waiting for the next flush. AFTER
+            # the light per-replica work, and at most ONE synchronous
+            # compaction per tick — a multi-second merge must not stall
+            # every other replica's checkpoint/GC behind it
+            for rep in reps:
+                try:
+                    if rep.server.engine.poke_compaction():
+                        break
+                except Exception as e:
                     print(f"[maintenance] {rep.name}: {e!r}", flush=True)
 
     # ------------------------------------------------------------- beacons
@@ -875,6 +896,81 @@ class ReplicaStub:
             if la:
                 ent["audit"] = dict(la)
             out[gpid] = ent
+        return json.dumps(out)
+
+    def _cmd_compact_sched_policy(self, args: list) -> str:
+        """compact-sched-policy <json> — the cluster compaction
+        scheduler's delivery surface (ISSUE 10). The body is
+        ``{"ttl_s": s, "decisions": {"<app>.<pidx>": {"policy":
+        defer|normal|urgent, "reasons": [...]}}, "max_device": n?}``:
+        each hosted partition named installs the policy token on its
+        engine (expiring after ttl_s — a dead scheduler reverts to
+        engine-local triggers), max_device caps this node's concurrent
+        device compactions. Returns {gpid: policy} for what applied
+        (disjoint keys merge cleanly through the group router)."""
+        if not args:
+            return "usage: compact-sched-policy <json>"
+        try:
+            req = json.loads(" ".join(args))
+        except ValueError as e:
+            return f"bad policy json: {e}"
+        ttl = req.get("ttl_s")
+        if "max_device" in req:
+            from ..engine.db import SCHED_GATE
+
+            # same lease as the tokens (set_max defaults the ttl): a
+            # dead scheduler's cap expires back to the node's env
+            # default instead of sticking forever. In partition-group
+            # mode the command fans out to EVERY worker process and the
+            # gate is per-process, so each worker takes its share of
+            # the node cap (at least 1 — 0 would mean "no gate")
+            cap = max(0, int(req["max_device"]))
+            if cap > 0 and self.group_spec:
+                cap = max(1, cap // self.group_spec["group_count"])
+            SCHED_GATE.set_max(cap, ttl_s=ttl)
+        with self._lock:
+            reps = dict(self._replicas)
+        applied = {}
+        for gpid, dec in sorted((req.get("decisions") or {}).items()):
+            a, _, p = gpid.partition(".")
+            try:
+                rep = reps.get((int(a), int(p)))
+            except ValueError:
+                continue
+            if rep is None:
+                continue
+            policy = dec.get("policy", "normal")
+            try:
+                rep.server.engine.set_compact_policy(
+                    policy, reasons=dec.get("reasons", ()), ttl_s=ttl)
+            except ValueError as e:
+                applied[gpid] = f"error: {e}"
+                continue
+            applied[gpid] = policy
+        return json.dumps(applied)
+
+    def _cmd_compact_sched_status(self, args: list) -> str:
+        """compact-sched-status [gpid] — each hosted (or the named)
+        partition's live scheduler token (policy + the reasons that
+        drove it + time to expiry) and its current compaction debt,
+        keyed by gpid (JSON dict; disjoint keys merge cleanly through
+        the group router's structural fan-out merge)."""
+        with self._lock:
+            targets = list(self._replicas.items())
+        out = {}
+        for (a, p), rep in targets:
+            gpid = f"{a}.{p}"
+            if args and args[0] != gpid:
+                continue
+            policy, reasons, expires_in = rep.server.engine.compact_policy()
+            debt = rep.server.engine.compaction_debt()
+            out[gpid] = {"policy": policy, "reasons": reasons,
+                         "expires_in_s": round(expires_in, 3),
+                         "l0_files": debt["l0_files"],
+                         "debt_bytes": debt["debt_bytes"],
+                         "pending_installs": debt["pending_installs"],
+                         "ceiling_files": debt["ceiling_files"],
+                         "node": self.address}
         return json.dumps(out)
 
     def _cmd_flush_log(self, args: list) -> str:

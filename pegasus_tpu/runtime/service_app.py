@@ -463,6 +463,24 @@ class CollectorApp:
         self.collector = InfoCollector(
             list(self.metas),
             interval_seconds=config.get_float(section, "interval_seconds", 10.0))
+        # cluster compaction scheduler (ISSUE 10): PEGASUS_SCHED=1 arms
+        # the debt-driven control loop; the info collector's confirmed
+        # read-hot pins and slow-request rollup feed the decision fold.
+        # Off (the default), engines run their local triggers untouched.
+        self.scheduler = None
+        if os.environ.get("PEGASUS_SCHED", "") == "1":
+            from ..collector.compact_scheduler import CompactScheduler
+
+            def _hot_gpids():
+                # read_residency publishes copy-on-write: lock-free
+                # iteration always sees a stable snapshot
+                return {t["gpid"]
+                        for t in dict(self.collector.read_residency).values()}
+
+            self.scheduler = CompactScheduler(
+                list(self.metas), pool=self.collector.pool,
+                hot_fn=_hot_gpids,
+                slow_fn=lambda: len(self.collector.cluster_slow_requests))
         self.detector = AvailableDetector(
             list(self.metas), table_name=self.detect_table,
             interval_seconds=config.get_float(section,
@@ -482,9 +500,24 @@ class CollectorApp:
                 "compact_stats": self.collector.compact_stats,
                 "lag_stats": self.collector.lag_stats,
                 "slow_requests": self.collector.cluster_slow_requests,
+                "compact_sched": (
+                    dict(self.scheduler.status(), enabled=True)
+                    if self.scheduler else {"enabled": False}),
             })
 
         self.commands.register("collector-info", info)
+
+        def compact_sched_status(args):
+            """compact-sched-status — the scheduler's last decision round
+            (per-partition policy + reasons, delivery map, errors); the
+            replica-side command of the same name shows the tokens as
+            the engines see them."""
+            if self.scheduler is None:
+                return json.dumps({"enabled": False})
+            return json.dumps(dict(self.scheduler.status(), enabled=True),
+                              indent=1)
+
+        self.commands.register("compact-sched-status", compact_sched_status)
 
         def cluster_doctor(args):
             """cluster-doctor [last] — one structured cluster-health
@@ -571,6 +604,8 @@ class CollectorApp:
 
         spawn_thread(self._ensure_probe_table_loop, daemon=True)
         self.collector.start()
+        if self.scheduler is not None:
+            self.scheduler.start()
         self.detector.start()
         print(f"[pegasus-tpu] collector rpc on {self.address}", flush=True)
         return self
@@ -580,6 +615,8 @@ class CollectorApp:
         if self.reporter:
             self.reporter.stop()
         self.detector.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()  # before the collector closes their pool
         self.collector.stop()
         self.rpc.stop()
 

@@ -74,6 +74,12 @@ class Shell:
                                "trigger once manual compaction via app envs"),
             "query_compact_state": (self.cmd_query_compact,
                                     "query manual compact state on nodes"),
+            "compact_sched": (self.cmd_compact_sched,
+                              "compact_sched [node|all] [gpid] — per-"
+                              "partition compaction-scheduler decisions "
+                              "(defer/normal/urgent + the reasons that "
+                              "drove them + live debt) from every node's "
+                              "compact-sched-status"),
             "remote_command": (self.cmd_remote_command,
                                "remote_command <node|all> <cmd> [args...]"),
             "server_info": (self.cmd_server_info, "server-info on every node"),
@@ -511,6 +517,37 @@ class Shell:
             if n.alive:
                 self.p(f"[{n.address}]")
                 self.p(self._node_command(n.address, "query-compact-state", []))
+
+    def cmd_compact_sched(self, args):
+        """Per-partition compaction-scheduler decisions, one line per
+        gpid: the policy token, the reasons that drove it (which signal
+        deferred/promoted it) and the live debt behind it."""
+        target = args[0] if args else "all"
+        rest = args[1:]
+        nodes = ([n.address for n in self._nodes() if n.alive]
+                 if target == "all" else [target])
+        for node in nodes:
+            try:
+                out = self._node_command(node, "compact-sched-status", rest)
+                doc = json.loads(out)
+            except (RpcError, OSError, ValueError) as e:
+                self.p(f"[{node}] unreachable/bad reply: {e}")
+                continue
+            self.p(f"[{node}]")
+            if not isinstance(doc, dict) or not doc:
+                self.p("  no partitions")
+                continue
+            for gpid, d in sorted(doc.items()):
+                if not isinstance(d, dict) or "policy" not in d:
+                    self.p(f"  {gpid}: {d}")
+                    continue
+                reasons = ",".join(d.get("reasons", [])) or "-"
+                self.p(f"  {gpid}: {d['policy']:<7} reasons={reasons} "
+                       f"l0={d.get('l0_files', 0)}"
+                       f"/{d.get('ceiling_files', '?')} "
+                       f"debt_bytes={d.get('debt_bytes', 0)} "
+                       f"pending={d.get('pending_installs', 0)} "
+                       f"expires_in={d.get('expires_in_s', 0)}s")
 
     def cmd_remote_command(self, args):
         target, cmd, rest = args[0], args[1], args[2:]

@@ -273,8 +273,10 @@ def test_wedged_pipeline_prefetch_abandoned_cpu_rerun_byte_equal(guard):
     assert st["deadline_abandons"] == 1
     assert st["fallbacks"] == 1
     assert st["retries"] == 0  # a wedge must NOT retry
-    # the cpu rerun did not wait out the 1.5s wedge: abandon + rerun only
-    assert elapsed < 1.2, elapsed
+    # the cpu rerun did not wait out the 1.5s wedge: abandon + rerun
+    # only (waiting it out would be >= 1.5 + rerun; the 0.9s scaled
+    # deadline + rerun can brush 1.3 on a loaded 1-core box)
+    assert elapsed < 1.45, elapsed
     # the stall was attributable (open pipeline.stall span in the
     # abandoned lane thread)
     assert "pipeline.stall" in st["last_failure"]["error"]
