@@ -1,0 +1,24 @@
+"""Production-sim chaos harness (ISSUE 11).
+
+The pressure tier's scenario engine: a declarative fault schedule
+(`scenario.Scenario` — timed/periodic `FaultAction`s with arm/heal pairs
+and per-action recovery deadlines) driven by `scenario.ScenarioRunner`
+against fault actors (`actors` — node kill+restart, group-worker kill,
+remote fail-point arming, mid-load partition split, balancer primary
+move, compaction-scheduler token flips, duplication to a second
+cluster), with every event landing in an `journal.EventJournal` the run
+emits as its artifact.
+
+`tools/pressure_test.py --scenario smoke|full` is the driver: sustained
+target-QPS self-verifying load, the scripted fault schedule, periodic
+decree-anchored audit rounds (collector.cluster_doctor.AuditRounds), a
+cross-cluster digest compare for the duplication leg, and a final
+cluster-doctor verdict — exit 0 only when no acked write was lost, every
+transient error fell inside a declared fault window, every audit round
+was mismatch-free, and the doctor ends healthy.
+"""
+
+from .journal import EventJournal
+from .scenario import FaultAction, Scenario, ScenarioRunner
+
+__all__ = ["EventJournal", "FaultAction", "Scenario", "ScenarioRunner"]

@@ -1,0 +1,397 @@
+"""Fault actors: the arm/heal implementations the scenario engine drives.
+
+Each actor reuses EXISTING cluster machinery rather than inventing a
+parallel one (ISSUE 11): node kill+restart goes through the onebox
+cluster handles + the meta's failure detector and repair path,
+group-worker kill through `GroupedReplicaNode.kill_group/restart_group`
+(exercising the restart replay), fail points through the new
+``set-fail-point`` remote command (live arming in remote server
+processes), the split through ``RPC_CM_SPLIT_APP``, the primary move
+through the balancer's ``RPC_CM_PROPOSE``, and the scheduler flip
+through the ``compact-sched-policy`` delivery surface.
+
+Actors hold onebox/MiniCluster handles (``cluster``: an object with
+``stubs`` (list of replica nodes), ``meta`` (in-process MetaServer),
+``meta_addr`` and ``ddl(code, req, resp_cls)``) — this is the chaos
+harness's process, so in-process handles are the honest interface; every
+fault they inject still lands on the cluster over real sockets.
+"""
+
+import json
+import time
+
+from ..meta import messages as mm
+from ..meta.meta_server import (RPC_CM_PROPOSE, RPC_CM_QUERY_CONFIG,
+                                RPC_CM_SPLIT_APP)
+from ..rpc.transport import RpcError
+
+
+class FaultActor:
+    """Base: arm() injects the fault, heal() removes it, recovered()
+    reports whether the cluster has fully re-converged after heal (the
+    runner polls it against the action's recovery deadline)."""
+
+    def arm(self, **args):
+        raise NotImplementedError
+
+    def heal(self):
+        pass
+
+    def recovered(self) -> bool:
+        return True
+
+
+def _cluster_state(cluster, caller=None) -> dict:
+    """The meta's one-RPC cluster-state snapshot, over the public RPC
+    surface (None when the meta cannot answer). A provided `caller`
+    (cluster_doctor.ClusterCaller) is REUSED — recovery polls run every
+    0.2 s, and opening a fresh TCP connection per poll piles hundreds of
+    short-lived sockets onto a recovering cluster."""
+    from ..collector.cluster_doctor import ClusterCaller
+
+    if caller is not None:
+        return caller.meta_state()
+    caller = ClusterCaller([cluster.meta_addr])
+    try:
+        return caller.meta_state()
+    finally:
+        caller.close()
+
+
+def _alive_nodes(cluster, caller=None) -> list:
+    state = _cluster_state(cluster, caller) or {}
+    return sorted(a for a, n in state.get("nodes", {}).items()
+                  if n.get("alive"))
+
+
+def _fully_replicated(cluster, caller=None) -> bool:
+    """Every partition of every app has a live primary and a full live
+    member set — the doctor-healthy bar for membership."""
+    state = _cluster_state(cluster, caller)
+    if state is None:
+        return False
+    alive = {a for a, n in state.get("nodes", {}).items() if n.get("alive")}
+    if not alive:
+        return False
+    for app in state.get("apps", {}).values():
+        want = app.get("replica_count", 0)
+        for pc in app.get("partitions", []):
+            members = [m for m in [pc.get("primary")]
+                       + pc.get("secondaries", []) if m]
+            live = [m for m in members if m in alive]
+            if not pc.get("primary") or pc["primary"] not in alive:
+                return False
+            if want and len(live) < want:
+                return False
+    return True
+
+
+class NodeKillRestart(FaultActor):
+    """Hard-stop one replica NODE (the meta declares it dead and fails
+    over), then restart it on the SAME address and drive the meta's
+    repair path until every partition is fully replicated again. Works
+    for both plain ReplicaStub nodes and grouped nodes (whose group
+    workers are real OS processes)."""
+
+    def __init__(self, cluster, node_index: int = -1, caller=None):
+        self.cluster = cluster
+        self.node_index = node_index
+        self.caller = caller
+        self._spec = None
+        self._node = None
+        self._last_repair = 0.0
+
+    def arm(self, node_index: int = None):
+        idx = self.node_index if node_index is None else node_index
+        victim = self.cluster.stubs[idx]
+        addr = victim.address
+        _, _, port = addr.rpartition(":")
+        spec = {"addr": addr, "port": int(port), "root": victim.root,
+                "metas": list(victim.meta_addrs)}
+        if hasattr(victim, "kill_group"):     # GroupedReplicaNode
+            spec.update(kind="grouped", groups=victim.groups,
+                        base=dict(victim._spec_base))
+        else:
+            spec.update(kind="stub",
+                        options_factory=victim.options_factory,
+                        remote_clusters=dict(victim.remote_clusters),
+                        cluster_id=victim.cluster_id)
+        self._spec = spec
+        self.cluster.stubs.remove(victim)
+        victim.stop()
+        self.cluster.meta.mark_node_dead(addr)
+
+    def heal(self):
+        s = self._spec
+        # prefer the SAME address (a restarted machine keeps its name);
+        # lingering sockets from the killed node's accepted connections
+        # can hold the port for a while, so retry, then fall back to a
+        # fresh address — a replacement node — and drop the old one's
+        # tombstone from the meta so it does not read as dead forever
+        node = None
+        deadline = time.monotonic() + 8.0
+        while node is None:
+            try:
+                node = self._build(s, s["port"])
+            except OSError:
+                if time.monotonic() >= deadline:
+                    node = self._build(s, 0)
+                    self.cluster.meta.forget_node(s["addr"])
+                else:
+                    time.sleep(0.5)
+        self._node = node
+        self.cluster.stubs.append(node)
+
+    def _build(self, s, port: int):
+        if s["kind"] == "grouped":
+            from ..replication.serve_groups import GroupedReplicaNode
+
+            base = s["base"]
+            return GroupedReplicaNode(
+                s["root"], s["metas"], port=port, groups=s["groups"],
+                backend=base["backend"], compression=base["compression"],
+                sharded_compaction=base["sharded_compaction"],
+                remote_clusters=base["remote_clusters"],
+                cluster_id=base["cluster_id"]).start(0.2)
+        from ..replication.replica_stub import ReplicaStub
+
+        return ReplicaStub(
+            s["root"], s["metas"], port=port,
+            options_factory=s["options_factory"],
+            remote_clusters=s["remote_clusters"],
+            cluster_id=s["cluster_id"]).start(0.2)
+
+    def _restarted_addr(self) -> str:
+        return self._node.address
+
+    def recovered(self) -> bool:
+        if self._restarted_addr() not in _alive_nodes(self.cluster,
+                                                      self.caller):
+            return False
+        # the rejoined node is alive but partitions lost a member while
+        # it was down and nothing re-examines them on a join — re-drive
+        # the meta's repair pass (a failed learner seed needs a retry),
+        # but not on every 0.2 s poll: each pass scans every partition
+        # under the meta lock and persists a ballot bump while a seed
+        # keeps failing
+        now = time.monotonic()
+        if now - self._last_repair >= 1.0:
+            self._last_repair = now
+            self.cluster.meta.repair_under_replication()
+        return _fully_replicated(self.cluster, self.caller)
+
+
+class GroupWorkerKill(FaultActor):
+    """Hard-kill one partition-group executor PROCESS of a grouped node,
+    then restart it (PR 6's restart_group replay: the parent replays its
+    cached open-replica state so the group re-serves without waiting for
+    the meta's next proposal round)."""
+
+    def __init__(self, cluster, node_index: int = 0, group: int = None):
+        self.cluster = cluster
+        self.node_index = node_index
+        self.group = group
+        self._target = None
+
+    def arm(self, node_index: int = None, group: int = None):
+        idx = self.node_index if node_index is None else node_index
+        stub = self.cluster.stubs[idx]
+        if not hasattr(stub, "kill_group"):
+            raise RuntimeError(f"node {stub.address} is not group-served "
+                               "(need serve_groups >= 2)")
+        g = self.group if group is None else group
+        if g is None:
+            g = stub.groups - 1
+        self._target = (stub, g)
+        stub.kill_group(g)
+
+    def heal(self):
+        stub, g = self._target
+        stub.restart_group(g)
+
+    def recovered(self) -> bool:
+        stub, g = self._target
+        return stub.group_alive(g)
+
+
+class FailPointActor(FaultActor):
+    """Live fail-point arming in REMOTE server processes over the
+    ``set-fail-point`` remote command: a grouped node's router fans the
+    command to every worker process, so the point arms where the
+    serving actually happens. heal() re-arms with ``off()``."""
+
+    def __init__(self, caller, nodes_fn=None):
+        """caller: cluster_doctor.ClusterCaller (remote_command surface);
+        nodes_fn: () -> target node addresses (default: every alive
+        node at arm time)."""
+        self.caller = caller
+        self.nodes_fn = nodes_fn
+        self._armed = None   # (point, [nodes]) while armed
+
+    def arm(self, point: str = "", action: str = "", nodes=None):
+        if not point or not action:
+            raise ValueError("FailPointActor needs point= and action=")
+        targets = list(nodes) if nodes else (self.nodes_fn or list)()
+        if not targets:
+            raise RuntimeError("no target nodes to arm")
+        armed = []
+        errors = []
+        for n in targets:
+            try:
+                out = self.caller.remote_command(n, "set-fail-point",
+                                                 [point, action])
+                if out.startswith("bad fail point"):
+                    raise ValueError(out)
+                armed.append(n)
+            except (RpcError, OSError, ValueError) as e:
+                errors.append(f"{n}: {e}")
+        self._armed = (point, armed)
+        if not armed:
+            raise RuntimeError(f"set-fail-point armed nowhere: {errors}")
+
+    def heal(self):
+        if self._armed is None:
+            return
+        point, nodes = self._armed
+        self._armed = None
+        stubborn = []
+        for n in nodes:
+            try:
+                self.caller.remote_command(n, "set-fail-point",
+                                           [point, "off()"])
+            except (RpcError, OSError) as e:
+                stubborn.append(f"{n}: {e}")
+        if stubborn:
+            # an unhealed fail point means undeclared faults after the
+            # window closes — that must surface as a heal failure
+            raise RuntimeError(f"set-fail-point off() failed: {stubborn}")
+
+
+class SplitActor(FaultActor):
+    """Mid-load online partition split: doubles the app's partition
+    count through ``RPC_CM_SPLIT_APP`` while the load keeps running;
+    clients re-resolve on the partition-hash rejection path."""
+
+    def __init__(self, cluster, app: str, caller=None):
+        self.cluster = cluster
+        self.app = app
+        self.caller = caller
+        self._want = None
+
+    def arm(self):
+        # the split RPC is synchronous through phase-2 child seeding
+        # (full-copy learns, one history source) — under load on a
+        # saturated box that legitimately runs past the default 30 s
+        # DDL timeout, and a client-side timeout here would abandon a
+        # split that IS completing (the harness then mis-reads the
+        # doubled partition count as an arm failure). A seeding failure
+        # mid-load is retryable by contract: the meta resumes the
+        # incomplete split (replica.split_pending marker) instead of
+        # doubling again.
+        last = None
+        for _ in range(4):
+            r = self.cluster.ddl(RPC_CM_SPLIT_APP,
+                                 mm.SplitAppRequest(self.app),
+                                 mm.SplitAppResponse, timeout=180.0)
+            if not r.error:
+                self._want = r.new_partition_count
+                return
+            last = r.error_text
+            if "re-run split" not in (last or ""):
+                break
+            time.sleep(2.0)
+        raise RuntimeError(f"split failed: {last}")
+
+    def recovered(self) -> bool:
+        state = _cluster_state(self.cluster, self.caller)
+        if state is None:
+            return False
+        app = state.get("apps", {}).get(self.app)
+        if not app or app.get("partition_count") != self._want:
+            return False
+        return _fully_replicated(self.cluster, self.caller)
+
+
+class BalanceActor(FaultActor):
+    """Balancer leg: move one partition's primary to a secondary (the
+    greedy balancer's move_primary proposal) mid-load."""
+
+    def __init__(self, cluster, app: str, pidx: int = 0, caller=None):
+        self.cluster = cluster
+        self.app = app
+        self.pidx = pidx
+        self.caller = caller
+        self._want = None
+
+    def arm(self, pidx: int = None):
+        p = self.pidx if pidx is None else pidx
+        cfg = self.cluster.ddl(RPC_CM_QUERY_CONFIG,
+                               mm.QueryConfigRequest(self.app),
+                               mm.QueryConfigResponse)
+        pc = cfg.partitions[p]
+        if not pc.secondaries:
+            raise RuntimeError(f"partition {p} has no secondary to move to")
+        target = sorted(pc.secondaries)[0]
+        r = self.cluster.ddl(RPC_CM_PROPOSE,
+                             mm.ProposeRequest(self.app, p, target),
+                             mm.ProposeResponse)
+        if r.error:
+            raise RuntimeError(f"propose failed: {r.error_text}")
+        self._want = (p, target)
+
+    def recovered(self) -> bool:
+        p, target = self._want
+        cfg = self.cluster.ddl(RPC_CM_QUERY_CONFIG,
+                               mm.QueryConfigRequest(self.app),
+                               mm.QueryConfigResponse)
+        return cfg.partitions[p].primary == target \
+            and _fully_replicated(self.cluster, self.caller)
+
+
+class SchedFlipActor(FaultActor):
+    """Compaction-scheduler token flips: deliver DEFER tokens for every
+    partition of the app at arm (the engines hold elective L0 merges),
+    then flip to short-lived URGENT tokens at heal (early-fire + queue
+    jump), which lease-expire back to normal — the ``compact-sched-
+    policy`` delivery surface the cluster scheduler itself uses."""
+
+    def __init__(self, caller, cluster, app: str):
+        self.caller = caller
+        self.cluster = cluster
+        self.app = app
+        self._flip_at = 0.0
+
+    def _deliver(self, policy: str, ttl_s: float):
+        state = _cluster_state(self.cluster, self.caller)
+        if state is None:
+            raise RuntimeError("no cluster state for sched delivery")
+        app = state.get("apps", {}).get(self.app)
+        if app is None:
+            raise RuntimeError(f"no app {self.app!r}")
+        decisions = {f"{app['app_id']}.{pc['pidx']}":
+                     {"policy": policy, "reasons": ["chaos.flip"]}
+                     for pc in app.get("partitions", [])}
+        body = json.dumps({"ttl_s": ttl_s, "decisions": decisions})
+        delivered = 0
+        for node in sorted(a for a, n in state.get("nodes", {}).items()
+                           if n.get("alive")):
+            try:
+                self.caller.remote_command(node, "compact-sched-policy",
+                                           [body])
+                delivered += 1
+            except (RpcError, OSError):
+                continue
+        if not delivered:
+            raise RuntimeError("sched policy delivered to no node")
+
+    def arm(self, ttl_s: float = 30.0):
+        self._deliver("defer", ttl_s)
+
+    def heal(self):
+        # the flip: urgent with a short lease, then expiry back to normal
+        self._deliver("urgent", 3.0)
+        self._flip_at = time.monotonic()
+
+    def recovered(self) -> bool:
+        # recovered = the urgent lease expired (tokens revert to normal)
+        return time.monotonic() - self._flip_at >= 3.0

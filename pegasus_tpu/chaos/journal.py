@@ -1,0 +1,135 @@
+"""Event journal: the chaos run's timeline artifact (ISSUE 11).
+
+Everything the scenario engine, the audit cadence and the load harness
+conclude lands here as one timestamped event stream — fault armed/healed,
+recovery verified/breached, audit-round trajectory, error-window bounds,
+doctor verdicts, and the named failures that decide the exit code. The
+run emits it as a JSON artifact so a red run always says WHICH leg
+failed and WHEN, not just "exit 1".
+"""
+
+import json
+import time
+
+from ..runtime import lockrank
+from ..runtime.perf_counters import counters
+
+
+class EventJournal:
+    """Thread-safe append-only event timeline. Timestamps are seconds
+    relative to the journal's creation (the run's t=0), so a journal
+    reads as a timeline, not a wall-clock log."""
+
+    def __init__(self):
+        self.t0 = time.monotonic()
+        self._wall0 = time.time()
+        self._lock = lockrank.named_lock("chaos.journal")
+        self._events = []    #: guarded_by self._lock
+        self._failures = []  #: guarded_by self._lock
+
+    def now(self) -> float:
+        return time.monotonic() - self.t0
+
+    def record(self, kind: str, **fields) -> dict:
+        ev = {"t": round(self.now(), 3), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+        return ev
+
+    def fail(self, name: str, **fields) -> dict:
+        """A named failure: recorded as an event AND remembered in the
+        failure list the run's exit code folds. `name` is the machine-
+        readable failure key (e.g. ``recovery.deadline:kill-node``)."""
+        counters.rate("chaos.failure_count").increment()
+        ev = self.record("failure", failure=name, **fields)
+        with self._lock:
+            self._failures.append(ev)
+        return ev
+
+    @property
+    def failures(self) -> list:
+        with self._lock:
+            return list(self._failures)
+
+    def events(self, kind: str = None) -> list:
+        with self._lock:
+            evs = list(self._events)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        return evs
+
+    def dump(self) -> dict:
+        """The artifact: start wall-clock, the full timeline, and the
+        failure digest."""
+        with self._lock:
+            return {"started_at": self._wall0,
+                    "events": list(self._events),
+                    "failures": list(self._failures)}
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.dump(), f, indent=1)
+
+
+class FaultWindows:
+    """Declared fault windows: the intervals during which transient
+    errors are ALLOWED (ISSUE 11 satellite — a failover blip inside an
+    armed fault's window must not fail the run; the same error in steady
+    state must). A window opens when a fault arms and closes
+    ``settle_s`` after it heals (recovery is not instantaneous: a killed
+    group re-serves only after restart+replay). Instantaneous faults
+    (split, balancer move) still open a bounded window — the client-
+    visible reconfiguration blip is part of the declared fault."""
+
+    def __init__(self, journal: EventJournal = None):
+        self.journal = journal
+        self._lock = lockrank.named_lock("chaos.windows")
+        # entries are [start, end|None, name]
+        self._windows = []  #: guarded_by self._lock
+
+    def open(self, name: str, settle_s: float = 0.0) -> int:
+        """-> window id. settle_s here pads the START backward (unused
+        today; symmetry with close)."""
+        t = self._now()
+        with self._lock:
+            self._windows.append([t - settle_s, None, name])
+            wid = len(self._windows) - 1
+        counters.number("chaos.active_fault_windows").set(self._open_count())
+        return wid
+
+    def close(self, wid: int, settle_s: float = 0.0) -> None:
+        t = self._now()
+        with self._lock:
+            if 0 <= wid < len(self._windows):
+                self._windows[wid][1] = t + settle_s
+        counters.number("chaos.active_fault_windows").set(self._open_count())
+
+    def _now(self) -> float:
+        return self.journal.now() if self.journal is not None \
+            else time.monotonic()
+
+    def _open_count(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._windows if w[1] is None)
+
+    def in_window(self, t: float = None) -> bool:
+        """Was instant `t` (journal-relative; default now) inside any
+        declared fault window?"""
+        t = self._now() if t is None else t
+        with self._lock:
+            return any(s <= t and (e is None or t <= e)
+                       for s, e, _ in self._windows)
+
+    def bounds(self) -> list:
+        """[{name, start, end}] — the journal artifact's window table."""
+        with self._lock:
+            return [{"name": n, "start": round(s, 3),
+                     "end": None if e is None else round(e, 3)}
+                    for s, e, n in self._windows]
+
+
+# module-import registration keeps the metric-name lint's reverse pass
+# honest for the dynamic set() sites above
+counters.rate("chaos.failure_count")
+counters.number("chaos.active_fault_windows")

@@ -1,0 +1,272 @@
+"""Declarative fault schedule + the engine that drives it (ISSUE 11).
+
+A `Scenario` is a list of `FaultAction`s: each names an actor (a key
+into the runner's actor dict — see chaos.actors), an arm time, a
+duration (heal fires at ``at_s + duration_s``), an optional period for
+recurring faults, and a recovery deadline. `ScenarioRunner` walks the
+expanded timeline on a background thread: for every occurrence it opens
+a declared fault window (chaos.journal.FaultWindows — the interval in
+which the load harness classifies transient errors as ALLOWED), arms the
+actor, heals it on schedule, then polls ``actor.recovered()`` until the
+recovery deadline — a breach is a named journal failure that fails the
+run. Windows close only after recovery (+ the action's settle grace), so
+the blip between heal and fully-reserving is still inside the declared
+window.
+"""
+
+import time
+from dataclasses import dataclass, field
+
+from ..runtime.perf_counters import counters
+from ..runtime.tasking import spawn_thread
+from .journal import EventJournal, FaultWindows
+
+
+@dataclass
+class FaultAction:
+    """One scripted fault: arm at `at_s`, heal `duration_s` later; with
+    `every_s` the pair repeats until the run ends. After heal, the actor
+    must report recovered() within `recovery_deadline_s` or the run
+    fails with a named breach. `settle_s` extends the declared fault
+    window past recovery (failover blips trail the heal)."""
+
+    name: str
+    actor: str
+    at_s: float
+    duration_s: float = 0.0
+    every_s: float = None
+    recovery_deadline_s: float = 30.0
+    settle_s: float = 2.0
+    args: dict = field(default_factory=dict)
+
+
+class ScenarioError(ValueError):
+    """A scenario that cannot be scheduled (validation failure)."""
+
+
+@dataclass
+class Scenario:
+    name: str
+    actions: list = field(default_factory=list)
+
+    def validate(self, actor_keys=None) -> "Scenario":
+        """Schedule sanity: unique action names, non-negative times,
+        arm/heal pairing (a periodic action's next arm must come after
+        the previous heal), positive recovery deadlines, and (when the
+        runner's actor set is known) every referenced actor exists."""
+        names = set()
+        for a in self.actions:
+            if a.name in names:
+                raise ScenarioError(f"duplicate action name {a.name!r}")
+            names.add(a.name)
+            if a.at_s < 0 or a.duration_s < 0:
+                raise ScenarioError(
+                    f"action {a.name!r}: negative at_s/duration_s")
+            if a.recovery_deadline_s <= 0:
+                raise ScenarioError(
+                    f"action {a.name!r}: recovery_deadline_s must be > 0")
+            if a.every_s is not None and a.every_s <= a.duration_s:
+                raise ScenarioError(
+                    f"action {a.name!r}: every_s ({a.every_s}) must exceed "
+                    f"duration_s ({a.duration_s}) — the next arm would "
+                    "overlap the previous unhealed occurrence")
+            if actor_keys is not None and a.actor not in actor_keys:
+                raise ScenarioError(
+                    f"action {a.name!r} references unknown actor "
+                    f"{a.actor!r} (have: {sorted(actor_keys)})")
+        return self
+
+    def timeline(self, run_s: float) -> list:
+        """Expand to ``[(t, "arm"|"heal", action, occurrence)]`` sorted
+        by time — periodic actions repeat every `every_s` while the arm
+        still falls inside the run; each occurrence's heal is always
+        emitted (a fault armed near the end still heals)."""
+        events = []
+        for a in self.actions:
+            k = 0
+            while True:
+                t_arm = a.at_s + (k * a.every_s if a.every_s else 0)
+                if t_arm >= run_s and k > 0:
+                    break
+                events.append((t_arm, "arm", a, k))
+                events.append((t_arm + a.duration_s, "heal", a, k))
+                k += 1
+                if not a.every_s:
+                    break
+        # STABLE sort by time only: each occurrence emits arm-then-heal,
+        # so a zero-duration action's heal stays AFTER its arm (a
+        # heal-first tiebreak here once inverted every instantaneous
+        # action's pair and derailed the whole schedule)
+        events.sort(key=lambda e: e[0])
+        return events
+
+
+class ScenarioRunner:
+    """Drives one Scenario against a dict of fault actors on a
+    background thread. The journal + fault windows are shared with the
+    load harness: workers classify their errors against the windows this
+    runner opens/closes."""
+
+    def __init__(self, scenario: Scenario, actors: dict,
+                 journal: EventJournal, windows: FaultWindows = None):
+        self.scenario = scenario.validate(set(actors))
+        self.actors = dict(actors)
+        self.journal = journal
+        self.windows = windows or FaultWindows(journal)
+        self._abort = False   #: unguarded_ok checked/written as a plain
+        # bool flag (atomic store; the runner only ever flips it on)
+        self._thread = None
+
+    def start(self, run_s: float) -> "ScenarioRunner":
+        self._thread = spawn_thread(self._run, run_s, daemon=True,
+                                    name=f"chaos:{self.scenario.name}")
+        return self
+
+    def join(self, timeout: float = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def stop(self) -> None:
+        """Abort between events: remaining ARMs are skipped (a teardown
+        must never inject new faults into a cluster being stopped),
+        already-armed faults still HEAL, and recovery waits are skipped
+        so the abort is prompt. A running arm/heal completes first."""
+        self._abort = True
+
+    @property
+    def failures(self) -> list:
+        return self.journal.failures
+
+    # ------------------------------------------------------------- engine
+
+    def _run(self, run_s: float):
+        # schedule times are relative to the RUNNER's start (= load
+        # start), not the journal's creation — harness build time must
+        # not eat the front of the schedule
+        epoch = time.monotonic()
+        open_windows = {}   # (action.name, k) -> window id; runner-thread-
+        # local: only this loop touches it
+        arm_failed = set()  # occurrences whose arm() raised — their heal/
+        # recovery must not run (healing an unarmed actor cascades one
+        # failure into spurious actor.heal + recovery.deadline ones)
+        for t, what, action, k in self.scenario.timeline(run_s):
+            self._sleep_until(epoch + t)
+            occ = f"{action.name}#{k}" if action.every_s else action.name
+            actor = self.actors[action.actor]
+            if what == "arm":
+                if self._abort:
+                    continue   # aborted: never arm a NEW fault
+                counters.rate("chaos.faults_armed").increment()
+                open_windows[(action.name, k)] = self.windows.open(occ)
+                self.journal.record("fault.armed", action=occ,
+                                    actor=action.actor, scheduled_t=t)
+                try:
+                    actor.arm(**action.args)
+                except Exception as e:  # noqa: BLE001 - an actor that
+                    # cannot arm is a harness failure, named and fatal
+                    self.journal.fail(f"actor.arm:{occ}", error=repr(e))
+                    arm_failed.add((action.name, k))
+                continue
+            if self._abort and (action.name, k) not in open_windows:
+                continue   # aborted before this occurrence armed
+            if (action.name, k) in arm_failed:
+                # nothing armed: close the declared window and move on
+                # promptly instead of stalling the schedule on a recovery
+                # wait for a fault that never happened
+                arm_failed.discard((action.name, k))
+                wid = open_windows.pop((action.name, k), None)
+                if wid is not None:
+                    self.windows.close(wid, settle_s=action.settle_s)
+                continue
+            try:
+                actor.heal()
+            except Exception as e:  # noqa: BLE001 - same: named + fatal
+                self.journal.fail(f"actor.heal:{occ}", error=repr(e))
+            counters.rate("chaos.faults_healed").increment()
+            self.journal.record("fault.healed", action=occ, scheduled_t=t)
+            if not self._abort:   # an abort must not block on recovery
+                self._await_recovery(action, occ, actor)
+            wid = open_windows.pop((action.name, k), None)
+            if wid is not None:
+                self.windows.close(wid, settle_s=action.settle_s)
+        self.journal.record("scenario.done", name=self.scenario.name)
+
+    def _await_recovery(self, action: FaultAction, occ: str, actor) -> None:
+        deadline = time.monotonic() + action.recovery_deadline_s
+        while True:
+            try:
+                ok = actor.recovered()
+            except Exception:  # noqa: BLE001 - a probe error = not yet
+                ok = False
+            if ok:
+                self.journal.record("fault.recovered", action=occ)
+                return
+            if time.monotonic() >= deadline:
+                counters.rate("chaos.recovery_breach_count").increment()
+                self.journal.fail(
+                    f"recovery.deadline:{occ}",
+                    deadline_s=action.recovery_deadline_s,
+                    detail=f"actor {action.actor!r} did not report "
+                           f"recovered within "
+                           f"{action.recovery_deadline_s:.1f}s of heal")
+                return
+            time.sleep(0.2)
+
+    def _sleep_until(self, deadline: float) -> None:
+        while not self._abort:
+            dt = deadline - time.monotonic()
+            if dt <= 0:
+                return
+            time.sleep(min(dt, 0.1))
+
+
+# ------------------------------------------------------ builtin scenarios
+# Actor keys the builders reference; tools/pressure_test.py constructs the
+# matching actors for its onebox harness.
+A_FAILPOINT = "failpoint"
+A_GROUP_KILL = "group_kill"
+A_NODE_KILL = "node_kill"
+A_SPLIT = "split"
+A_BALANCE = "balance"
+A_SCHED = "sched_flip"
+
+
+def smoke_scenario() -> Scenario:
+    """Tier-1 sized (~12 s of load): one group-worker kill + one remote
+    fail-point wedge under load — the bounded chaos smoke."""
+    return Scenario("smoke", [
+        FaultAction("dispatch-wedge", A_FAILPOINT, at_s=2.0, duration_s=5.0,
+                    recovery_deadline_s=10.0, settle_s=1.0,
+                    args={"point": "serve.dispatch",
+                          "action": "20%sleep(40)"}),
+        FaultAction("kill-group", A_GROUP_KILL, at_s=3.0, duration_s=3.0,
+                    recovery_deadline_s=25.0, settle_s=2.0),
+    ])
+
+
+def full_scenario() -> Scenario:
+    """The production-sim flagship schedule (~30 s of load): scheduler
+    token flips, a remote fail-point wedge, a mid-load partition split,
+    a group-worker kill, a balancer primary move, and a whole-node
+    kill+restart — everything at once, under periodic audit, with a
+    duplication leg (set up by the harness) compared cross-cluster at
+    the end."""
+    return Scenario("full", [
+        FaultAction("sched-defer-urgent", A_SCHED, at_s=2.0, duration_s=4.0,
+                    recovery_deadline_s=10.0, settle_s=0.5),
+        FaultAction("dispatch-wedge", A_FAILPOINT, at_s=3.0, duration_s=4.0,
+                    recovery_deadline_s=10.0, settle_s=1.0,
+                    args={"point": "serve.dispatch",
+                          "action": "20%sleep(40)"}),
+        FaultAction("split-double", A_SPLIT, at_s=6.0, duration_s=0.0,
+                    recovery_deadline_s=30.0, settle_s=3.0),
+        FaultAction("kill-group", A_GROUP_KILL, at_s=11.0, duration_s=3.0,
+                    recovery_deadline_s=30.0, settle_s=2.0),
+        FaultAction("primary-move", A_BALANCE, at_s=16.0, duration_s=0.0,
+                    recovery_deadline_s=15.0, settle_s=2.0),
+        FaultAction("kill-node", A_NODE_KILL, at_s=19.0, duration_s=3.0,
+                    recovery_deadline_s=40.0, settle_s=3.0),
+    ])
+
+
+SCENARIOS = {"smoke": smoke_scenario, "full": full_scenario}

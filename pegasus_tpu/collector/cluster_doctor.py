@@ -29,8 +29,10 @@ Both are pure functions over RPC surfaces: the collector app, the shell,
 
 import json
 import os
+import threading
 import time
 
+from ..base.utils import epoch_now
 from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_QUERY_CLUSTER_STATE
 from ..rpc import codec
@@ -93,22 +95,28 @@ class ClusterCaller:
 
 def run_cluster_audit(meta_addrs, pool: ConnectionPool = None,
                       apps: list = None, wait_s: float = 5.0,
-                      caller: ClusterCaller = None) -> dict:
+                      caller: ClusterCaller = None, now: int = None) -> dict:
     """Trigger + verify a decree-anchored consistency audit across every
     partition of every (or the named) app. -> report dict:
 
     ``{"partitions": N, "ok": [gpid...], "mismatches": [{app, app_id,
     pidx, gpid, node, decree, digest, expected}...], "inconclusive":
     [{gpid, node?, reason}...], "digests": {gpid: {node: {decree,
-    digest}}}}``
+    digest}}}, "primaries": {gpid: {node, decree, digest, records}}}``
 
     Zero mismatches with every partition in ``ok`` means every replica
     held byte-equivalent logical state at the same applied decree —
-    the pass criterion the production-sim scenario builds on."""
+    the pass criterion the production-sim scenario builds on.
+    ``primaries`` carries each partition's anchor (the primary's digest
+    + record count at the anchoring decree): the cross-cluster compare
+    folds these into one table-level digest. `now` (epoch seconds)
+    overrides each primary's own expiry clock — the cross-cluster
+    compare passes ONE instant to both clusters so a TTL record
+    expiring between the two audits cannot fake a mismatch."""
     own = caller is None
     caller = caller or ClusterCaller(meta_addrs, pool=pool)
     report = {"partitions": 0, "ok": [], "mismatches": [],
-              "inconclusive": [], "digests": {}}
+              "inconclusive": [], "digests": {}, "primaries": {}}
     try:
         state = caller.meta_state()
         if state is None:
@@ -121,21 +129,22 @@ def run_cluster_audit(meta_addrs, pool: ConnectionPool = None,
             for pc in app.get("partitions", []):
                 report["partitions"] += 1
                 _audit_partition(caller, report, app_name, app["app_id"],
-                                 pc, wait_s)
+                                 pc, wait_s, now)
     finally:
         if own:
             caller.close()
     return report
 
 
-def _audit_partition(caller, report, app_name, app_id, pc, wait_s):
+def _audit_partition(caller, report, app_name, app_id, pc, wait_s, now=None):
     gpid = f"{app_id}.{pc['pidx']}"
     if not pc.get("primary"):
         report["inconclusive"].append(
             {"gpid": gpid, "reason": "no primary assigned"})
         return
+    args = [gpid] if now is None else [gpid, f"now={int(now)}"]
     try:
-        out = caller.remote_command(pc["primary"], "trigger-audit", [gpid])
+        out = caller.remote_command(pc["primary"], "trigger-audit", args)
     except (RpcError, OSError) as e:
         report["inconclusive"].append(
             {"gpid": gpid, "node": pc["primary"],
@@ -154,6 +163,9 @@ def _audit_partition(caller, report, app_name, app_id, pc, wait_s):
     expected = primary_audit["digest"]
     digests = {pc["primary"]: {"decree": decree, "digest": expected}}
     report["digests"][gpid] = digests
+    report["primaries"][gpid] = {
+        "node": pc["primary"], "decree": decree, "digest": expected,
+        "records": primary_audit.get("records", 0)}
     clean = True
     for node in pc.get("secondaries", []):
         got = _poll_secondary_audit(caller, node, gpid, decree, wait_s)
@@ -199,6 +211,250 @@ def _poll_secondary_audit(caller, node, gpid, decree, wait_s):
         if time.monotonic() >= deadline:
             return None
         time.sleep(0.05)
+
+
+# ============================================== cross-cluster audit (dup)
+
+
+def fold_table_digest(entries) -> dict:
+    """Commutative table-level fold of per-partition engine digests.
+    Each per-partition digest is ``{xor:016x}{add:016x}`` over one crc64
+    per live record (engine.state_digest) — both combines are
+    commutative AND associative, so folding partitions (xor of xors,
+    sum of adds, sum of counts) yields the digest of the whole table's
+    record SET, independent of how records are partitioned. That is what
+    makes the cross-cluster compare survive a mid-run partition split:
+    the source may hold 2N partitions while the remote still holds N,
+    but the folded table digests compare 1:1."""
+    xor = add = n = 0
+    for digest, records in entries:
+        xor ^= int(digest[:16], 16)
+        add = (add + int(digest[16:32], 16)) & 0xFFFFFFFFFFFFFFFF
+        n += int(records)
+    return {"digest": f"{xor:016x}{add:016x}", "records": n}
+
+
+def run_cross_cluster_audit(src_meta_addrs, dst_meta_addrs, app: str,
+                            dupid: int = None, wait_s: float = 20.0,
+                            confirm_wait_s: float = 30.0,
+                            pool: ConnectionPool = None) -> dict:
+    """Cross-CLUSTER consistency compare for a duplication leg (ISSUE
+    11), anchored at the duplicator's confirmed decree. Requires the
+    caller to have QUIESCED writes to `app` (the chaos harness runs it
+    after the load stops): shipping is asynchronous, so the compare
+    waits for the duplicators to confirm through the anchor rather than
+    assuming they are caught up.
+
+    Protocol:
+
+    1. decree-anchored audit on the SOURCE cluster: every partition's
+       primary digests its owned live state at an anchor decree;
+    2. wait until the meta's beacon-folded dup ``confirmed`` decree
+       reaches each partition's anchor — every mutation below the
+       anchor has then been shipped AND acked by the remote cluster
+       (the remote acks only after its own PacificA commit+apply);
+    3. decree-anchored audit on the DESTINATION cluster;
+    4. fold both sides' per-partition digests into one table-level
+       digest each (fold_table_digest) and compare.
+
+    -> ``{"app", "match": True|False|None, "src", "dst",
+    "anchors": {gpid: decree}, "confirmed": {pidx: decree},
+    "inconclusive": [reason...], "mismatches": [...]}`` — ``match`` is
+    None when any step was inconclusive (never a false mismatch)."""
+    report = {"app": app, "match": None, "src": None, "dst": None,
+              "anchors": {}, "confirmed": {}, "inconclusive": [],
+              "mismatches": []}
+    caller = ClusterCaller(src_meta_addrs, pool=pool)
+    try:
+        state = caller.meta_state()
+        if state is None or app not in state.get("apps", {}):
+            report["inconclusive"].append(
+                f"source cluster state unavailable or no app {app!r}")
+            return report
+        app_id = state["apps"][app]["app_id"]
+        entry = _pick_dup_entry(state, app_id, dupid)
+        if entry is None:
+            report["inconclusive"].append(
+                f"no active duplication on {app!r} "
+                f"(dupid={dupid if dupid is not None else 'any'})")
+            return report
+        report["dupid"] = entry["dupid"]
+        # ONE expiry anchor for both sides: the audits run seconds apart,
+        # and a TTL record expiring in between would otherwise diverge
+        # the two digests on byte-identical data (false mismatch)
+        audit_now = epoch_now()
+        src_audit = run_cluster_audit(src_meta_addrs, apps=[app],
+                                      wait_s=wait_s, pool=pool,
+                                      now=audit_now)
+        if len(src_audit["ok"]) != src_audit["partitions"] \
+                or not src_audit["primaries"]:
+            report["inconclusive"].append(
+                "source audit incomplete: "
+                f"{len(src_audit['ok'])}/{src_audit['partitions']} "
+                "partitions conclusive")
+            report["src_audit"] = {k: src_audit[k]
+                                   for k in ("mismatches", "inconclusive")}
+            return report
+        report["anchors"] = {g: p["decree"]
+                             for g, p in src_audit["primaries"].items()}
+        lagging = _wait_confirmed(caller, app, app_id, entry["dupid"],
+                                  src_audit["primaries"], confirm_wait_s,
+                                  report)
+        if lagging:
+            report["inconclusive"].append(
+                "duplicator confirmed decree never reached the anchor "
+                f"within {confirm_wait_s:.0f}s for partition(s) {lagging}")
+            return report
+    finally:
+        caller.close()
+    dst_audit = run_cluster_audit(dst_meta_addrs, apps=[app], wait_s=wait_s,
+                                  now=audit_now)
+    if len(dst_audit["ok"]) != dst_audit["partitions"] \
+            or not dst_audit["primaries"]:
+        report["inconclusive"].append(
+            "destination audit incomplete: "
+            f"{len(dst_audit['ok'])}/{dst_audit['partitions']} "
+            "partitions conclusive")
+        return report
+    report["src"] = fold_table_digest(
+        (p["digest"], p["records"]) for p in src_audit["primaries"].values())
+    report["dst"] = fold_table_digest(
+        (p["digest"], p["records"]) for p in dst_audit["primaries"].values())
+    report["match"] = report["src"]["digest"] == report["dst"]["digest"] \
+        and report["src"]["records"] == report["dst"]["records"]
+    if not report["match"]:
+        report["mismatches"].append(
+            {"app": app, "src": report["src"], "dst": report["dst"],
+             "anchors": report["anchors"]})
+    return report
+
+
+def _pick_dup_entry(state, app_id: int, dupid):
+    for e in state.get("dups", {}).get(str(app_id), []):
+        if dupid is not None and e.get("dupid") != dupid:
+            continue
+        if dupid is not None or e.get("status") == "start":
+            return e
+    return None
+
+
+def _wait_confirmed(caller, app, app_id, dupid, primaries, confirm_wait_s,
+                    report):
+    """Poll the source meta until the dup entry's beacon-folded confirmed
+    decree reaches every partition's anchor. -> list of lagging pidx
+    (empty = fully confirmed)."""
+    anchors = {int(g.split(".")[1]): p["decree"] for g, p in primaries.items()}
+    deadline = time.monotonic() + confirm_wait_s
+    while True:
+        state = caller.meta_state()
+        conf = {}
+        if state is not None:
+            e = _pick_dup_entry(state, app_id, dupid)
+            conf = (e or {}).get("confirmed", {})
+        report["confirmed"] = conf
+        lagging = [p for p, d in sorted(anchors.items())
+                   if int(conf.get(str(p), 0)) < d]
+        if not lagging or time.monotonic() >= deadline:
+            return lagging
+        time.sleep(0.2)
+
+
+# ===================================================== periodic audit rounds
+
+
+class AuditRounds:
+    """Periodic decree-anchored audit cadence for pressure/chaos runs
+    (ISSUE 11 satellite): instead of ONE audit at t/2 — which a mismatch
+    introduced late in the run slips past — a background thread audits
+    every `every_s` seconds with per-round conclusive/vacuous
+    bookkeeping. A round is *conclusive* when every partition landed in
+    ``ok``; zero mismatches without full coverage is *vacuous* and says
+    nothing. Counters: ``audit.round.count`` / ``.conclusive`` /
+    ``.vacuous`` / ``.mismatch_count``.
+
+    `journal` is any object with ``record(kind, **fields)`` and
+    ``fail(name, **fields)`` (chaos.journal.EventJournal); None = no
+    journaling."""
+
+    def __init__(self, meta_addrs, apps=None, every_s: float = 5.0,
+                 wait_s: float = 5.0, journal=None,
+                 pool: ConnectionPool = None):
+        from ..runtime import lockrank
+        from ..runtime.tasking import spawn_thread
+
+        self.meta_addrs = list(meta_addrs)
+        self.apps = list(apps) if apps else None
+        self.every_s = every_s
+        self.wait_s = wait_s
+        self.journal = journal
+        self.pool = pool
+        self._lock = lockrank.named_lock("audit.rounds")
+        self.rounds = []   #: guarded_by self._lock
+        self._stop = threading.Event()
+        self._thread = spawn_thread(self._loop, daemon=True, start=False,
+                                    name="audit-rounds")
+
+    def start(self) -> "AuditRounds":
+        self._thread.start()
+        return self
+
+    def stop(self, final_round: bool = True) -> dict:
+        """Stop the cadence (joining the loop); final_round runs one more
+        audit AFTER the caller quiesced — the round that catches a
+        mismatch introduced in the last window. -> summary()."""
+        self._stop.set()
+        self._thread.join(timeout=max(30.0, self.wait_s * 4))
+        if final_round:
+            self._run_round(final=True)
+        return self.summary()
+
+    def _loop(self):
+        while not self._stop.wait(self.every_s):
+            try:
+                self._run_round()
+            except Exception as e:  # noqa: BLE001 - cadence must survive
+                # mid-chaos RPC storms; the round is recorded as vacuous
+                with self._lock:
+                    self.rounds.append({"error": repr(e), "conclusive": False,
+                                        "mismatches": []})
+                if self.journal is not None:
+                    self.journal.record("audit.round.error", error=repr(e))
+
+    def _run_round(self, final: bool = False):
+        report = run_cluster_audit(self.meta_addrs, apps=self.apps,
+                                   wait_s=self.wait_s, pool=self.pool)
+        rnd = {"ok": len(report["ok"]), "partitions": report["partitions"],
+               "mismatches": report["mismatches"],
+               "inconclusive": report["inconclusive"],
+               "conclusive": (report["partitions"] > 0
+                              and len(report["ok"]) == report["partitions"]),
+               "final": final}
+        counters.rate("audit.round.count").increment()
+        if rnd["conclusive"]:
+            counters.rate("audit.round.conclusive").increment()
+        else:
+            counters.rate("audit.round.vacuous").increment()
+        if rnd["mismatches"]:
+            counters.rate("audit.round.mismatch_count").increment(
+                len(rnd["mismatches"]))
+        with self._lock:
+            self.rounds.append(rnd)
+        if self.journal is not None:
+            self.journal.record("audit.round", ok=rnd["ok"],
+                                partitions=rnd["partitions"],
+                                conclusive=rnd["conclusive"], final=final,
+                                mismatches=len(rnd["mismatches"]))
+            for m in rnd["mismatches"]:
+                self.journal.fail("audit.mismatch", **m)
+
+    def summary(self) -> dict:
+        with self._lock:
+            rounds = list(self.rounds)
+        mismatches = [m for r in rounds for m in r["mismatches"]]
+        return {"rounds": len(rounds),
+                "conclusive": sum(1 for r in rounds if r["conclusive"]),
+                "vacuous": sum(1 for r in rounds if not r["conclusive"]),
+                "mismatches": mismatches}
 
 
 # ================================================================ doctor

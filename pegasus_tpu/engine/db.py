@@ -734,7 +734,7 @@ class LsmEngine:
 
     # ------------------------------------------------------------------ audit
 
-    def state_digest(self, now: int = None) -> dict:
+    def state_digest(self, now: int = None, pmask: int = None) -> dict:
         """Order-independent digest of the LIVE logical state — the
         consistency-audit primitive (ISSUE 8). Walks memtable + immutables
         + every SST through the one merged recency iterator (scan: same
@@ -748,10 +748,27 @@ class LsmEngine:
         compaction independently drops both, so their physical presence is
         legitimately divergent state. `now` must be the auditor-chosen
         clock (the trigger_audit mutation carries it) so every replica
-        filters expiry against the same instant."""
+        filters expiry against the same instant.
+
+        Records the partition no longer OWNS after a split (the
+        partition-version rule: ``key_hash % partition_count != pidx``,
+        the same ownership split stale-key GC enforces in compaction)
+        are excluded for the same reason: after a split, a replica that
+        compacted has physically dropped its stale half while a sibling
+        that has not compacted yet still holds it — comparing them would
+        fake a mismatch — and the cross-CLUSTER table fold (ISSUE 11)
+        would double-count every key still physically present in both
+        the parent and the child partition. `pmask` must be the
+        AUDITOR-chosen mask carried in the trigger-audit mutation (the
+        env-spread partition_version lands at different times per
+        replica; None falls back to the engine's own mask for direct
+        engine-level callers)."""
         now = epoch_now() if now is None else now
+        pmask = self.opts.partition_mask if pmask is None else pmask
         xor = add = n = 0
         for k, v, e in self.scan(now=now):
+            if pmask and key_hash(k) % (pmask + 1) != self.opts.pidx:
+                continue
             c = crc64(struct.pack("<I", len(k)) + k
                       + struct.pack("<q", int(e)) + v)
             xor ^= c

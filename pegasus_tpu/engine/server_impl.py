@@ -166,14 +166,22 @@ class PegasusServer:
 
     def __init__(self, path: str, app_id: int = 1, pidx: int = 0,
                  options: EngineOptions = None, server: str = "local",
-                 app_envs: dict = None):
+                 app_envs: dict = None, cluster_id: int = 0):
         self.app_id = app_id
         self.pidx = pidx
         self.server = server
         opts = options or EngineOptions()
         opts.pidx = pidx
         self.engine = LsmEngine(path, opts)
-        self.write_service = WriteService(self.engine, app_id, pidx, server)
+        # cluster_id flows into every local write's value timetag — the
+        # same provenance bits the duplicate apply path stores for its
+        # ORIGIN cluster, so a row written locally on cluster 1 and its
+        # duplicated copy on cluster 2 hold byte-identical values (the
+        # cross-cluster digest compare depends on it; with the old
+        # hardwired 0, every local row differed from its shipped twin by
+        # exactly the cluster bits)
+        self.write_service = WriteService(self.engine, app_id, pidx, server,
+                                          cluster_id=cluster_id)
         self._schema = SCHEMAS[self.engine.data_version()]
         self._contexts = ScanContextCache()
         self._app_envs = {}

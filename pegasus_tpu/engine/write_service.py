@@ -384,7 +384,11 @@ class WriteService:
         self.empty_put(decree)  # the decree itself advances like any write
         t0 = _time.perf_counter()
         try:
-            dig = self.engine.state_digest(now=req.now or None)
+            # the auditor-chosen ownership mask rides the mutation: every
+            # replica excludes split-stale rows against the SAME mask at
+            # the same decree (the env-spread mask is async per replica)
+            dig = self.engine.state_digest(now=req.now or None,
+                                           pmask=req.pmask or None)
         except Exception as e:  # noqa: BLE001 - an audit must never wedge
             # the apply path; a digest failure reports as inconclusive
             resp.error = Status.IO_ERROR

@@ -360,19 +360,36 @@ class MetaServer:
             if app is None:
                 return codec.encode(mm.SplitAppResponse(error=1,
                                                         error_text="no such app"))
-            n = app.partition_count
             parts = self._parts[app.app_id]
-            children = []
-            for pidx in range(n, 2 * n):
-                parent = parts[pidx - n]
-                pc = mm.PartitionConfig(pidx=pidx, ballot=1,
-                                        primary=parent.primary,
-                                        secondaries=list(parent.secondaries))
-                parts.append(pc)
-                children.append((parent, pc))
-            app.partition_count = 2 * n
-            parents = list(parts[:n])
+            envs = json.loads(app.envs_json)
+            pending = envs.get("replica.split_pending")
+            if pending is not None:
+                # RESUME an incomplete split (the retry the seeding-failure
+                # error text promises): the count is already doubled and
+                # the child configs installed — re-drive phase 2 for the
+                # existing children instead of doubling again
+                old_n, new_n = int(pending), app.partition_count
+                children = [(parts[p - old_n], parts[p])
+                            for p in range(old_n, new_n)]
+            else:
+                old_n = app.partition_count
+                new_n = 2 * old_n
+                children = []
+                for pidx in range(old_n, new_n):
+                    parent = parts[pidx - old_n]
+                    pc = mm.PartitionConfig(
+                        pidx=pidx, ballot=1, primary=parent.primary,
+                        secondaries=list(parent.secondaries))
+                    parts.append(pc)
+                    children.append((parent, pc))
+                app.partition_count = new_n
+                # the resume marker rides the app envs (persisted with
+                # the config) until phase 3 declares seeding complete
+                envs["replica.split_pending"] = str(old_n)
+                app.envs_json = json.dumps(envs)
+            parents = list(parts[:old_n])
             self._persist_locked()
+        n = old_n
         # Phase 1: parents learn the NEW partition count FIRST, so any write
         # still routed with the old count but belonging to a child half is
         # rejected from here on (client re-resolves). Writes accepted before
@@ -380,19 +397,38 @@ class MetaServer:
         # no write can fall between the two.
         for pc in parents:
             self._install_partition(app, pc)
-        # Phase 2: seed every child from its parent's primary (full-copy
-        # learn). Failures are fatal for the split: the stale-key GC mask
-        # must not spread unless every child holds its half.
+        # Phase 2: seed each child's PRIMARY from the parent's primary
+        # (full-copy learn), then each child SECONDARY from the child
+        # primary — ONE history source. Seeding every member from the
+        # parent directly looks equivalent but is not under live load:
+        # the parent advances between the independent learns, so two
+        # members could snapshot different parent decrees and the gap
+        # mutations exist in neither the later learner's checkpoint nor
+        # the child primary's plog — decrees align again through the
+        # prepare stream while the CONTENT stays divergent forever (the
+        # decree-anchored audit caught exactly this under chaos load).
+        # Failures are fatal for the split: the stale-key GC mask must
+        # not spread unless every child holds its half.
         seeded = True
         for parent, pc in children:
-            req_open = mm.OpenReplicaRequest(
+            req_primary = mm.OpenReplicaRequest(
                 app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
                 ballot=pc.ballot, primary=pc.primary,
                 secondaries=pc.secondaries, envs_json=app.envs_json,
                 partition_count=2 * n, learn_from=parent.primary,
                 learn_pidx=parent.pidx)
-            for node in [pc.primary] + pc.secondaries:
-                if self._send_to_node(node, RPC_OPEN_REPLICA, req_open,
+            if self._send_to_node(pc.primary, RPC_OPEN_REPLICA, req_primary,
+                                  ignore_errors=True) is None:
+                seeded = False
+                continue
+            req_secondary = mm.OpenReplicaRequest(
+                app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
+                ballot=pc.ballot, primary=pc.primary,
+                secondaries=pc.secondaries, envs_json=app.envs_json,
+                partition_count=2 * n, learn_from=pc.primary,
+                learn_pidx=pc.pidx)
+            for node in pc.secondaries:
+                if self._send_to_node(node, RPC_OPEN_REPLICA, req_secondary,
                                       ignore_errors=True) is None:
                     seeded = False
         if not seeded:
@@ -404,6 +440,7 @@ class MetaServer:
         # compaction GCs keys each partition no longer owns.
         with self._lock:
             envs = json.loads(app.envs_json)
+            envs.pop("replica.split_pending", None)
             envs["replica.partition_version"] = str(2 * n - 1)
             app.envs_json = json.dumps(envs)
             all_parts = list(self._parts[app.app_id])
@@ -1156,9 +1193,17 @@ class MetaServer:
                         "primary": pc.primary,
                         "secondaries": list(pc.secondaries)}
                         for pc in self._parts[app.app_id]]}
+            # duplication entries ride the snapshot too (deep-copied: the
+            # beacon fold mutates `confirmed` concurrently) — the
+            # cross-cluster audit (ISSUE 11) anchors its digest compare
+            # at these beacon-folded confirmed decrees
+            dups = {str(aid): [dict(e, confirmed=dict(e.get("confirmed", {})))
+                               for e in entries]
+                    for aid, entries in self._dups.items() if entries}
             state = {"nodes": nodes, "apps": apps,
                      "replica_states": {n: dict(s) for n, s
                                         in self._node_states.items()},
+                     "dups": dups,
                      "meta_level": self.level}
         return codec.encode(mm.QueryClusterStateResponse(
             state_json=json.dumps(state)))
@@ -1250,6 +1295,17 @@ class MetaServer:
                 self._nodes[addr] = -1e18
         self._handle_node_death(addr)
 
+    def forget_node(self, addr: str) -> None:
+        """Drop a DEAD node from the liveness map entirely (admin /
+        chaos heal): the node was replaced by one on a new address
+        rather than restarted, so its tombstone must not read as a
+        permanent 'node dead' health cause. A forgotten node that
+        beacons again simply re-registers."""
+        with self._lock:
+            self._nodes.pop(addr, None)
+            self._node_replicas.pop(addr, None)
+            self._node_states.pop(addr, None)
+
     # ---------------------------------------------------------- failover
 
     def _handle_node_death(self, node: str) -> None:
@@ -1309,8 +1365,60 @@ class MetaServer:
             # while meta reports it as a full secondary.
             self._install_partition(app, pc)
 
+    def repair_under_replication(self) -> int:
+        """Re-seed lost replicas onto alive nodes — the healing half of
+        `_reconfigure_partition`'s learner path, runnable on demand
+        (reference meta's partition-guardian cure role). A node death
+        with no spare node leaves partitions under-replicated forever:
+        at death time every alive node was already a member, and nothing
+        re-examines the partition when a replacement (or the restarted
+        node itself) later joins. The chaos harness's node-kill actor
+        calls this after the killed node rejoins, so a kill+restart leg
+        can end with the doctor HEALTHY instead of pinned degraded.
+        Returns the number of partitions a learner was seeded for."""
+        if self.level in ("stopped", "blind", "freezed"):
+            return 0
+        with self._lock:
+            work = [(app, pc) for app in self._apps.values()
+                    for pc in self._parts[app.app_id]]
+        repaired = 0
+        for app, pc in work:
+            with self._lock:
+                alive = self._alive_nodes_locked()
+                if not pc.primary or pc.primary not in alive:
+                    continue  # dead primary is _handle_node_death's job
+                members = [m for m in [pc.primary] + pc.secondaries if m]
+                live = [m for m in members if m in alive]
+                candidates = [n for n in alive if n not in members]
+                if len(live) >= app.replica_count or not candidates:
+                    continue
+                new_node = min(candidates, key=self._node_load_locked)
+                pc.ballot += 1
+                self._persist_locked()
+            # learn is synchronous inside the open RPC: the learner copies
+            # the primary's checkpoint + log tail before we admit it — a
+            # failed seed (target mid-restart) must NOT be admitted, or a
+            # hollow "secondary" reads as healthy and a later promotion
+            # loses acked writes; the next repair pass retries
+            if not self._install_partition(app, pc, learners=[new_node]):
+                continue
+            with self._lock:
+                if new_node not in pc.secondaries:
+                    pc.secondaries.append(new_node)
+                self._persist_locked()
+            # re-push the view so the primary's in-memory membership
+            # includes the admitted member (same reason as the failover
+            # learner path above)
+            self._install_partition(app, pc)
+            repaired += 1
+        return repaired
+
     def _install_partition(self, app, pc: mm.PartitionConfig, learners=()):
-        """Push the view to every member (primary first), seed learners."""
+        """Push the view to every member (primary first), seed learners.
+        -> True when every learner's seeding open succeeded (the learn is
+        synchronous inside the open RPC, so a non-error reply means the
+        checkpoint + log tail were copied); member pushes stay
+        best-effort."""
         with self._lock:
             # fresh dup entries (incl. beacon-folded confirmed decrees) ride
             # every install: a promoted primary starts its shippers at the
@@ -1325,6 +1433,7 @@ class MetaServer:
             if node:
                 self._send_to_node(node, RPC_OPEN_REPLICA, req,
                                    ignore_errors=True)
+        seeded = True
         for node in learners:
             lreq = mm.OpenReplicaRequest(
                 app_name=app.app_name, app_id=app.app_id, pidx=pc.pidx,
@@ -1332,7 +1441,10 @@ class MetaServer:
                 secondaries=pc.secondaries + [node],
                 learn_from=pc.primary, envs_json=app.envs_json,
                 partition_count=app.partition_count)
-            self._send_to_node(node, RPC_OPEN_REPLICA, lreq, ignore_errors=True)
+            if self._send_to_node(node, RPC_OPEN_REPLICA, lreq,
+                                  ignore_errors=True) is None:
+                seeded = False
+        return seeded
 
     # ------------------------------------------------------------- helpers
 

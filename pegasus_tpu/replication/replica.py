@@ -96,11 +96,12 @@ class Replica:
 
     def __init__(self, name: str, path: str, app_id: int = 1, pidx: int = 0,
                  options: EngineOptions = None, peers=None,
-                 quorum: int = 2, fsync: bool = False):
+                 quorum: int = 2, fsync: bool = False, cluster_id: int = 0):
         self.name = name
         self.path = path
         self.app_id = app_id
         self.pidx = pidx
+        self.cluster_id = cluster_id
         self.quorum = quorum
         self.peers = peers or (lambda n: (_ for _ in ()).throw(ConnectionError(n)))
         self._lock = lockrank.named_rlock("replica.lock")
@@ -108,7 +109,8 @@ class Replica:
         self.ballot = 0         #: guarded_by self._lock
         self.view = None        #: guarded_by self._lock
         self.server = PegasusServer(os.path.join(path, "data"), app_id=app_id,
-                                    pidx=pidx, options=options, server=name)
+                                    pidx=pidx, options=options, server=name,
+                                    cluster_id=cluster_id)
         self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
         # decree -> LogMutation (prepared, not applied)
         self._uncommitted = {}   #: guarded_by self._lock
@@ -543,7 +545,8 @@ class Replica:
             self.server = PegasusServer.__new__(PegasusServer)
             self.server.__init__(os.path.join(self.path, "data"),
                                  app_id=self.app_id, pidx=self.pidx,
-                                 options=engine.opts, server=self.name)
+                                 options=engine.opts, server=self.name,
+                                 cluster_id=self.cluster_id)
             self.plog.reset()
             self.last_committed = self.server.engine.last_committed_decree()
             self.last_prepared = self.last_committed

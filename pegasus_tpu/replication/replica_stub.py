@@ -390,6 +390,10 @@ class ReplicaStub:
                            f"partition {req.app_id}.{req.pidx} belongs to "
                            f"another group executor")
         key = (req.app_id, req.pidx)
+        # a CROSS-partition learn is split child seeding (parent history
+        # copied once); a same-pidx learn is a repair/failover re-seed
+        # from the partition's own authoritative primary
+        cross_learn = bool(req.learn_from) and 0 <= req.learn_pidx != req.pidx
         with self._lock:
             rep = self._replicas.get(key)
             if rep is None:
@@ -399,14 +403,35 @@ class ReplicaStub:
                     self._seed_from_restore(path, req.restore_dir)
                 rep = Replica(f"{self.address}", path, req.app_id, req.pidx,
                               self.options_factory(),
-                              peers=self._peer_factory(req.app_id, req.pidx))
+                              peers=self._peer_factory(req.app_id, req.pidx),
+                              cluster_id=self.cluster_id)
                 self._replicas[key] = rep
-            # (re-)register: partition splits change the count for existing
-            # replicas, which drives the misroute rejection check
-            self._service.add_replica(rep.server, req.partition_count)
+            # Split seeding must be ONCE-ONLY and seed-before-serve:
+            #  * once-only — when the meta retries a split whose seeding
+            #    RPC failed (timeout/partial), a child that DID seed and
+            #    then accepted writes must not re-learn from the parent:
+            #    the parent has rejected child-half writes since split
+            #    phase 1, so its copy lacks them, and learn_from replaces
+            #    the engine wholesale — the re-learn would silently wipe
+            #    acked writes (the cross-cluster digest compare caught
+            #    exactly this: the duplication target kept rows the
+            #    re-learned source child had lost);
+            #  * seed-before-serve — registering the child before its
+            #    seed learn makes a child whose learn then fails servable
+            #    EMPTY (clients would write into a hollow partition whose
+            #    pre-split half later reads as lost), so a child pending
+            #    its seed is registered only after the learn succeeds.
+            seeded = getattr(rep, "split_seeded", False) \
+                or rep.last_committed > 0
+            need_seed = cross_learn and not seeded
+            if not need_seed:
+                # (re-)register: partition splits change the count for
+                # existing replicas, which drives the misroute rejection
+                self._service.add_replica(rep.server, req.partition_count)
         learn_self = (req.learn_from == self.address
                       and (req.learn_pidx < 0 or req.learn_pidx == req.pidx))
-        if req.learn_from and not learn_self:
+        if req.learn_from and not learn_self and (need_seed
+                                                  or not cross_learn):
             learn_pidx = req.learn_pidx if req.learn_pidx >= 0 else req.pidx
             if req.learn_from == self.address:
                 with self._lock:
@@ -425,8 +450,22 @@ class ReplicaStub:
             if peer is not None:
                 rep.learn_from(peer)
                 with self._lock:
+                    if cross_learn:
+                        # seed complete: a split retry must never learn
+                        # this child from its parent again
+                        rep.split_seeded = True
                     self._service.remove_replica(req.app_id, req.pidx)
                     self._service.add_replica(rep.server, req.partition_count)
+            elif need_seed:
+                # no resolvable seed source (the in-process parent is gone,
+                # e.g. mid-restart): replying success here would let the
+                # meta count this child as seeded and spread the GC mask
+                # over a hollow, unregistered partition — fail the open so
+                # the split marks seeding incomplete and retries
+                raise RpcError(ERR_INVALID_STATE,
+                               f"split child {req.app_id}.{req.pidx} cannot "
+                               f"seed: parent {req.app_id}.{learn_pidx} not "
+                               f"found at {req.learn_from}")
         rep.app_name = req.app_name or rep.app_name
         rep.partition_count = req.partition_count or rep.partition_count
         rep.assume_view(GroupView(req.ballot, req.primary, req.secondaries))
@@ -848,31 +887,44 @@ class ReplicaStub:
         from ..engine.server_impl import RPC_TRIGGER_AUDIT
         from ..rpc import messages as rpc_msg
 
-        if not args:
-            return "usage: trigger-audit <app_id.pidx> [audit_id]"
-        a, _, p = args[0].partition(".")
+        # now=<epoch>: auditor-supplied expiry anchor — the cross-cluster
+        # compare digests BOTH clusters against one instant so a TTL
+        # record expiring between the two audits cannot fake a mismatch
+        now_arg = next((int(x[4:]) for x in args if x.startswith("now=")),
+                       None)
+        pos = [x for x in args if not x.startswith("now=")]
+        if not pos:
+            return ("usage: trigger-audit <app_id.pidx> [audit_id] "
+                    "[now=<epoch>]")
+        a, _, p = pos[0].partition(".")
         with self._lock:
             rep = self._replicas.get((int(a), int(p)))
         if rep is None:
             return ""
         if rep.status != PRIMARY:
             return json.dumps({"error": f"not primary ({rep.status})",
-                               "gpid": args[0], "node": self.address})
-        audit_id = int(args[1]) if len(args) > 1 else int(time.time() * 1000)
-        req = rpc_msg.TriggerAuditRequest(audit_id=audit_id, now=epoch_now())
+                               "gpid": pos[0], "node": self.address})
+        audit_id = int(pos[1]) if len(pos) > 1 else int(time.time() * 1000)
+        # partition_count - 1 = the ownership mask (hash % count == pidx);
+        # carried IN the mutation so every replica digests against the
+        # same mask at the same decree, mid-split or not
+        pmask = max(0, (rep.partition_count or 0) - 1)
+        req = rpc_msg.TriggerAuditRequest(
+            audit_id=audit_id,
+            now=epoch_now() if now_arg is None else now_arg, pmask=pmask)
         try:
             resp = rep.client_write(RPC_TRIGGER_AUDIT, req)
         except ReplicaError as e:
-            return json.dumps({"error": str(e), "gpid": args[0],
+            return json.dumps({"error": str(e), "gpid": pos[0],
                                "node": self.address})
         if resp.error or not resp.digest:
             # a failed digest computation must surface as an ERROR the
             # audit driver turns into inconclusive — an empty digest
             # compared as real would fake a mismatch on every secondary
             return json.dumps({"error": f"digest failed ({resp.server})",
-                               "gpid": args[0], "node": self.address})
+                               "gpid": pos[0], "node": self.address})
         rep.broadcast_commit_point()
-        return json.dumps({"gpid": args[0], "audit_id": audit_id,
+        return json.dumps({"gpid": pos[0], "audit_id": audit_id,
                            "decree": resp.decree, "digest": resp.digest,
                            "records": resp.records, "node": self.address})
 

@@ -318,6 +318,22 @@ class GroupedReplicaNode:
             self.pool.invalidate(("127.0.0.1", port))
         self._c_active.set(sum(x.alive for x in self._workers))
 
+    def group_alive(self, g: int, probe: bool = True) -> bool:
+        """Is group executor `g` serving? With probe=True (default) the
+        worker must also ANSWER an RPC_GROUP_STATE round trip — the
+        chaos harness's recovery check after restart_group: a respawned
+        process that never reached serving must not count as healed."""
+        w = self._workers[g]
+        if not w.alive:
+            return False
+        if not probe:
+            return True
+        try:
+            self._upstream(g).call(RPC_GROUP_STATE, b"", timeout=2.0)
+            return True
+        except (RpcError, OSError, ConnectionError):
+            return False
+
     def restart_group(self, g: int):
         """Respawn a dead group and replay its cached open-replica state
         so it re-serves immediately (decree state recovers from the
@@ -382,24 +398,32 @@ class GroupedReplicaNode:
         payload = struct.pack("<I", len(buffered)) + bytes(buffered)
         try:
             with w.ctrl_lock:
-                if not w.ctrl_ok:
+                # local ref: kill_group() nulls w.ctrl concurrently (it
+                # does not take ctrl_lock — closing must not queue behind
+                # a wedged handoff), so every touch below goes through
+                # `ctrl`, and a close mid-handoff surfaces as OSError
+                ctrl = w.ctrl
+                if not w.ctrl_ok or ctrl is None:
                     return False
                 # send_fds is ONE sendmsg: the fd rides its ancillary data,
                 # but a large first frame can exceed the unix-socket buffer
                 # and return a SHORT write — push the rest with sendall or
                 # both ends wedge (worker waiting for bytes, parent for ack)
-                w.ctrl.settimeout(10.0)  # a wedged worker must not pin
+                ctrl.settimeout(10.0)  # a wedged worker must not pin
                 # ctrl_lock forever (every later handoff would queue on it)
                 try:
-                    sent = socket.send_fds(w.ctrl, [payload],
+                    sent = socket.send_fds(ctrl, [payload],
                                            [conn.fileno()])
                     if sent < len(payload):
-                        w.ctrl.sendall(payload[sent:])
+                        ctrl.sendall(payload[sent:])
                     # 1-byte ack serializes fd+payload pairs on the stream
-                    if w.ctrl.recv(1) != b"A":
+                    if ctrl.recv(1) != b"A":
                         raise ConnectionError("handoff not acked")
                 finally:
-                    w.ctrl.settimeout(None)
+                    try:
+                        ctrl.settimeout(None)
+                    except OSError:
+                        pass   # closed mid-handoff (kill_group)
             return True
         except (OSError, ConnectionError) as e:
             # the channel may be desynced mid-message: stop handing off to

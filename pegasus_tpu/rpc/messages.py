@@ -309,10 +309,17 @@ class TriggerAuditRequest:
     digest of its engine state at the decree this mutation applies at.
     `now` is the expiry clock the PRIMARY chose — all replicas filter
     TTL-expired records against the same instant, so clock skew cannot
-    fake a mismatch."""
+    fake a mismatch. `pmask` (partition_count - 1) is the ownership
+    mask the PRIMARY chose: every replica excludes records the
+    partition no longer owns (split stale halves) against the SAME
+    mask — the env-spread partition_version is asynchronous per
+    replica, so anchoring the mask in the mutation is what keeps a
+    digest during a split from faking a mismatch (append-only codec
+    evolution: old senders leave it 0 = engine-local mask)."""
 
     audit_id: int = 0
     now: int = 0
+    pmask: int = 0
 
 
 @dataclass

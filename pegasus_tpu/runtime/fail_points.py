@@ -37,17 +37,33 @@ class _FailPointRegistry:
         self._lock = threading.Lock()
         self._points = {}
         self._enabled = False
+        self._active = 0   # non-'off' points; with _enabled it forms the
+        # UNLOCKED fast-path check in evaluate() — once every point is
+        # healed with off(), hot-path hooks (serve.dispatch runs per RPC)
+        # go back to a plain attribute read instead of taking the lock
         self._rng = random.Random(0)
 
     def setup(self):
         with self._lock:
             self._enabled = True
             self._points.clear()
+            self._active = 0
 
     def teardown(self):
         with self._lock:
             self._enabled = False
             self._points.clear()
+            self._active = 0
+
+    def arm(self, name: str, action: str):
+        """cfg() that also ENABLES the registry without clearing points
+        already armed — the ``set-fail-point`` remote-command path (ISSUE
+        11): a chaos harness arms points one at a time in a live server
+        process, where setup()'s clear would heal every other armed
+        fault as a side effect."""
+        with self._lock:
+            self._enabled = True
+        self.cfg(name, action)
 
     def cfg(self, name: str, action: str):
         m = _ACTION_RE.match(action)
@@ -60,12 +76,14 @@ class _FailPointRegistry:
                 "verb": m.group("verb"),
                 "arg": m.group("arg"),
             }
+            self._active = sum(1 for p in self._points.values()
+                               if p["verb"] != "off")
 
     def evaluate(self, name: str):
         """None = not triggered; otherwise the (verb, arg) tuple. Pure:
         side-effectful verbs (sleep/raise) act in fail_point(), OUTSIDE the
         registry lock — a sleeping hook must not block cfg()/teardown()."""
-        if not self._enabled:
+        if not self._enabled or not self._active:
             return None
         with self._lock:
             p = self._points.get(name)
@@ -84,6 +102,7 @@ _REGISTRY = _FailPointRegistry()
 setup = _REGISTRY.setup
 teardown = _REGISTRY.teardown
 cfg = _REGISTRY.cfg
+arm = _REGISTRY.arm
 
 
 def fail_point(name: str):

@@ -49,12 +49,39 @@ class RemoteCommandService:
         self.register("perf-counters-by-substr",
                       lambda a: self._dump_counters(
                           lambda n: any(p in n for p in a)))
+        self.register("set-fail-point", self._cmd_set_fail_point)
         self.register("compact-trace-dump", self._cmd_compact_trace_dump)
         self.register("device-health", self._cmd_device_health)
         self.register("request-trace-dump", self._cmd_request_trace_dump)
         self.register("slow-requests", self._cmd_slow_requests)
         if describe is not None:
             self.register("describe", lambda a: json.dumps(describe(), indent=1))
+
+    @staticmethod
+    def _cmd_set_fail_point(args) -> str:
+        """set-fail-point <name> <action> — arm (or heal, with 'off()') a
+        fail point in THIS server process at runtime, using the same
+        action mini-language tests use (`sleep(ms)`, `raise(msg)`,
+        `return(v)`, `N%`/`K*` modifiers). The chaos scenario engine's
+        fault-injection surface (ISSUE 11): before this command, fail
+        points could only be armed in-process before startup, so a
+        spawned group worker or remote node was out of reach. Arming
+        never clears other armed points (fail_points.arm). The reply is
+        a JSON dict keyed by this process's pid, so a partition-group
+        router's structural fan-out merge keeps every worker's ack and
+        the caller can count how many processes armed."""
+        import os
+
+        from . import fail_points
+
+        if len(args) < 2:
+            return "usage: set-fail-point <name> <action>"
+        name, action = args[0], " ".join(args[1:])
+        try:
+            fail_points.arm(name, action)
+        except ValueError as e:
+            return str(e)   # "bad fail point action: ..."
+        return json.dumps({f"pid:{os.getpid()}": f"{name}={action}"})
 
     @staticmethod
     def _cmd_compact_trace_dump(args) -> str:

@@ -113,6 +113,18 @@ class Shell:
                                "named causes + evidence"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
+            "set_fail_point": (self.cmd_set_fail_point,
+                               "set_fail_point <node|all> <name> <action> — "
+                               "arm/heal a fail point in live server "
+                               "processes (chaos harness; action e.g. "
+                               "'sleep(40)', '20%raise(x)', 'off()')"),
+            "cross_cluster_audit": (self.cmd_cross_cluster_audit,
+                                    "cross_cluster_audit <app> "
+                                    "<dst_meta[,dst_meta...]> [dupid] — "
+                                    "table-level digest compare against a "
+                                    "duplication target cluster, anchored "
+                                    "at the duplicator's confirmed decree "
+                                    "(quiesce writes first)"),
             "propose": (self.cmd_propose,
                         "propose <pidx> <target_node> — move primary"),
             "balance": (self.cmd_balance, "equalize primary counts"),
@@ -631,6 +643,39 @@ class Shell:
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]
         self.p(self._node_command(node, "detect_hotkey", rest))
+
+    def cmd_set_fail_point(self, args):
+        if len(args) < 3:
+            self.p("usage: set_fail_point <node|all> <name> <action>")
+            return
+        target, rest = args[0], args[1:]
+        nodes = ([n.address for n in self._nodes() if n.alive]
+                 if target == "all" else [target])
+        for node in nodes:
+            self.p(f"[{node}] "
+                   + self._node_command(node, "set-fail-point", rest))
+
+    def cmd_cross_cluster_audit(self, args):
+        from ..collector.cluster_doctor import run_cross_cluster_audit
+
+        if len(args) < 2:
+            self.p("usage: cross_cluster_audit <app> "
+                   "<dst_meta[,dst_meta...]> [dupid]")
+            return
+        app, dst = args[0], args[1].split(",")
+        dupid = int(args[2]) if len(args) > 2 else None
+        report = run_cross_cluster_audit(self.meta_addrs, dst, app,
+                                         dupid=dupid)
+        self.p(json.dumps(report, indent=1))
+        if report["match"] is True:
+            self.p(f"cross-cluster audit OK: {report['src']['records']} "
+                   "records, table digests identical at the confirmed "
+                   "decree anchors")
+        elif report["match"] is False:
+            self.p("cross-cluster audit MISMATCH")
+        else:
+            self.p("cross-cluster audit inconclusive: "
+                   + "; ".join(report["inconclusive"]))
 
     def cmd_propose(self, args):
         from ..meta.meta_server import RPC_CM_PROPOSE

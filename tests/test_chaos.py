@@ -1,0 +1,322 @@
+"""ISSUE 11: chaos scenario engine — units + the production-sim tier.
+
+Three layers:
+
+  * scenario-engine units: schedule validation/expansion (ordering,
+    arm/heal pairing), runner semantics against fake actors (windows
+    open/close around faults, a recovery-deadline breach is a NAMED
+    failure, an actor that cannot arm/heal is a named failure), the
+    bounded latency reservoir's pinned percentile semantics, and the
+    fault-window error classification;
+  * a bounded tier-1 chaos smoke: `pressure_test --scenario smoke`
+    (group-worker kill + remote fail-point wedge under self-verifying
+    load) must exit 0 with the doctor healthy — and the SAME command
+    with undeclared `audit.digest` corruption injected must exit 1
+    with `audit.mismatch` named in the journal (self-falsification:
+    a harness that cannot catch a planted fault proves nothing);
+  * a `slow`-marked full scenario: node kill+restart, mid-load split,
+    balancer move, scheduler flips, duplication leg + cross-cluster
+    digest compare at the duplicator's confirmed decree.
+"""
+
+import json
+import time
+
+import pytest
+
+from pegasus_tpu.chaos.journal import EventJournal, FaultWindows
+from pegasus_tpu.chaos.scenario import (FaultAction, Scenario,
+                                        ScenarioError, ScenarioRunner,
+                                        full_scenario, smoke_scenario)
+
+from tools.pressure_test import LatencyReservoir, run_pressure
+
+
+# ----------------------------------------------------- schedule validation
+
+
+def test_validate_rejects_duplicate_action_names():
+    s = Scenario("s", [FaultAction("a", "x", at_s=0),
+                       FaultAction("a", "x", at_s=1)])
+    with pytest.raises(ScenarioError, match="duplicate"):
+        s.validate()
+
+
+def test_validate_rejects_negative_times_and_zero_deadline():
+    with pytest.raises(ScenarioError, match="negative"):
+        Scenario("s", [FaultAction("a", "x", at_s=-1)]).validate()
+    with pytest.raises(ScenarioError, match="recovery_deadline"):
+        Scenario("s", [FaultAction("a", "x", at_s=0,
+                                   recovery_deadline_s=0)]).validate()
+
+
+def test_validate_rejects_overlapping_periodic_occurrences():
+    # every_s <= duration_s would arm the next occurrence before the
+    # previous one healed — the arm/heal pairing invariant
+    with pytest.raises(ScenarioError, match="every_s"):
+        Scenario("s", [FaultAction("a", "x", at_s=0, duration_s=5,
+                                   every_s=4)]).validate()
+
+
+def test_validate_rejects_unknown_actor():
+    s = Scenario("s", [FaultAction("a", "nope", at_s=0)])
+    with pytest.raises(ScenarioError, match="unknown actor"):
+        s.validate(actor_keys={"failpoint"})
+    s.validate(actor_keys={"nope"})  # known = fine
+
+
+def test_builtin_scenarios_validate():
+    keys = {"failpoint", "group_kill", "node_kill", "split", "balance",
+            "sched_flip"}
+    smoke_scenario().validate(keys)
+    full_scenario().validate(keys)
+
+
+# ----------------------------------------------------- timeline expansion
+
+
+def test_timeline_sorted_with_arm_before_heal():
+    s = Scenario("s", [
+        FaultAction("instant", "x", at_s=2.0, duration_s=0.0),
+        FaultAction("early", "x", at_s=1.0, duration_s=5.0),
+    ])
+    tl = s.timeline(run_s=10.0)
+    assert [t for t, _, _, _ in tl] == sorted(t for t, _, _, _ in tl)
+    # zero-duration action: arm and heal share t=2.0 but arm comes FIRST
+    pair = [(what, a.name) for t, what, a, _ in tl if a.name == "instant"]
+    assert pair == [("arm", "instant"), ("heal", "instant")]
+
+
+def test_timeline_periodic_expansion_and_pairing():
+    s = Scenario("s", [FaultAction("p", "x", at_s=1.0, duration_s=2.0,
+                                   every_s=4.0)])
+    tl = s.timeline(run_s=10.0)  # arms at 1, 5, 9
+    arms = [(t, k) for t, what, _, k in tl if what == "arm"]
+    heals = [(t, k) for t, what, _, k in tl if what == "heal"]
+    assert arms == [(1.0, 0), (5.0, 1), (9.0, 2)]
+    # every occurrence heals, including the one armed near the end
+    assert heals == [(3.0, 0), (7.0, 1), (11.0, 2)]
+
+
+def test_timeline_single_shot_past_run_end_still_emitted():
+    s = Scenario("s", [FaultAction("a", "x", at_s=0.0, duration_s=99.0)])
+    tl = s.timeline(run_s=10.0)
+    assert [(t, what) for t, what, _, _ in tl] == [(0.0, "arm"),
+                                                  (99.0, "heal")]
+
+
+# --------------------------------------------------------- runner semantics
+
+
+class FakeActor:
+    def __init__(self, recover_after_heals: int = 0, arm_error=None,
+                 heal_error=None):
+        self.armed = []
+        self.healed = 0
+        self.recover_after_heals = recover_after_heals
+        self.arm_error = arm_error
+        self.heal_error = heal_error
+
+    def arm(self, **args):
+        if self.arm_error:
+            raise self.arm_error
+        self.armed.append(args)
+
+    def heal(self):
+        if self.heal_error:
+            raise self.heal_error
+        self.healed += 1
+
+    def recovered(self):
+        return self.healed >= self.recover_after_heals
+
+
+def _run(scenario, actors, run_s=0.1):
+    journal = EventJournal()
+    runner = ScenarioRunner(scenario, actors, journal)
+    runner.start(run_s)
+    runner.join(timeout=30)
+    return runner, journal
+
+
+def test_runner_arms_heals_and_closes_windows():
+    actor = FakeActor()
+    s = Scenario("s", [FaultAction("a", "x", at_s=0.0, duration_s=0.05,
+                                   settle_s=0.0, args={"k": 1})])
+    runner, journal = _run(s, {"x": actor})
+    assert actor.armed == [{"k": 1}] and actor.healed == 1
+    assert runner.failures == []
+    kinds = [e["kind"] for e in journal.events()]
+    assert kinds.count("fault.armed") == 1
+    assert kinds.count("fault.healed") == 1
+    assert kinds.count("fault.recovered") == 1
+    assert kinds[-1] == "scenario.done"
+    # the declared window is closed and bounded
+    (w,) = runner.windows.bounds()
+    assert w["name"] == "a" and w["end"] is not None
+
+
+def test_runner_periodic_occurrences_pair_and_name():
+    actor = FakeActor()
+    s = Scenario("s", [FaultAction("p", "x", at_s=0.0, duration_s=0.02,
+                                   every_s=0.06, settle_s=0.0)])
+    runner, journal = _run(s, {"x": actor}, run_s=0.15)
+    assert len(actor.armed) == actor.healed >= 2
+    names = [e["action"] for e in journal.events("fault.armed")]
+    assert names[:2] == ["p#0", "p#1"]   # occurrence-indexed
+    assert all(w["end"] is not None for w in runner.windows.bounds())
+
+
+def test_runner_deadline_breach_is_named_failure():
+    actor = FakeActor(recover_after_heals=99)   # never recovers
+    s = Scenario("s", [FaultAction("wedge", "x", at_s=0.0, duration_s=0.0,
+                                   recovery_deadline_s=0.4)])
+    runner, _ = _run(s, {"x": actor})
+    assert [f["failure"] for f in runner.failures] \
+        == ["recovery.deadline:wedge"]
+
+
+def test_runner_arm_and_heal_errors_are_named_failures():
+    s = Scenario("s", [FaultAction("boom", "x", at_s=0.0, duration_s=0.0)])
+    runner, _ = _run(s, {"x": FakeActor(arm_error=RuntimeError("nope"))})
+    assert "actor.arm:boom" in [f["failure"] for f in runner.failures]
+    runner, _ = _run(s, {"x": FakeActor(heal_error=RuntimeError("nope"))})
+    assert "actor.heal:boom" in [f["failure"] for f in runner.failures]
+
+
+def test_runner_arm_failure_skips_heal_and_recovery():
+    """An occurrence whose arm() raised has nothing to heal: healing the
+    unarmed actor would cascade ONE failure into spurious actor.heal +
+    recovery.deadline ones, and the recovery wait would stall every
+    later action by the full deadline."""
+    actor = FakeActor(arm_error=RuntimeError("nope"),
+                      heal_error=RuntimeError("unarmed"),
+                      recover_after_heals=99)
+    s = Scenario("s", [FaultAction("boom", "x", at_s=0.0, duration_s=0.0,
+                                   recovery_deadline_s=30.0, settle_s=0.0)])
+    t0 = time.monotonic()
+    runner, _ = _run(s, {"x": actor})
+    assert [f["failure"] for f in runner.failures] == ["actor.arm:boom"]
+    assert actor.healed == 0
+    assert time.monotonic() - t0 < 5.0   # no recovery-deadline stall
+    (w,) = runner.windows.bounds()
+    assert w["end"] is not None          # the declared window still closes
+
+
+# ------------------------------------------- windows + error classification
+
+
+def test_fault_windows_classify_in_vs_out():
+    j = EventJournal()
+    w = FaultWindows(j)
+    assert not w.in_window()
+    wid = w.open("blip")
+    assert w.in_window()
+    w.close(wid, settle_s=100.0)         # settle keeps the window open
+    assert w.in_window()
+    w2 = w.open("other")
+    w.close(w2, settle_s=0.0)
+    # an instant before any window opened stays OUT
+    assert not w.in_window(t=-1.0)
+
+
+# ----------------------------------------------------- latency reservoir
+
+
+def test_reservoir_below_cap_pins_old_percentile_semantics():
+    vals = [float(v) for v in range(100, 0, -1)]   # 100..1, unsorted-ish
+    r = LatencyReservoir(cap=1000)
+    for v in vals:
+        r.add(v)
+    s = sorted(vals)
+    for p in (0.5, 0.95, 0.99):
+        # the exact index rule the old unbounded sorted list used
+        assert r.percentile(p) == round(s[min(len(s) - 1,
+                                              int(len(s) * p))], 2)
+    assert r.avg() == round(sum(vals) / len(vals), 2)
+
+
+def test_reservoir_bounded_past_cap():
+    r = LatencyReservoir(cap=64, seed=7)
+    for v in range(10_000):
+        r.add(float(v))
+    assert len(r._sample) == 64 and r.count == 10_000
+    assert r.total == float(sum(range(10_000)))
+    # a uniform sample of 0..9999: p95 lands in the upper region
+    assert 8000 < r.percentile(0.95) <= 9999
+
+
+# ------------------------------------------------- tier-1 chaos smoke (e2e)
+
+
+def _journal(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_chaos_smoke_survives_and_doctor_healthy(tmp_path):
+    """The bounded production-sim smoke: self-verifying load while a
+    group-worker process is SIGKILLed (+ restart_group replay) and a
+    dispatch wedge is armed remotely over set-fail-point, under a
+    periodic decree-anchored audit cadence — zero lost acked writes,
+    every error in a declared window, doctor ends healthy."""
+    out = tmp_path / "journal.json"
+    rc = run_pressure(["--scenario", "smoke", "--qps", "40", "--seconds",
+                       "12", "--threads", "2", "--audit-every", "4",
+                       "--journal", str(out)])
+    j = _journal(out)
+    assert rc == 0, f"chaos smoke failed: {j['failures']}"
+    assert j["failures"] == []
+    kinds = {e["kind"] for e in j["events"]}
+    assert {"fault.armed", "fault.healed", "fault.recovered",
+            "audit.round", "doctor.final"} <= kinds
+    (doc,) = [e for e in j["events"] if e["kind"] == "doctor.final"]
+    assert doc["verdict"] == "healthy"
+    # the cadence ran MORE than one round, and at least one concluded
+    rounds = [e for e in j["events"] if e["kind"] == "audit.round"]
+    assert len(rounds) >= 2
+    assert any(r["conclusive"] for r in rounds)
+    assert not any(r["mismatches"] for r in rounds)
+
+
+def test_chaos_smoke_catches_planted_audit_corruption(tmp_path):
+    """Self-falsification: the SAME command with undeclared audit-digest
+    corruption armed on one node must exit 1 with the failure NAMED —
+    a green harness that cannot catch a planted fault proves nothing."""
+    out = tmp_path / "journal.json"
+    rc = run_pressure(["--scenario", "smoke", "--qps", "30", "--seconds",
+                       "8", "--threads", "2", "--audit-every", "3",
+                       "--inject-fault", "audit.digest=return()",
+                       "--journal", str(out)])
+    j = _journal(out)
+    assert rc == 1
+    failures = [f["failure"] for f in j["failures"]]
+    assert "audit.mismatch" in failures, failures
+
+
+# ------------------------------------------------- full scenario (kill tier)
+
+
+@pytest.mark.slow
+def test_chaos_full_scenario_survives(tmp_path):
+    """The flagship: scheduler flips, dispatch wedge, mid-load partition
+    split, group-worker kill, balancer primary move, node kill+restart,
+    duplication to a second cluster — exit 0 requires zero lost acked
+    writes, in-window-only errors, mismatch-free non-vacuous audits, a
+    matching cross-cluster digest at the duplicator's confirmed decree,
+    and a healthy final doctor verdict."""
+    out = tmp_path / "journal.json"
+    rc = run_pressure(["--scenario", "full", "--qps", "60", "--seconds",
+                       "30", "--threads", "2", "--audit-every", "5",
+                       "--journal", str(out)])
+    j = _journal(out)
+    assert rc == 0, f"full scenario failed: {j['failures']}"
+    assert j["failures"] == []
+    (xc,) = [e for e in j["events"] if e["kind"] == "cross_cluster.audit"]
+    assert xc["match"] is True
+    assert xc["src"]["records"] == xc["dst"]["records"] > 0
+    (doc,) = [e for e in j["events"] if e["kind"] == "doctor.final"]
+    assert doc["verdict"] == "healthy"
+    armed = {e["action"] for e in j["events"] if e["kind"] == "fault.armed"}
+    assert {"sched-defer-urgent", "dispatch-wedge", "split-double",
+            "kill-group", "primary-move", "kill-node"} <= armed

@@ -25,8 +25,10 @@ from pegasus_tpu.rpc.transport import RpcConnection, RpcServer
 
 
 class MiniCluster:
-    def __init__(self, root, n_nodes=3, serve_groups=0):
-        self.meta = MetaServer(str(root / "meta.json"), fd_grace_seconds=60)
+    def __init__(self, root, n_nodes=3, serve_groups=0, remote_clusters=None,
+                 cluster_id=1, fd_grace_seconds=60):
+        self.meta = MetaServer(str(root / "meta.json"),
+                               fd_grace_seconds=fd_grace_seconds)
         self.rpc = RpcServer().start()
         for code, fn in self.meta.rpc_handlers().items():
             self.rpc.register(code, fn)
@@ -38,16 +40,20 @@ class MiniCluster:
 
             self.stubs = [GroupedReplicaNode(str(root / f"n{i}"),
                                              [self.meta_addr],
-                                             groups=serve_groups).start(0.2)
+                                             groups=serve_groups,
+                                             remote_clusters=remote_clusters,
+                                             cluster_id=cluster_id).start(0.2)
                           for i in range(n_nodes)]
         else:
             self.stubs = [ReplicaStub(str(root / f"n{i}"),
-                                      [self.meta_addr]).start(0.2)
+                                      [self.meta_addr],
+                                      remote_clusters=remote_clusters,
+                                      cluster_id=cluster_id).start(0.2)
                           for i in range(n_nodes)]
         self._conn = RpcConnection(self.rpc.address)
 
-    def ddl(self, code, req, resp_cls):
-        _, body = self._conn.call(code, codec.encode(req), timeout=30.0)
+    def ddl(self, code, req, resp_cls, timeout=30.0):
+        _, body = self._conn.call(code, codec.encode(req), timeout=timeout)
         return codec.decode(resp_cls, body)
 
     def create(self, name, partitions=2, replicas=3):

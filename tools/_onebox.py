@@ -16,14 +16,20 @@ class Onebox:
     entry point."""
 
     def __init__(self, table: str, partitions: int = 8, n_nodes: int = 3,
-                 serve_groups: int = 0, replicas: int = 3):
+                 serve_groups: int = 0, replicas: int = 3,
+                 remote_clusters: dict = None, cluster_id: int = 1,
+                 fd_grace_seconds: float = 60, create: bool = True):
         from tests.test_satellites import MiniCluster
 
         self._tmp = tempfile.TemporaryDirectory(prefix="pegasus_tool_")
         self.cluster = MiniCluster(pathlib.Path(self._tmp.name),
-                                   n_nodes=n_nodes, serve_groups=serve_groups)
-        self.cluster.create(table, partitions=partitions,
-                            replicas=replicas).close()
+                                   n_nodes=n_nodes, serve_groups=serve_groups,
+                                   remote_clusters=remote_clusters,
+                                   cluster_id=cluster_id,
+                                   fd_grace_seconds=fd_grace_seconds)
+        if create:
+            self.cluster.create(table, partitions=partitions,
+                                replicas=replicas).close()
         self.meta_addr = self.cluster.meta_addr
 
     def __enter__(self):

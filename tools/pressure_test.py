@@ -1,15 +1,33 @@
-"""Pressure test: sustained target-QPS load with self-checking data.
+"""Pressure test: sustained target-QPS load — now with a chaos scenario
+engine (ISSUE 11's production-sim harness).
 
-The reference's src/test/pressure_test tier: a long-running load generator
-holding a TARGET qps against a cluster (onebox here; point --meta at any
-cluster) with a configurable op mix, writing SELF-CHECKING rows (value
-derived from key) so every read verifies itself, and reporting achieved
-qps + latency percentiles + verification failures.
+The reference's src/test/pressure_test + kill_test tiers in one driver: a
+load generator holding a TARGET qps against a cluster with a configurable
+op mix, writing SELF-CHECKING rows (value derived from key) so every read
+verifies itself, while (optionally) a scripted fault schedule runs
+node kills, group-worker kills, remote fail-point wedges, a mid-load
+partition split, a balancer primary move, compaction-scheduler token
+flips and a duplication leg to a second cluster — all under periodic
+decree-anchored audit rounds.
+
+Pass criterion (exit 0) — every failure is NAMED in the event journal:
+
+  * zero lost acked writes (self-verifying reads, with re-read
+    verification before anything counts as lost);
+  * every transient error fell inside a DECLARED fault window
+    (steady-state errors fail the run);
+  * every audit round mismatch-free, with at least one conclusive
+    (non-vacuous) round;
+  * scenario runs: every fault healed within its recovery deadline, the
+    cross-cluster digest compare (anchored at the duplicator's confirmed
+    decree) matched, and the final cluster_doctor verdict is healthy.
 
 Usage:
     python tools/pressure_test.py [--meta host:port] [--table t]
         [--qps 500] [--seconds 30] [--threads 4] [--read-pct 50]
-(no --meta: boots its own in-process onebox)
+        [--scenario none|smoke|full] [--audit-every 5] [--journal out.json]
+(no --meta: boots its own onebox; --scenario requires the self-booted
+onebox — the fault actors need the cluster handles)
 """
 
 import argparse
@@ -31,7 +49,46 @@ def expected_value(key: bytes) -> bytes:
     return hashlib.md5(key).hexdigest().encode()
 
 
-def main():
+class LatencyReservoir:
+    """Bounded-memory latency sample (Vitter's Algorithm R) replacing the
+    old unbounded per-op list: a long chaos run at 500+ QPS would hold
+    millions of floats. Up to `cap` samples the reservoir IS the full
+    population, so `percentile` reproduces the old sorted-list semantics
+    exactly (index ``min(n-1, int(n*p))``); past `cap` each op keeps a
+    uniform cap/count chance of being sampled. Thread-safe."""
+
+    def __init__(self, cap: int = 8192, seed: int = 0):
+        self.cap = max(1, cap)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._sample = []
+        self.count = 0
+        self.total = 0.0
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if len(self._sample) < self.cap:
+                self._sample.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.cap:
+                    self._sample[j] = v
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            s = sorted(self._sample)
+        if not s:
+            return 0.0
+        return round(s[min(len(s) - 1, int(len(s) * p))], 2)
+
+    def avg(self) -> float:
+        with self._lock:
+            return round(self.total / self.count, 2) if self.count else 0.0
+
+
+def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--meta", default="")
     ap.add_argument("--table", default="pressure")
@@ -40,129 +97,385 @@ def main():
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--read-pct", type=int, default=50)
     ap.add_argument("--key-space", type=int, default=100_000)
+    ap.add_argument("--scenario", default="none",
+                    choices=["none", "smoke", "full"],
+                    help="scripted chaos schedule to run under the load "
+                         "(pegasus_tpu.chaos): smoke = group-worker kill + "
+                         "remote fail-point wedge; full = + node "
+                         "kill/restart, mid-load split, balancer primary "
+                         "move, scheduler token flips, duplication leg "
+                         "with cross-cluster digest compare")
+    ap.add_argument("--audit-every", type=float, default=5.0,
+                    help="seconds between decree-anchored audit rounds "
+                         "under the load (0 disables; a final quiesced "
+                         "round always runs when enabled)")
+    ap.add_argument("--journal", default="",
+                    help="write the full event-journal artifact (JSON) here")
+    ap.add_argument("--reservoir", type=int, default=8192,
+                    help="latency reservoir sample size")
+    ap.add_argument("--inject-fault", default="", metavar="POINT=ACTION",
+                    help="arm one UNDECLARED fail point on the first node "
+                         "at load start (e.g. audit.digest=return() to "
+                         "corrupt that node's audit digests) — the "
+                         "self-falsification knob: the run must exit 1 "
+                         "with the failure named in the journal, proving "
+                         "the harness can actually catch what it claims "
+                         "to check (requires --scenario)")
     ap.add_argument("--no-audit", action="store_true",
-                    help="skip the mid-run decree-anchored consistency "
-                         "audit (on by default; a digest mismatch fails "
-                         "the run like a verify failure)")
-    args = ap.parse_args()
+                    help="legacy alias for --audit-every 0")
+    return ap.parse_args(argv)
 
+
+def _build_harness(args, journal):
+    """-> (box, dst_box, actors, scenario) for --scenario runs. The
+    source onebox serves through partition-group executors (so the
+    group-kill leg is a real process kill); the full scenario adds a
+    second onebox cluster as the duplication target."""
+    from pegasus_tpu.chaos import actors as act
+    from pegasus_tpu.chaos import scenario as sc
+    from pegasus_tpu.collector.cluster_doctor import ClusterCaller
+    from pegasus_tpu.meta import messages as mm
+    from pegasus_tpu.meta.meta_server import RPC_CM_ADD_DUPLICATION
+
+    from tools._onebox import Onebox
+
+    box = dst = None
+    try:
+        if args.scenario == "full":
+            dst = Onebox(args.table, partitions=8, n_nodes=3, cluster_id=2)
+        box = Onebox(args.table, partitions=8, n_nodes=3, serve_groups=2,
+                     remote_clusters={"chaos-dst": [dst.meta_addr]} if dst
+                     else None, cluster_id=1)
+        if dst is not None:
+            r = box.cluster.ddl(RPC_CM_ADD_DUPLICATION,
+                                mm.AddDuplicationRequest(args.table,
+                                                         "chaos-dst"),
+                                mm.AddDuplicationResponse)
+            if r.error:
+                raise RuntimeError(f"add_dup failed: {r.error_text}")
+            journal.record("dup.added", dupid=r.dupid, remote=dst.meta_addr)
+    except BaseException:
+        # run_pressure's finally never sees these handles (the assignment
+        # from _build_harness did not happen) — stop them here or the
+        # half-built clusters' threads + tmpdirs outlive the run
+        for b in (box, dst):
+            if b is not None:
+                b.stop()
+        raise
+    caller = ClusterCaller([box.meta_addr])
+
+    def alive_nodes():
+        return act._alive_nodes(box.cluster, caller)
+
+    # ONE pooled caller shared by every actor: recovery polls run every
+    # 0.2 s, and per-poll connections would pile onto a recovering cluster
+    actors = {
+        sc.A_FAILPOINT: act.FailPointActor(caller, nodes_fn=alive_nodes),
+        sc.A_GROUP_KILL: act.GroupWorkerKill(box.cluster, node_index=0),
+        sc.A_NODE_KILL: act.NodeKillRestart(box.cluster, node_index=-1,
+                                            caller=caller),
+        sc.A_SPLIT: act.SplitActor(box.cluster, args.table, caller=caller),
+        sc.A_BALANCE: act.BalanceActor(box.cluster, args.table,
+                                       caller=caller),
+        sc.A_SCHED: act.SchedFlipActor(caller, box.cluster, args.table),
+    }
+    box.chaos_caller = caller   # closed with the box in the run's finally
+    box.alive_nodes = alive_nodes   # --inject-fault victim selection
+    return box, dst, actors, sc.SCENARIOS[args.scenario]()
+
+
+def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
+            written, written_lock, windows, journal):
     from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
 
-    from tools._onebox import resolve_cluster
+    rng = random.Random(tid)
+    cli = PegasusClient(MetaResolver([meta_addr], args.table), timeout=10)
+    per_thread_qps = args.qps / args.threads
+    interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
+    next_fire = time.time()
+    local = {"reads": 0, "writes": 0, "errors_in_window": 0,
+             "errors_steady": 0, "recovered_reads": 0,
+             "verify_failures": 0, "not_found": 0}
 
-    meta_addr, cluster = resolve_cluster(args.meta, args.table, 8)
+    def classify_error(t_err, what, detail=""):
+        """In-fault-window errors are DECLARED (bounded, allowed);
+        steady-state errors fail the run (ISSUE 11 satellite)."""
+        if windows is not None and windows.in_window(t_err):
+            local["errors_in_window"] += 1
+        else:
+            local["errors_steady"] += 1
+            journal.record("error.steady", op=what, thread=tid,
+                           detail=detail)
+
+    def timed(fn, *fargs):
+        """One client attempt with its latency sampled. Only FIRST
+        attempts go through here — reread()'s retry sleeps are harness
+        policy, not server latency, and would inflate p99 by orders of
+        magnitude under chaos. An errored attempt still records (its
+        duration is real server-observed time)."""
+        t0 = time.perf_counter()
+        try:
+            return fn(*fargs)
+        finally:
+            lat.add((time.perf_counter() - t0) * 1000)
+
+    def reread(hk, attempts=5, delay=0.2):
+        """-> (ok, value): retry a read past transient routing blips
+        before concluding anything about the key."""
+        for _ in range(attempts):
+            time.sleep(delay)
+            try:
+                return True, cli.get(hk, b"s")
+            except PegasusError:
+                continue
+        return False, None
+
+    while time.time() < stop_at:
+        now = time.time()
+        if interval and now < next_fire:
+            time.sleep(min(interval, next_fire - now))
+            continue
+        next_fire += interval
+        i = rng.randrange(args.key_space)
+        hk = b"pres%07d" % i
+        if rng.randrange(100) < args.read_pct:
+            # snapshot BEFORE the read: a write completing between
+            # the get and a later check would fake a lost write
+            with written_lock:
+                was_written = i in written
+            try:
+                v = timed(cli.get, hk, b"s")
+            except PegasusError as e:
+                # re-read-verify before counting anything: a failover
+                # blip is not a lost write. Only a read that keeps
+                # erroring counts as an error at the ORIGINAL instant.
+                t_err = journal.now()
+                ok, v = reread(hk)
+                if not ok:
+                    classify_error(t_err, "get", repr(e))
+                    continue
+                local["recovered_reads"] += 1
+            local["reads"] += 1
+            if v is None:
+                if was_written:
+                    # an acked write must be readable; re-read before
+                    # declaring it lost (routing may still be settling)
+                    ok, v2 = reread(hk, attempts=3, delay=0.3)
+                    if v2 == expected_value(hk):
+                        local["recovered_reads"] += 1
+                    else:
+                        local["verify_failures"] += 1
+                        journal.record("verify.lost", key=i, thread=tid)
+                else:
+                    local["not_found"] += 1
+            elif v != expected_value(hk):
+                local["verify_failures"] += 1
+                journal.record("verify.corrupt", key=i, thread=tid)
+        else:
+            try:
+                timed(cli.set, hk, b"s", expected_value(hk))
+            except PegasusError as e:
+                classify_error(journal.now(), "set", repr(e))
+                continue
+            with written_lock:
+                written.add(i)
+            local["writes"] += 1
+    cli.close()
+    with stats_lock:
+        for k, v in local.items():
+            stats[k] += v
+
+
+def run_pressure(argv=None) -> int:
+    """The whole run; returns the process exit code (importable for
+    tests — main() wraps it)."""
+    args = _parse_args(argv)
+    if args.no_audit:
+        args.audit_every = 0.0
+    if args.scenario != "none" and args.meta:
+        print("pressure_test: --scenario needs the self-booted onebox "
+              "(the fault actors hold cluster handles); drop --meta",
+              file=sys.stderr)
+        return 2
+    if args.inject_fault and args.scenario == "none":
+        print("pressure_test: --inject-fault requires --scenario "
+              "(it arms over the harness's remote-command caller)",
+              file=sys.stderr)
+        return 2
+
+    from pegasus_tpu.chaos.journal import EventJournal, FaultWindows
+    from pegasus_tpu.chaos.scenario import ScenarioRunner
+    from pegasus_tpu.collector.cluster_doctor import (
+        AuditRounds, run_cluster_doctor, run_cross_cluster_audit)
+
+    journal = EventJournal()
+    windows = FaultWindows(journal)
+    box = dst = runner = None
+    meta_addr = args.meta
     try:
+        if args.scenario != "none":
+            box, dst, actors, scenario = _build_harness(args, journal)
+            meta_addr = box.meta_addr
+            runner = ScenarioRunner(scenario, actors, journal,
+                                    windows=windows)
+        elif not args.meta:
+            from tools._onebox import Onebox
 
-        per_thread_qps = args.qps / args.threads
-        stop_at = time.time() + args.seconds
+            box = Onebox(args.table, partitions=8)
+            meta_addr = box.meta_addr
+
+        stats = {"reads": 0, "writes": 0, "errors_in_window": 0,
+                 "errors_steady": 0, "recovered_reads": 0,
+                 "verify_failures": 0, "not_found": 0}
         stats_lock = threading.Lock()
-        stats = {"reads": 0, "writes": 0, "errors": 0, "verify_failures": 0,
-                 "not_found": 0}
-        lat_ms = []
+        lat = LatencyReservoir(cap=args.reservoir)
         written = set()
         written_lock = threading.Lock()
 
-        def worker(tid):
-            rng = random.Random(tid)
-            cli = PegasusClient(MetaResolver([meta_addr], args.table), timeout=10)
-            interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
-            next_fire = time.time()
-            local = {k: 0 for k in stats}
-            local_lat = []
-            while time.time() < stop_at:
-                now = time.time()
-                if interval and now < next_fire:
-                    time.sleep(min(interval, next_fire - now))
-                    continue
-                next_fire += interval
-                i = rng.randrange(args.key_space)
-                hk = b"pres%07d" % i
-                t0 = time.perf_counter()
-                try:
-                    if rng.randrange(100) < args.read_pct:
-                        # snapshot BEFORE the read: a write completing between
-                        # the get and a later check would fake a lost write
-                        with written_lock:
-                            was_written = i in written
-                        v = cli.get(hk, b"s")
-                        local["reads"] += 1
-                        if v is None:
-                            if was_written:
-                                local["verify_failures"] += 1
-                            else:
-                                local["not_found"] += 1
-                        elif v != expected_value(hk):
-                            local["verify_failures"] += 1
-                    else:
-                        cli.set(hk, b"s", expected_value(hk))
-                        with written_lock:
-                            written.add(i)
-                        local["writes"] += 1
-                except PegasusError:
-                    local["errors"] += 1
-                local_lat.append((time.perf_counter() - t0) * 1000)
-            cli.close()
-            with stats_lock:
-                for k, v in local.items():
-                    stats[k] += v
-                lat_ms.extend(local_lat)
-
+        audits = None
+        if args.audit_every > 0:
+            audits = AuditRounds([meta_addr], apps=[args.table],
+                                 every_s=args.audit_every,
+                                 wait_s=min(5.0, args.audit_every),
+                                 journal=journal).start()
+        if args.inject_fault:
+            # UNDECLARED corruption on the first node — no fault window,
+            # no heal: the audits/classifier must catch it and fail the
+            # run, or the harness's green runs mean nothing
+            point, _, action = args.inject_fault.partition("=")
+            victim = box.alive_nodes()[0]
+            reply = box.chaos_caller.remote_command(victim, "set-fail-point",
+                                                    [point, action])
+            if not (reply or "").lstrip().startswith("{"):
+                # a rejected arming (bad name/action) would otherwise let
+                # the run pass its self-falsification check with NO fault
+                # planted — the journal would lie
+                print(f"pressure_test: --inject-fault rejected: {reply}",
+                      file=sys.stderr)
+                return 2
+            journal.record("fault.injected", point=point, action=action,
+                           node=victim, declared=False)
+        journal.record("load.start", qps=args.qps, seconds=args.seconds,
+                       threads=args.threads, read_pct=args.read_pct,
+                       scenario=args.scenario)
         t_start = time.time()
-        threads = [threading.Thread(target=worker, args=(t,))
-                   for t in range(args.threads)]
+        stop_at = t_start + args.seconds
+        if runner is not None:
+            runner.start(args.seconds)
+        from pegasus_tpu.runtime.tasking import spawn_thread
+
+        threads = [spawn_thread(
+            _worker, t, args, meta_addr, stop_at, stats, stats_lock, lat,
+            written, written_lock,
+            windows if args.scenario != "none" else None, journal,
+            name=f"pressure-{t}", start=False)
+            for t in range(args.threads)]
         for t in threads:
             t.start()
-        # consistency audit UNDER the load (ISSUE 8): partway through the
-        # run, every replica digests its state at the same applied decree;
-        # a mismatch fails the run exactly like a verify failure — the
-        # pass criterion the production-sim scenario builds on
-        audit = None
-        if not args.no_audit:
-            from pegasus_tpu.collector.cluster_doctor import \
-                run_cluster_audit
-
-            time.sleep(min(2.0, args.seconds / 2))
-            audit = run_cluster_audit([meta_addr], apps=[args.table],
-                                      wait_s=20.0)
-            audit.pop("digests", None)
         for t in threads:
             t.join()
         elapsed = time.time() - t_start
-        lat_ms.sort()
+        journal.record("load.done", elapsed_s=round(elapsed, 1))
+        if runner is not None:
+            # every armed fault heals + verifies recovery (may run past
+            # the load window); a wedged actor is bounded by its own
+            # recovery deadline, so the join is finite
+            runner.join(timeout=180)
+
+        # ---- conclusions: audit rounds (final quiesced round), the
+        # cross-cluster digest compare, the final doctor verdict
+        audit_summary = None
+        if audits is not None:
+            audit_summary = audits.stop(final_round=True)
+            if audit_summary["mismatches"]:
+                pass  # already journal.fail'd per mismatch by AuditRounds
+            elif audit_summary["conclusive"] == 0:
+                journal.fail("audit.vacuous",
+                             detail="zero conclusive audit rounds — zero "
+                                    "mismatches proves nothing",
+                             rounds=audit_summary["rounds"])
+        xcluster = None
+        if dst is not None:
+            # retry while INCONCLUSIVE (match=None) only: right after the
+            # node-kill leg a replica can still be mid-learn, which makes
+            # a single audit attempt vacuous (not wrong) — writes are
+            # quiesced, so waiting out the learn and re-auditing is
+            # sound. A real mismatch (match=False) is never retried.
+            for attempt in range(3):
+                xcluster = run_cross_cluster_audit(
+                    [meta_addr], [dst.meta_addr], args.table)
+                if xcluster["match"] is not None:
+                    break
+                journal.record("cross_cluster.retry", attempt=attempt,
+                               inconclusive=xcluster["inconclusive"])
+                time.sleep(5.0)
+            journal.record("cross_cluster.audit", match=xcluster["match"],
+                           src=xcluster["src"], dst=xcluster["dst"],
+                           anchors=xcluster["anchors"])
+            if xcluster["match"] is not True:
+                journal.fail("cross_cluster.digest",
+                             match=xcluster["match"],
+                             inconclusive=xcluster["inconclusive"],
+                             mismatches=xcluster["mismatches"])
+        doctor = None
+        if args.scenario != "none":
+            doctor = run_cluster_doctor([meta_addr])
+            journal.record("doctor.final", verdict=doctor["verdict"],
+                           causes=[c["cause"] for c in doctor["causes"]])
+            if doctor["verdict"] != "healthy":
+                journal.fail("doctor.unhealthy", verdict=doctor["verdict"],
+                             causes=[c["cause"] for c in doctor["causes"]])
+
+        if stats["verify_failures"]:
+            journal.fail("verify.lost_acked_writes",
+                         count=stats["verify_failures"])
+        if stats["errors_steady"]:
+            journal.fail("errors.steady_state",
+                         count=stats["errors_steady"],
+                         detail="errors outside any declared fault window")
+
         total_ops = stats["reads"] + stats["writes"]
-
-        def pct(p):
-            return round(lat_ms[min(len(lat_ms) - 1,
-                                    int(len(lat_ms) * p))], 2) if lat_ms else 0
-
+        failures = journal.failures
+        detail = {**stats, "elapsed_s": round(elapsed, 1),
+                  "avg_ms": lat.avg(), "p95_ms": lat.percentile(0.95),
+                  "p99_ms": lat.percentile(0.99),
+                  "lat_sampled": min(lat.count, lat.cap),
+                  "audit_rounds": audit_summary,
+                  "fault_windows": windows.bounds(),
+                  "failures": [f["failure"] for f in failures]}
+        if xcluster is not None:
+            detail["cross_cluster"] = {
+                k: xcluster[k] for k in ("match", "src", "dst", "dupid")
+                if k in xcluster}
+        if doctor is not None:
+            detail["doctor"] = doctor["verdict"]
         print(json.dumps({
             "metric": f"pressure test achieved qps (target {args.qps}, "
-                      f"{args.read_pct}% reads, {args.threads} threads)",
+                      f"{args.read_pct}% reads, {args.threads} threads, "
+                      f"scenario {args.scenario})",
             "value": round(total_ops / elapsed, 1),
             "unit": "ops/s",
-            "detail": {**stats, "elapsed_s": round(elapsed, 1),
-                       "avg_ms": round(sum(lat_ms) / max(1, len(lat_ms)), 2),
-                       "p95_ms": pct(0.95), "p99_ms": pct(0.99),
-                       "audit": audit},
+            "detail": detail,
         }), flush=True)
-
+        if args.journal:
+            journal.write(args.journal)
+        for f in failures:
+            print(f"pressure_test: FAILED: {f['failure']}: "
+                  f"{ {k: v for k, v in f.items() if k not in ('kind', 'failure')} }",
+                  file=sys.stderr)
+        return 1 if failures else 0
     finally:
-        if cluster is not None:
-            cluster.stop()
-    audit_failed = bool(audit and audit.get("mismatches"))
-    if audit_failed:
-        print(f"pressure_test: consistency audit FAILED: "
-              f"{audit['mismatches']}", file=sys.stderr)
-    elif audit is not None and len(audit.get("ok", [])) \
-            != audit.get("partitions", 0):
-        # zero mismatches without full coverage is not a pass — say so
-        # (only a real mismatch fails the run, per the audit contract)
-        print("pressure_test: consistency audit inconclusive for "
-              f"{audit.get('partitions', 0) - len(audit.get('ok', []))} "
-              "partition(s) — zero mismatches is vacuous",
-              file=sys.stderr)
-    sys.exit(1 if stats["verify_failures"] or stats["errors"]
-             or audit_failed else 0)
+        if runner is not None:
+            runner.stop()
+        for b in (box, dst):
+            if b is not None:
+                if getattr(b, "chaos_caller", None) is not None:
+                    b.chaos_caller.close()
+                b.stop()
+
+
+def main():
+    sys.exit(run_pressure())
 
 
 if __name__ == "__main__":
