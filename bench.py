@@ -833,6 +833,22 @@ def ycsb_main():
             print(f"ycsb: consistency audit INCONCLUSIVE — zero "
                   f"mismatches is vacuous here: {audit}",
                   file=sys.stderr, flush=True)
+        if audit.get("mismatches"):
+            # flight recorder (ISSUE 12): capture the cluster's recorded
+            # past NOW, while the onebox still serves — the degraded
+            # line below references the artifact instead of asking for a
+            # re-reproduction
+            try:
+                from pegasus_tpu.collector.flight_recorder import RECORDER
+
+                inc = RECORDER.capture(
+                    [box.meta_addr],
+                    reason=f"ycsb audit mismatch x{len(audit['mismatches'])}",
+                    trigger="bench")
+                audit["incident"] = {"id": inc["id"], "path": inc["path"]}
+            except Exception as e:  # capture must not mask the mismatch
+                print(f"ycsb: incident capture failed: {e!r}",
+                      file=sys.stderr, flush=True)
 
         # ---- attribution: server-side latency percentiles per op class
         # (max across partitions, the collector's merge rule), the plog

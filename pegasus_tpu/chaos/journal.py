@@ -26,6 +26,12 @@ class EventJournal:
         self._lock = lockrank.named_lock("chaos.journal")
         self._events = []    #: guarded_by self._lock
         self._failures = []  #: guarded_by self._lock
+        # optional flight-recorder hook (ISSUE 12): called with the
+        # failure event AFTER it is journaled — pressure_test wires an
+        # incident capture here so the cluster's recorded past is pulled
+        # AT failure time, not after teardown erased it. Set before the
+        # run starts; never called under the journal lock.
+        self.on_fail = None
 
     def now(self) -> float:
         return time.monotonic() - self.t0
@@ -45,6 +51,12 @@ class EventJournal:
         ev = self.record("failure", failure=name, **fields)
         with self._lock:
             self._failures.append(ev)
+        if self.on_fail is not None:
+            try:
+                self.on_fail(ev)
+            except Exception as e:  # noqa: BLE001 - evidence capture must
+                # never turn one named failure into two
+                self.record("incident.capture_error", error=repr(e))
         return ev
 
     @property

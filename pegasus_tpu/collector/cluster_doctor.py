@@ -37,6 +37,7 @@ from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_QUERY_CLUSTER_STATE
 from ..rpc import codec
 from ..rpc.transport import ConnectionPool, RpcError
+from ..runtime import events
 from ..runtime.perf_counters import counters
 from ..runtime.remote_command import (RemoteCommandRequest,
                                       RemoteCommandResponse)
@@ -183,6 +184,8 @@ def _audit_partition(caller, report, app_name, app_id, pc, wait_s, now=None):
                 {"app": app_name, "app_id": app_id, "pidx": pc["pidx"],
                  "gpid": gpid, "node": node, "decree": decree,
                  "digest": got["digest"], "expected": expected})
+            events.emit("audit.mismatch", severity="error", gpid=gpid,
+                        node=node, decree=decree)
             clean = False
     if clean:
         report["ok"].append(gpid)
@@ -504,20 +507,39 @@ def run_cluster_doctor(meta_addrs, pool: ConnectionPool = None,
         _check_audit(state, causes, evidence)
         if scrape:
             _scrape_nodes(caller, state, causes, evidence, slow_last)
+        verdict = CRITICAL if any(c["severity"] == CRITICAL
+                                  for c in causes) \
+            else DEGRADED if causes else HEALTHY
+        out = {"verdict": verdict, "causes": causes, "evidence": evidence,
+               "ts": time.time()}
+        _export_verdict(out)
+        # flight recorder (ISSUE 12): a healthy->degraded/critical
+        # transition auto-captures an incident artifact; the id rides the
+        # verdict so every doctor surface (HTTP, remote command, shell,
+        # bench, pressure_test) can point at the evidence bundle
+        try:
+            from .flight_recorder import RECORDER
+
+            incident = RECORDER.observe_verdict(out, list(meta_addrs),
+                                                caller=caller)
+            if incident:
+                out["incident"] = incident
+        except Exception as e:  # noqa: BLE001 - capture is best-effort;
+            # the verdict must never fail because evidence gathering did
+            print(f"[doctor] incident capture failed: {e!r}", flush=True)
+        return out
     finally:
         if own:
             caller.close()
-    verdict = CRITICAL if any(c["severity"] == CRITICAL for c in causes) \
-        else DEGRADED if causes else HEALTHY
-    out = {"verdict": verdict, "causes": causes, "evidence": evidence,
-           "ts": time.time()}
-    _export_verdict(out)
-    return out
 
 
 def _export_verdict(out: dict) -> None:
     counters.rate("doctor.run_count").increment()
     counters.number("doctor.verdict").set(_VERDICT_GAUGE[out["verdict"]])
+    events.emit("doctor.verdict",
+                severity={CRITICAL: "error", DEGRADED: "warn"}.get(
+                    out["verdict"], "info"),
+                verdict=out["verdict"], causes=len(out.get("causes", ())))
 
 
 def _check_nodes(state, causes, evidence) -> None:

@@ -22,7 +22,7 @@ from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_LIST_APPS, RPC_CM_QUERY_CONFIG
 from ..rpc import codec
 from ..rpc.transport import ConnectionPool, RpcError
-from ..runtime import lockrank
+from ..runtime import events, lockrank
 from ..runtime.perf_counters import counters
 from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
 from ..runtime.tasking import spawn_thread
@@ -34,12 +34,14 @@ def rollup_slow_requests(fetch, nodes, last: int = 20) -> list:
     of traces with full span breakdowns; a partition-group router already
     concatenates its workers' lists through the structural fan-out merge)
     into ONE worst-first top-N, each trace tagged with the node it came
-    from. `fetch(node) -> str` is the transport (remote command); nodes
-    that fail to answer are skipped — a rollup must degrade, not raise."""
+    from. `fetch(node)` is the transport (remote command) — it may return
+    the raw JSON text or an already-parsed list; nodes that fail to
+    answer are skipped — a rollup must degrade, not raise."""
     merged = []
     for node in nodes:
         try:
-            traces = json.loads(fetch(node))
+            raw = fetch(node)
+            traces = json.loads(raw) if isinstance(raw, str) else raw
         except (RpcError, OSError, ValueError):
             continue
         if not isinstance(traces, list):
@@ -93,6 +95,16 @@ class InfoCollector:
         # worst-offender summary the doctor reads
         self.cluster_slow_requests = []
         self.lag_stats = {}
+        # scrape robustness (ISSUE 12 satellite): a node dying
+        # mid-collect_once must COUNT, not silently vanish from the
+        # round's aggregates — the counter + event make a blind round
+        # distinguishable from a quiet one
+        self._c_scrape_err = counters.rate("collector.scrape.error_count")
+
+    def _scrape_failed(self, node: str, what: str, err) -> None:
+        self._c_scrape_err.increment()
+        events.emit("collector.scrape_failed", severity="warn", node=node,
+                    what=what, error=repr(err)[:200])
 
     def start(self):
         self._thread.start()
@@ -147,7 +159,8 @@ class InfoCollector:
             for prefix in ("compact.", "engine."):
                 try:
                     snap = self.scrape_node(node, prefix=prefix)
-                except (RpcError, OSError, ValueError):
+                except (RpcError, OSError, ValueError) as e:
+                    self._scrape_failed(node, f"perf-counters:{prefix}", e)
                     continue
                 for name, v in snap.items():
                     if isinstance(v, dict):
@@ -200,7 +213,8 @@ class InfoCollector:
                 snap = json.loads(self.remote_command(
                     node, "perf-counters-by-prefix",
                     ["replica.", "dup.lag."]))
-            except (RpcError, OSError, ValueError):
+            except (RpcError, OSError, ValueError) as e:
+                self._scrape_failed(node, "perf-counters:replica", e)
                 continue
             committed, applied = {}, {}
             for name, v in snap.items():
@@ -233,9 +247,19 @@ class InfoCollector:
         """Cluster-wide top-N slow requests (the node-local ledger merged
         worst-first; see rollup_slow_requests). Republishes the count as
         collector.cluster.slow_request_count."""
+        def fetch(n):
+            try:
+                # parse here (rollup accepts the parsed list): a
+                # truncated/garbage reply (node died mid-answer) must
+                # COUNT like a refused connection does
+                return json.loads(
+                    self.remote_command(n, "slow-requests", [str(last)]))
+            except (RpcError, OSError, ValueError) as e:
+                self._scrape_failed(n, "slow-requests", e)
+                raise  # rollup_slow_requests skips the node either way
+
         self.cluster_slow_requests = rollup_slow_requests(
-            lambda n: self.remote_command(n, "slow-requests", [str(last)]),
-            sorted(nodes), last=last)
+            fetch, sorted(nodes), last=last)
         counters.number("collector.cluster.slow_request_count").set(
             len(self.cluster_slow_requests))
         return self.cluster_slow_requests
@@ -265,7 +289,8 @@ class InfoCollector:
             for node in nodes:
                 try:
                     snap = self.scrape_node(node, prefix=f"app.{app.app_id}.")
-                except (RpcError, OSError, ValueError):
+                except (RpcError, OSError, ValueError) as e:
+                    self._scrape_failed(node, f"perf-counters:app.{app.app_id}", e)
                     continue
                 for name, v in snap.items():
                     if isinstance(v, dict):  # percentile counters: not qps

@@ -62,14 +62,16 @@ class CounterReporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path.startswith("/metrics"):
+                # exact routes FIRST: the /metrics prefix fallback must
+                # not shadow a mounted subpath (/metrics/history)
+                fn = routes.get(self.path.split("?")[0])
+                if fn is None and self.path.startswith("/metrics"):
                     body = prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.startswith("/counters"):
+                elif fn is None and self.path.startswith("/counters"):
                     body = json.dumps(counters.snapshot(), indent=1).encode()
                     ctype = "application/json"
                 else:
-                    fn = routes.get(self.path.split("?")[0])
                     if fn is None:
                         self.send_response(404)
                         self.end_headers()

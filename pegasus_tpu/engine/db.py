@@ -48,7 +48,7 @@ from ..base.key_schema import key_hash
 from ..base.utils import epoch_now
 from ..base.value_schema import check_if_ts_expired
 from ..runtime.fail_points import fail_point
-from ..runtime import lockrank
+from ..runtime import events, lockrank
 from ..ops.compact import CompactOptions, compact_blocks, sort_block
 from .block import KVBlock
 from .memtable import Memtable
@@ -213,9 +213,13 @@ class _SchedGate:
         """Install a cap lease (ttl_s default PEGASUS_SCHED_TTL_S —
         every set expires; only the env default is permanent)."""
         with self._lock:
+            changed = self._max != max(0, int(n))
             self._max = max(0, int(n))
             self._max_expire = time.monotonic() + (
                 self._ttl_default if ttl_s is None else float(ttl_s))
+            cap = self._max
+        if changed:
+            events.emit("sched.device_cap", cap=cap)
 
     def _max_locked(self) -> int:  #: requires self._lock
         if self._max_expire is not None \
@@ -978,21 +982,36 @@ class LsmEngine:
         if policy not in ("defer", "normal", "urgent"):
             raise ValueError(f"bad compaction policy {policy!r}")
         with self._lock:
+            changed = self._sched_policy != policy
             self._sched_policy = policy
             self._sched_reasons = tuple(reasons)
             self._sched_expire = time.monotonic() + (
                 self._sched_ttl_s if ttl_s is None else float(ttl_s))
+        if changed:
+            # transitions only: steady-state re-deliveries every tick
+            # would be ring noise, a defer->urgent flip is the story
+            events.emit("sched.token_apply", policy=policy,
+                        reasons=",".join(reasons), engine=self.path)
 
     def compact_policy(self) -> tuple:
         """-> (policy, reasons, expires_in_s); an expired token reads —
         and resets — as ('normal', [], 0.0)."""
+        expired = None
         with self._lock:
             now = time.monotonic()
             if self._sched_policy != "normal" and now >= self._sched_expire:
+                expired = self._sched_policy
                 self._sched_policy, self._sched_reasons = "normal", ()
-            return (self._sched_policy, list(self._sched_reasons),
-                    max(0.0, self._sched_expire - now)
-                    if self._sched_policy != "normal" else 0.0)
+            out = (self._sched_policy, list(self._sched_reasons),
+                   max(0.0, self._sched_expire - now)
+                   if self._sched_policy != "normal" else 0.0)
+        if expired is not None:
+            # a lease running out (vs being replaced) means the scheduler
+            # stopped delivering — exactly the kind of transient the
+            # flight recorder exists to keep
+            events.emit("sched.token_expired", severity="warn",
+                        was=expired, engine=self.path)
+        return out
 
     def compact_policy_fast(self) -> str:
         """Lock-free policy peek for the per-write admission path (the

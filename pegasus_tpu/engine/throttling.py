@@ -144,6 +144,11 @@ class DebtThrottle:
         self._c_reject = counters.rate("engine.throttle.debt_reject_count")
         self._c_delay_ms = counters.percentile(
             "engine.throttle.debt_delay_ms")
+        # flight-recorder edge detection: ONE event per engage/disengage
+        # transition, not one per delayed write. Deliberately lock-free
+        # (this sits on the per-write admission path); a race can at
+        # worst duplicate a transition event, never lose a delay.
+        self._engaged = False
 
     # a DEFER token means the scheduler is deliberately accumulating
     # this debt (a read-hot partition holding its compaction): charging
@@ -167,7 +172,18 @@ class DebtThrottle:
                 and self.engine.compact_policy_fast() == "defer":
             soft = max(soft, self.DEFER_SOFT)
         if ratio < soft:
+            if self._engaged:
+                self._engaged = False
+                from ..runtime import events
+
+                events.emit("throttle.disengage", ratio=round(ratio, 3))
             return
+        if not self._engaged:
+            self._engaged = True
+            from ..runtime import events
+
+            events.emit("throttle.engage", severity="warn",
+                        ratio=round(ratio, 3))
         if self.reject_ratio and ratio >= self.reject_ratio:
             self.rejected_count += 1
             self._c_reject.increment()

@@ -407,6 +407,13 @@ class WriteService:
         counters.rate("audit.trigger_count").increment()
         counters.percentile("audit.digest_us").set(
             int((_time.perf_counter() - t0) * 1e6))
+        # flight-recorder timeline: the audit landing on THIS replica at
+        # THIS decree is what the incident correlator orders against the
+        # breaker/fail-point events around it
+        from ..runtime import events
+
+        events.emit("audit.applied", gpid=f"{self.app_id}.{self.pidx}",
+                    decree=decree, node=self.server)
         self.last_audit = {"audit_id": req.audit_id, "decree": decree,
                            "digest": digest, "records": dig["records"],
                            "now": dig["now"], "ts": _time.time()}

@@ -188,6 +188,9 @@ class MetaElection:
         except Exception:  # noqa: BLE001 - an unreadable floor must not
             floor = 0  # block election; the persist-side fence still holds
         epoch = max(lease_epoch, floor) + 1
+        from ..runtime import events
+
+        events.emit("meta.epoch_bump", meta=self.my_addr, epoch=epoch)
         self._write_lease(epoch)
         # settle-and-reread: concurrent claimants all replaced the file;
         # exactly one write landed last. Everyone re-reads after a settle
@@ -203,6 +206,10 @@ class MetaElection:
         if value == self._leader:
             return
         self._leader = value
+        from ..runtime import events
+
+        events.emit("meta.election", severity="warn", meta=self.my_addr,
+                    leader=value, epoch=self.epoch)
         cb = self.on_acquire if value else self.on_demote
         if cb is not None:
             try:

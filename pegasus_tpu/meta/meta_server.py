@@ -390,6 +390,11 @@ class MetaServer:
             parents = list(parts[:old_n])
             self._persist_locked()
         n = old_n
+        from ..runtime import events
+
+        events.emit("split.phase", severity="warn",
+                    phase="resume" if pending is not None else "start",
+                    app=req.app_name, old_n=old_n, new_n=2 * old_n)
         # Phase 1: parents learn the NEW partition count FIRST, so any write
         # still routed with the old count but belonging to a child half is
         # rejected from here on (client re-resolves). Writes accepted before
@@ -432,6 +437,9 @@ class MetaServer:
                                       ignore_errors=True) is None:
                     seeded = False
         if not seeded:
+            events.emit("split.phase", severity="error",
+                        phase="seed_incomplete", app=req.app_name,
+                        new_n=2 * n)
             return codec.encode(mm.SplitAppResponse(
                 error=1, new_partition_count=2 * n,
                 error_text="child seeding incomplete; GC mask withheld — "
@@ -447,6 +455,8 @@ class MetaServer:
             self._persist_locked()
         for pc in all_parts:
             self._install_partition(app, pc)
+        events.emit("split.phase", phase="complete", app=req.app_name,
+                    new_n=2 * n)
         return codec.encode(mm.SplitAppResponse(new_partition_count=2 * n))
 
     def _on_backup_app(self, header, body) -> bytes:

@@ -513,13 +513,23 @@ class Replica:
         replay private log, SURVEY §3.5). `primary` is anything exposing
         fetch_learn_state() — a local Replica or an RPC peer proxy (the
         NFS-like learn file copy of config.ini:64-73)."""
+        from ..runtime import events
+
         learning = counters.number(
             f"replica.{self.app_id}.{self.pidx}.learning")
         learning.set(1)
+        events.emit("learn.start", gpid=f"{self.app_id}.{self.pidx}")
+        t0 = time.monotonic()
+        ok = False
         try:
             self._learn_from(primary)
+            ok = True
         finally:
             learning.set(0)
+            events.emit("learn.finish", severity="info" if ok else "error",
+                        gpid=f"{self.app_id}.{self.pidx}", ok=ok,
+                        dur_s=round(time.monotonic() - t0, 3),
+                        committed=self.last_committed)  #: unguarded_ok post-learn snapshot for the event record; _learn_from already released the lock and the value only moves forward
             self._export_gauges()
 
     def _learn_from(self, primary):

@@ -206,6 +206,13 @@ class ReplicaStub:
             self.send_beacon()
             self._beacon_thread.start()
         self._maint_thread.start()
+        # flight recorder (ISSUE 12): every serving process samples its
+        # counter registry into the history ring (refcounted process-wide
+        # sampler — group workers are their own processes and get their
+        # own, exactly like their own registry)
+        from ..runtime.metric_history import HISTORY
+
+        HISTORY.start()
         return self
 
     # --------------------------------------------- group-executor plumbing
@@ -448,6 +455,13 @@ class ReplicaStub:
             else:
                 peer = _RemotePeer(self, req.learn_from, req.app_id, learn_pidx)
             if peer is not None:
+                if need_seed:
+                    from ..runtime import events
+
+                    events.emit("split.seed_start",
+                                gpid=f"{req.app_id}.{req.pidx}",
+                                parent=f"{req.app_id}.{learn_pidx}",
+                                source=req.learn_from)
                 rep.learn_from(peer)
                 with self._lock:
                     if cross_learn:
@@ -456,6 +470,12 @@ class ReplicaStub:
                         rep.split_seeded = True
                     self._service.remove_replica(req.app_id, req.pidx)
                     self._service.add_replica(rep.server, req.partition_count)
+                if need_seed:
+                    from ..runtime import events
+
+                    events.emit("split.seeded",
+                                gpid=f"{req.app_id}.{req.pidx}",
+                                committed=rep.last_committed)
             elif need_seed:
                 # no resolvable seed source (the in-process parent is gone,
                 # e.g. mid-restart): replying success here would let the
@@ -1051,6 +1071,13 @@ class ReplicaStub:
     # -------------------------------------------------------------- control
 
     def stop(self):
+        if not self._stop.is_set():
+            # drop the refcounted sampler ref ONCE: a chaos node-kill plus
+            # the harness teardown both call stop(), and a double drop
+            # would stop the sampler out from under the surviving stubs
+            from ..runtime.metric_history import HISTORY
+
+            HISTORY.stop()
         self._stop.set()
         if getattr(self, "_adoption_srv", None) is not None:
             try:

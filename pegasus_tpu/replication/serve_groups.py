@@ -221,6 +221,9 @@ class GroupedReplicaNode:
         for target in (self._accept_loop, self._beacon_loop):
             self._threads.append(spawn_thread(target, daemon=True))
         self.send_beacon()
+        from ..runtime.metric_history import HISTORY
+
+        HISTORY.start()   # the router's own serve.group.* series
         return self
 
     def _spawn_checked(self, g: int):
@@ -278,6 +281,13 @@ class GroupedReplicaNode:
         w.ctrl_ok = True
 
     def stop(self):
+        if not self._stop.is_set():
+            # once only: a chaos kill + teardown both stop the node, and
+            # a double drop of the refcounted sampler ref would stop it
+            # out from under every other live stub in this process
+            from ..runtime.metric_history import HISTORY
+
+            HISTORY.stop()
         self._stop.set()
         try:
             self._listener.close()
@@ -342,6 +352,9 @@ class GroupedReplicaNode:
         self._spawn(g)
         self._c_restart.increment()
         self._c_active.set(sum(x.alive for x in self._workers))
+        from ..runtime import events
+
+        events.emit("serve_group.worker_restart", severity="warn", group=g)
         with self._lock:
             cached = [(k, v) for k, v in self._open_cache.items()
                       if group_of(k[0], k[1], self.groups) == g]
@@ -430,6 +443,10 @@ class GroupedReplicaNode:
             # this group but KEEP it alive — relay still serves it, and a
             # transient send failure must not take the whole group down
             w.ctrl_ok = False
+            from ..runtime import events
+
+            events.emit("serve_group.handoff_degraded", severity="error",
+                        group=w.g, error=repr(e)[:200])
             print(f"[serve-groups] group {w.g} handoff channel degraded "
                   f"({e!r}); serving via relay until restart", flush=True)
             return False

@@ -1,0 +1,137 @@
+"""Structured event plane: the cluster flight recorder's ring (ISSUE 12).
+
+Every observability surface built so far answers "what is happening
+NOW" — counters are levels, traces sample the present, the doctor folds
+the current beacon state. Nothing records that a breaker TRIPPED two
+minutes ago and closed again, that a scheduler token expired, that a
+meta election flapped — the transient state *transitions* every real
+incident is reconstructed from. Those transitions used to live as
+scattered `print`s and ad-hoc counters; this module is the one bus they
+all emit into:
+
+    from ..runtime import events
+    events.emit("lane.breaker_trip", severity="error", lane="read.lane")
+
+Design constraints (this sits on hot paths — the lane guard, the write
+admission throttle):
+
+  * allocation-light: one tuple append into a preallocated ring under a
+    leaf lock; attrs are kept as the caller's kwargs dict (no copy, no
+    JSON until a dump is requested);
+  * bounded: `PEGASUS_EVENTS_CAP` entries (default 4096); every
+    overwrite of an occupied slot counts into ``events.drop_count``
+    (there is no per-reader ack — once the ring has wrapped, drop rate
+    tracks emit rate; compare the two to size the retained window);
+  * queryable by window: every entry carries a wall-clock ts and a
+    monotone per-process seq, so the flight recorder can align rings
+    from many processes on one anchor.
+
+Event NAMES are part of the repo's lint surface: every emit call site
+must use a literal name documented in README.md's "### Event table"
+(tools/analyze events pass, both directions — exactly the discipline
+the metric-name and remote-command tables already get).
+
+Surfaces: ``GET /events`` on any role's http_port, the ``events-dump``
+remote command (per-PID JSON, so the partition-group router's structural
+fan-out merge keeps every worker process's ring), and the shell's
+``events``.
+"""
+
+import os
+import threading
+import time
+
+from . import lockrank
+from .perf_counters import counters
+
+SEVERITIES = ("info", "warn", "error")
+
+
+class EventBus:
+    """Bounded process-wide ring of (seq, ts, name, severity, attrs)."""
+
+    def __init__(self, capacity: int = None):
+        self.capacity = capacity if capacity is not None else int(
+            os.environ.get("PEGASUS_EVENTS_CAP", "4096"))
+        self.capacity = max(1, self.capacity)
+        self._lock = lockrank.named_lock("events.ring")
+        # preallocated ring + write cursor: append cost is one slot store
+        self._ring = [None] * self.capacity  #: guarded_by self._lock
+        self._next = 0   # total events ever emitted  #: guarded_by self._lock
+        # counter objects resolved once (PR 6 registry-lock rule: emit()
+        # can run per-write under the admission throttle)
+        self._c_emit = counters.rate("events.emit_count")
+        self._c_drop = counters.rate("events.drop_count")
+
+    def emit(self, name: str, severity: str = "info", **attrs) -> None:
+        """Record one state transition. `attrs` must be JSON-serializable
+        scalars/short strings (they are dumped verbatim by the surfaces);
+        the kwargs dict is stored as-is — no copies on the hot path."""
+        ts = time.time()
+        with self._lock:
+            slot = self._next % self.capacity
+            dropped = self._ring[slot] is not None
+            self._ring[slot] = (self._next, ts, name, severity,
+                                attrs or None)
+            self._next += 1
+        self._c_emit.increment()
+        if dropped:
+            self._c_drop.increment()
+
+    # ------------------------------------------------------------- queries
+
+    def snapshot(self, last: int = None, since: float = None,
+                 prefix: str = None) -> list:
+        """JSON-ready event dicts, oldest first. `last` bounds the count
+        (applied AFTER the filters), `since` keeps events with ts >= it,
+        `prefix` filters on the event name."""
+        with self._lock:
+            n = self._next
+            if n <= self.capacity:
+                entries = [e for e in self._ring[:n]]
+            else:
+                cut = n % self.capacity
+                entries = self._ring[cut:] + self._ring[:cut]
+        out = []
+        for e in entries:
+            if e is None:
+                continue
+            seq, ts, name, severity, attrs = e
+            if since is not None and ts < since:
+                continue
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            ev = {"seq": seq, "ts": ts, "name": name, "sev": severity}
+            if attrs:
+                ev["attrs"] = dict(attrs)
+            out.append(ev)
+        if last is not None and last >= 0:
+            out = out[-last:] if last else []
+        return out
+
+    def emitted_total(self) -> int:
+        """Total events ever emitted (monotone; the ring holds the tail)."""
+        with self._lock:
+            return self._next
+
+    def reset(self) -> None:
+        """Test hook: empty the ring."""
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._next = 0
+
+
+# process-wide bus, like the counter registry and the tracers: every
+# subsystem's transitions land in ONE per-process timeline
+EVENTS = EventBus()
+
+
+def emit(name: str, severity: str = "info", **attrs) -> None:
+    """Module-level shorthand for EVENTS.emit — the canonical call-site
+    shape the events lint pass scans for (module-qualified, with the
+    name as a plain string literal)."""
+    EVENTS.emit(name, severity=severity, **attrs)
+
+
+def dump(last: int = None, since: float = None, prefix: str = None) -> list:
+    return EVENTS.snapshot(last=last, since=since, prefix=prefix)

@@ -78,6 +78,17 @@ class _FailPointRegistry:
             }
             self._active = sum(1 for p in self._points.values()
                                if p["verb"] != "off")
+        # flight-recorder timeline (ISSUE 12): an armed fault is the
+        # canonical first-cause candidate the incident correlator hunts
+        # for, so arm/heal transitions land in the event ring of the
+        # process the fault actually lives in
+        from . import events
+
+        if m.group("verb") == "off":
+            events.emit("failpoint.disarm", point=name)
+        else:
+            events.emit("failpoint.arm", severity="warn", point=name,
+                        action=action)
 
     def evaluate(self, name: str):
         """None = not triggered; otherwise the (verb, arg) tuple. Pure:

@@ -63,7 +63,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from . import lockrank
+from . import events, lockrank
 from .perf_counters import counters
 from .tracing import COMPACT_TRACER
 
@@ -238,6 +238,8 @@ class LaneGuard:
                     self._consec_failures = 0
                     self._breaker_open_until = 0.0
                 counters.number(self.metric_prefix + ".breaker_open").set(0)
+                events.emit("lane.breaker_close", lane=self.metric_prefix,
+                            via="half_open_probe")
                 return False
             with self._lock:
                 self._breaker_open_until = (time.monotonic()
@@ -270,6 +272,9 @@ class LaneGuard:
         if tripped:
             counters.rate(self.metric_prefix + ".breaker_trip_count").increment()
             counters.number(self.metric_prefix + ".breaker_open").set(1)
+            events.emit("lane.breaker_trip", severity="error",
+                        lane=self.metric_prefix, op=op,
+                        error=str(error)[:200], stage=stage)
 
     def record_device_ok(self) -> None:
         with self._lock:
@@ -278,6 +283,8 @@ class LaneGuard:
             self._breaker_open_until = 0.0
         if was_open:
             counters.number(self.metric_prefix + ".breaker_open").set(0)
+            events.emit("lane.breaker_close", lane=self.metric_prefix,
+                        via="clean_device_attempt")
 
     # ----------------------------------------------------------------- run
 
@@ -363,6 +370,8 @@ class LaneGuard:
             self.last_fallback = {"op": op, "reason": reason,
                                   "ts": time.time()}
         counters.rate(self.metric_prefix + ".fallback_count").increment()
+        events.emit("lane.fallback", severity="warn",
+                    lane=self.metric_prefix, op=op, reason=reason[:200])
         print(f"[lane-guard:{self.metric_prefix}] {op}: falling back to the "
               f"host path ({reason})", flush=True)
         return fallback_fn()

@@ -64,6 +64,23 @@ class RateCounter(Counter):
         self._window_start = time.monotonic()
         self._last_rate = 0.0
         self._rolled = False
+        self._total = 0
+
+    def increment(self, by: int = 1):
+        with self._lock:
+            self._value += by
+            self._total += by
+
+    def add(self, by):
+        self.increment(by)
+
+    def total(self) -> int:
+        """Monotone event count since process start. Unlike the raw
+        window accumulator, this never resets on a read — the stable
+        thing to assert on when any concurrent scraper (collector,
+        /metrics, the metric-history sampler) may roll the window."""
+        with self._lock:
+            return self._total
 
     def value(self):
         with self._lock:

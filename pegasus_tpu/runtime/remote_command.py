@@ -50,6 +50,8 @@ class RemoteCommandService:
                       lambda a: self._dump_counters(
                           lambda n: any(p in n for p in a)))
         self.register("set-fail-point", self._cmd_set_fail_point)
+        self.register("events-dump", self._cmd_events_dump)
+        self.register("metrics-history", self._cmd_metrics_history)
         self.register("compact-trace-dump", self._cmd_compact_trace_dump)
         self.register("device-health", self._cmd_device_health)
         self.register("request-trace-dump", self._cmd_request_trace_dump)
@@ -82,6 +84,38 @@ class RemoteCommandService:
         except ValueError as e:
             return str(e)   # "bad fail point action: ..."
         return json.dumps({f"pid:{os.getpid()}": f"{name}={action}"})
+
+    @staticmethod
+    def _cmd_events_dump(args) -> str:
+        """events-dump [last] [prefix] — this process's structured event
+        ring (runtime/events.py), the flight recorder's per-node source.
+        The reply is a JSON dict keyed by this process's pid, so a
+        partition-group router's structural fan-out merge keeps EVERY
+        worker process's ring side by side (disjoint keys survive the
+        merge — the same shape set-fail-point uses for its acks)."""
+        import os
+
+        from .events import EVENTS
+
+        last = int(args[0]) if args else None
+        prefix = args[1] if len(args) > 1 else None
+        return json.dumps({f"pid:{os.getpid()}":
+                           EVENTS.snapshot(last=last, prefix=prefix)})
+
+    @staticmethod
+    def _cmd_metrics_history(args) -> str:
+        """metrics-history [seconds] [prefix] — this process's metric
+        history window (runtime/metric_history.py): the sampled tail of
+        the selected counter series. Pid-keyed like events-dump so a
+        grouped node's router merge keeps each worker's ring."""
+        import os
+
+        from .metric_history import HISTORY
+
+        seconds = float(args[0]) if args else None
+        prefix = args[1] if len(args) > 1 else None
+        return json.dumps({f"pid:{os.getpid()}":
+                           HISTORY.window(seconds=seconds, prefix=prefix)})
 
     @staticmethod
     def _cmd_compact_trace_dump(args) -> str:

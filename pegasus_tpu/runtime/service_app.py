@@ -142,6 +142,60 @@ def _request_trace_route(path: str) -> dict:
             "slow_requests": REQUEST_TRACER.slow_requests(last)}
 
 
+def _events_route(path: str) -> dict:
+    """GET /events[?last=N][&prefix=p][&since=ts]: the process-wide
+    structured event ring (runtime/events.py) — the HTTP twin of the
+    `events-dump` remote command and the shell's `events`."""
+    from urllib.parse import parse_qs, urlparse
+
+    from .events import EVENTS
+
+    q = parse_qs(urlparse(path).query)
+
+    def _num(key, cast, default):
+        try:
+            return cast((q.get(key) or [""])[0])
+        except ValueError:
+            return default
+
+    return {"events": EVENTS.snapshot(
+        last=_num("last", int, None),
+        since=_num("since", float, None),
+        prefix=(q.get("prefix") or [None])[0])}
+
+
+def _metrics_history_route(path: str) -> dict:
+    """GET /metrics/history[?seconds=N][&prefix=p][&deltas=1]: the metric
+    history ring (runtime/metric_history.py) — the sampled tail of the
+    selected counter series, queryable by window."""
+    from urllib.parse import parse_qs, urlparse
+
+    from .metric_history import HISTORY
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        seconds = float((q.get("seconds") or [""])[0])
+    except ValueError:
+        seconds = None
+    return HISTORY.window(
+        seconds=seconds, prefix=(q.get("prefix") or [None])[0],
+        deltas=(q.get("deltas") or ["0"])[0] not in ("0", ""))
+
+
+def _incidents_route(path: str) -> dict:
+    """GET /incidents[?id=<incident>]: the flight recorder's retained
+    incident artifacts — the list, or one full artifact by id."""
+    from urllib.parse import parse_qs, urlparse
+
+    from ..collector.flight_recorder import RECORDER
+
+    q = parse_qs(urlparse(path).query)
+    incident_id = (q.get("id") or [""])[0]
+    if incident_id:
+        return {"incident": RECORDER.load(incident_id)}
+    return {"incidents": RECORDER.list_incidents()}
+
+
 def _health_cluster_route(meta_addrs):
     """GET /health/cluster[?scrape=0][&last=N]: the cluster doctor's ONE
     structured verdict (healthy|degraded|critical|inconclusive + named
@@ -205,7 +259,10 @@ def _meta_http_routes(meta) -> dict:
             "/meta/apps": apps,
             "/meta/app": app,
             "/compact/trace": _compact_trace_route,
-            "/requests/trace": _request_trace_route}
+            "/requests/trace": _request_trace_route,
+            "/events": _events_route,
+            "/metrics/history": _metrics_history_route,
+            "/incidents": _incidents_route}
 
 
 def _replica_http_routes(stub) -> dict:
@@ -224,7 +281,9 @@ def _replica_http_routes(stub) -> dict:
     return {"/version": lambda p: _version_info("replica"),
             "/replica/info": info,
             "/compact/trace": _compact_trace_route,
-            "/requests/trace": _request_trace_route}
+            "/requests/trace": _request_trace_route,
+            "/events": _events_route,
+            "/metrics/history": _metrics_history_route}
 
 
 # ---------------------------------------------------------- built-in apps
@@ -295,6 +354,10 @@ class MetaApp:
         if self.election is not None:
             self.election.start()
         self._schedule_fd()
+        from .metric_history import HISTORY
+
+        HISTORY.start()
+        self._history_ref = True
         return self
 
     def _is_leader(self) -> bool:
@@ -342,6 +405,13 @@ class MetaApp:
         self._policy_timer.start()
 
     def stop(self):
+        # refcounted sampler: drop OUR ref exactly once (a double stop,
+        # or stop-before-start, must not steal a sibling app's ref)
+        if getattr(self, "_history_ref", False):
+            self._history_ref = False
+            from .metric_history import HISTORY
+
+            HISTORY.stop()
         self._stopped = True
         if self._fd_timer:
             self._fd_timer.cancel()
@@ -539,8 +609,27 @@ class CollectorApp:
                 list(self.metas), pool=self.collector.pool,
                 apps=list(args) or None), indent=1)
 
+        def trigger_incident(args):
+            """trigger-incident [reason] — manually capture a flight-
+            recorder incident NOW: pull every alive node's event ring +
+            metric-history window + slow ledger + recent traces, align
+            them on one anchor, run the first-cause heuristic and retain
+            the artifact (served as GET /incidents + shell
+            flight_recorder)."""
+            from ..collector.flight_recorder import RECORDER
+
+            reason = " ".join(args) if args else "manual trigger"
+            inc = RECORDER.capture(list(self.metas), reason=reason,
+                                   trigger="manual",
+                                   pool=self.collector.pool)
+            return json.dumps({"incident": inc["id"],
+                               "path": inc.get("path", ""),
+                               "first_cause": inc.get("first_cause")},
+                              indent=1)
+
         self.commands.register("cluster-doctor", cluster_doctor)
         self.commands.register("trigger-audit", trigger_audit)
+        self.commands.register("trigger-incident", trigger_incident)
         self.rpc.register("RPC_CLI_CLI_CALL", self.commands.rpc_handler)
         http_port = config.get_int(section, "http_port", -1)
         self.reporter = None
@@ -551,6 +640,9 @@ class CollectorApp:
                 port=http_port,
                 routes={"/compact/trace": _compact_trace_route,
                         "/requests/trace": _request_trace_route,
+                        "/events": _events_route,
+                        "/metrics/history": _metrics_history_route,
+                        "/incidents": _incidents_route,
                         "/health/cluster":
                             _health_cluster_route(self.metas)}).start()
 
@@ -600,8 +692,11 @@ class CollectorApp:
     def start(self):
         self._stopping = False
         self.rpc.start()
+        from .metric_history import HISTORY
         from .tasking import spawn_thread
 
+        HISTORY.start()
+        self._history_ref = True
         spawn_thread(self._ensure_probe_table_loop, daemon=True)
         self.collector.start()
         if self.scheduler is not None:
@@ -611,6 +706,13 @@ class CollectorApp:
         return self
 
     def stop(self):
+        # refcounted sampler: drop OUR ref exactly once (a double stop,
+        # or stop-before-start, must not steal a sibling app's ref)
+        if getattr(self, "_history_ref", False):
+            self._history_ref = False
+            from .metric_history import HISTORY
+
+            HISTORY.stop()
         self._stopping = True
         if self.reporter:
             self.reporter.stop()

@@ -102,6 +102,16 @@ class Shell:
                               "slow_requests [node|--cluster] [last] — the "
                               "slow-request ledger; --cluster merges every "
                               "node's ledger into one worst-first top-N"),
+            "events": (self.cmd_events,
+                       "events [node] [last] [prefix] — the structured "
+                       "event ring (flight recorder): breaker trips, "
+                       "scheduler tokens, elections, splits, fail-point "
+                       "arms... per process, pid-keyed"),
+            "flight_recorder": (self.cmd_flight_recorder,
+                                "flight_recorder [list|show <id>|capture "
+                                "[reason]] — retained incident artifacts "
+                                "(auto-captured on doctor degradation / "
+                                "chaos failures) or a manual capture now"),
             "trigger_audit": (self.cmd_trigger_audit,
                               "trigger_audit [app] — decree-anchored "
                               "consistency audit: every replica digests its "
@@ -610,6 +620,36 @@ class Shell:
             self.p(self._node_command(args[0], "slow-requests", args[1:]))
         else:
             self.cmd_remote_command(["all", "slow-requests"])
+
+    def cmd_events(self, args):
+        if args:
+            self.p(self._node_command(args[0], "events-dump", args[1:]))
+        else:
+            self.cmd_remote_command(["all", "events-dump"])
+
+    def cmd_flight_recorder(self, args):
+        from ..collector.flight_recorder import RECORDER
+
+        sub = args[0] if args else "list"
+        if sub == "capture":
+            reason = " ".join(args[1:]) or "shell capture"
+            inc = RECORDER.capture(self.meta_addrs, reason=reason,
+                                   trigger="shell", pool=self.pool)
+            self.p(json.dumps({"id": inc["id"], "path": inc["path"],
+                               "first_cause": inc["first_cause"],
+                               "timeline_events": len(inc["timeline"]),
+                               "errors": inc["errors"]}, indent=1))
+        elif sub == "show" and len(args) > 1:
+            inc = RECORDER.load(args[1])
+            self.p(json.dumps(inc, indent=1) if inc
+                   else f"no retained incident {args[1]!r}")
+        else:
+            incidents = RECORDER.list_incidents()
+            if not incidents:
+                self.p("no retained incidents")
+            for i in incidents:
+                self.p(f"{i['id']}  trigger={i['trigger']} "
+                       f"first_cause={i['first_cause']}  {i['reason']}")
 
     def cmd_trigger_audit(self, args):
         from ..collector.cluster_doctor import run_cluster_audit

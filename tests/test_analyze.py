@@ -222,6 +222,66 @@ def test_env_knobs_ignores_docstring_mentions(tmp_path):
     assert "stale-row:PEGASUS_DOCUMENTED" in keys
 
 
+# ---------------------------------------------------------------- events
+
+EVENTS_OK = """
+    from pegasus_tpu.runtime import events
+
+    def trip():
+        events.emit("lane.breaker_trip", severity="error", lane="compact")
+"""
+
+EVENT_README = """
+    ### Event table
+
+    | event | severity | transition it records |
+    |---|---|---|
+    | `lane.breaker_trip` | error | a breaker opened |
+"""
+
+
+def test_events_pass_clean_twin(tmp_path):
+    repo = make_repo(tmp_path, {"m.py": EVENTS_OK}, readme=EVENT_README)
+    assert run_pass("events", repo) == []
+
+
+def test_events_pass_both_directions(tmp_path):
+    # an emit with no table row, and a table row with no emit
+    repo = make_repo(tmp_path, {"m.py": EVENTS_OK + """
+    def ghost():
+        events.emit("ghost.event", why="undocumented")
+    """}, readme=EVENT_README + """
+    | `stale.event` | info | deleted emitter, row kept |
+    """)
+    keys = {f.key for f in run_pass("events", repo)}
+    assert "undoc:ghost.event" in keys
+    assert "stale-row:stale.event" in keys
+    assert not any(k.startswith(("undoc:lane.", "stale-row:lane."))
+                   for k in keys)
+
+
+def test_events_pass_requires_table(tmp_path):
+    repo = make_repo(tmp_path, {"m.py": EVENTS_OK}, readme="# nothing")
+    assert [f.key for f in run_pass("events", repo)] == ["no-table"]
+
+
+def test_events_pass_flags_nonliteral_names(tmp_path):
+    """A dynamic event name is invisible to the lint and to anyone
+    grepping an incident artifact — flagged even if it happens to land
+    on a documented name at runtime."""
+    repo = make_repo(tmp_path, {"m.py": EVENTS_OK + """
+    def dynamic(kind):
+        events.emit(f"lane.{kind}", lane="compact")
+
+    def indirect(name):
+        events.emit(name, lane="compact")
+    """}, readme=EVENT_README)
+    nonlit = [f for f in run_pass("events", repo)
+              if f.key.startswith("nonliteral:")]
+    assert len(nonlit) == 2
+    assert all("plain string literal" in f.message for f in nonlit)
+
+
 # -------------------------------------------------------------- lockrank
 
 def _graph():
@@ -492,6 +552,6 @@ def test_repo_clean():
     lines = [f.render() for f in report.findings] + [
         f"STALE baseline: {p}:{k}" for p, k in report.stale_baseline]
     assert report.clean, "\n".join(lines)
-    assert set(report.ran) == {"env_knobs", "fail_points", "lock_discipline",
-                               "metric_names", "remote_commands",
-                               "thread_lifecycle"}
+    assert set(report.ran) == {"env_knobs", "events", "fail_points",
+                               "lock_discipline", "metric_names",
+                               "remote_commands", "thread_lifecycle"}

@@ -1,0 +1,413 @@
+"""Flight recorder (ISSUE 12): the recorded-past plane.
+
+  - event-ring units: ordering, wraparound + drop accounting, filters;
+  - metric-history units: per-kind sampling semantics (gauge level,
+    percentile -> p99), ring wraparound, window queries with the
+    pre-window-anchored delta derivation;
+  - incident units: offline capture (an unreachable cluster still
+    retains an artifact), retention pruning, and the doctor-transition /
+    cooldown semantics of observe_verdict;
+  - grouped-onebox `events-dump`: every worker pid's ring stays visible
+    through the router's structural fan-out merge;
+  - collector scrape robustness: a node dying mid-collect_once COUNTS
+    (`collector.scrape.error_count` + a `collector.scrape_failed`
+    event) instead of being silently skipped;
+  - e2e acceptance: `audit.digest` corruption planted under load — the
+    doctor's healthy→critical transition auto-captures ONE retained
+    incident whose first cause names the fault's arm event, with the
+    audit/doctor events ordered on one timeline.
+"""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from pegasus_tpu.collector.cluster_doctor import (run_cluster_audit,
+                                                  run_cluster_doctor)
+from pegasus_tpu.collector.flight_recorder import RECORDER, FlightRecorder
+from pegasus_tpu.collector.info_collector import InfoCollector
+from pegasus_tpu.rpc import codec
+from pegasus_tpu.rpc.transport import RpcConnection
+from pegasus_tpu.runtime import events
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.events import EventBus
+from pegasus_tpu.runtime.metric_history import MetricHistory
+from pegasus_tpu.runtime.perf_counters import counters
+from pegasus_tpu.runtime.remote_command import (RemoteCommandRequest,
+                                                RemoteCommandResponse)
+
+from tests.test_cluster_doctor import (_Load, _partition_members,
+                                       _quiet_breakers)
+from tests.test_satellites import MiniCluster
+
+# meta addr nobody listens on: capture must degrade, never raise
+UNREACHABLE = ["127.0.0.1:1"]
+
+
+class _Cnt:
+    """Counter stand-in: records increments without the process-global
+    registry (whose rate windows other tests / the live sampler roll)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def increment(self, by=1):
+        self.n += by
+
+
+# ------------------------------------------------------------- event ring
+
+
+def test_event_ring_wraparound_keeps_newest():
+    bus = EventBus(capacity=8)
+    for i in range(20):
+        bus.emit("unit.test", i=i)
+    evs = bus.snapshot()
+    assert len(evs) == 8, "ring must stay bounded at capacity"
+    assert [e["seq"] for e in evs] == list(range(12, 20)), \
+        "oldest first, newest retained"
+    assert [e["attrs"]["i"] for e in evs] == list(range(12, 20))
+    assert bus.emitted_total() == 20
+
+
+def test_event_ring_drop_accounting():
+    bus = EventBus(capacity=4)
+    bus._c_drop = drops = _Cnt()
+    for _ in range(4):
+        bus.emit("unit.test")
+    assert drops.n == 0, "filling an empty ring drops nothing"
+    for _ in range(3):
+        bus.emit("unit.test")
+    assert drops.n == 3, "every wrapped slot counts"
+
+
+def test_event_snapshot_filters():
+    bus = EventBus(capacity=16)
+    t0 = time.time()
+    bus.emit("a.one")
+    bus.emit("a.two", severity="warn")
+    bus.emit("b.three")
+    assert [e["name"] for e in bus.snapshot(prefix="a.")] == \
+        ["a.one", "a.two"]
+    assert bus.snapshot(prefix="a.")[1]["sev"] == "warn"
+    assert [e["name"] for e in bus.snapshot(last=1)] == ["b.three"]
+    assert bus.snapshot(since=time.time() + 10) == []
+    assert len(bus.snapshot(since=t0 - 1)) == 3
+    # `last` applies AFTER the filters: the newest MATCHING event
+    assert [e["name"] for e in bus.snapshot(last=1, prefix="a.")] == \
+        ["a.two"]
+
+
+# --------------------------------------------------------- metric history
+
+
+def test_history_sampling_kinds_and_wraparound():
+    g = counters.number("frtest.gauge")
+    p = counters.percentile("frtest.lat_ms")
+    try:
+        h = MetricHistory(interval_s=5, capacity=4, prefixes=("frtest.",))
+        for i, t in enumerate([0, 10, 20, 30, 40, 50]):
+            g.set(i * 10)
+            p.set(100 + i)
+            h.sample_once(now=t)
+        w = h.window()
+        assert w["interval_s"] == 5 and w["capacity"] == 4
+        assert [s["ts"] for s in w["samples"]] == [20, 30, 40, 50], \
+            "ring wrapped: oldest two samples gone, order preserved"
+        assert [s["values"]["frtest.gauge"] for s in w["samples"]] == \
+            [20.0, 30.0, 40.0, 50.0]
+        # percentile counters flatten to their p99 series
+        assert all("frtest.lat_ms.p99" in s["values"] for s in w["samples"])
+        assert all("frtest.lat_ms" not in s["values"] for s in w["samples"])
+    finally:
+        counters.remove("frtest.gauge")
+        counters.remove("frtest.lat_ms")
+
+
+def test_history_window_query_and_deltas():
+    g = counters.number("frtest.level")
+    try:
+        h = MetricHistory(interval_s=5, capacity=8, prefixes=("frtest.",))
+        for t, v in [(0, 5), (10, 7), (20, 12), (30, 40)]:
+            g.set(v)
+            h.sample_once(now=t)
+        # full tail, convenience series view
+        assert h.series("frtest.level") == \
+            [(0, 5.0), (10, 7.0), (20, 12.0), (30, 40.0)]
+        # window cut at now-25: the ts=0 sample is outside but still
+        # anchors the first in-window delta (level -> rate view)
+        w = h.window(seconds=25, now=35, deltas=True)
+        assert [s["ts"] for s in w["samples"]] == [10, 20, 30]
+        assert [s["deltas"]["frtest.level"] for s in w["samples"]] == \
+            [2.0, 5.0, 28.0]
+        # names filter keeps only the asked-for series
+        w = h.window(names=["frtest.level"])
+        assert all(set(s["values"]) == {"frtest.level"}
+                   for s in w["samples"])
+    finally:
+        counters.remove("frtest.level")
+
+
+def test_history_sampler_refcounted_start_stop():
+    h = MetricHistory(interval_s=60, capacity=4, prefixes=("frtest.",))
+    h.start()
+    h.start()               # second role in the same process
+    h.stop()                # first stop: a ref remains, sampler lives
+    assert h._stop_evt is not None
+    h.stop()                # last stop: sampler told to exit
+    assert h._stop_evt is None
+
+
+# -------------------------------------------------------- incident units
+
+
+@pytest.fixture
+def incident_dir(tmp_path, monkeypatch):
+    d = tmp_path / "incidents"
+    monkeypatch.setenv("PEGASUS_INCIDENT_DIR", str(d))
+    return d
+
+
+def test_capture_offline_still_retains_local_ring(incident_dir):
+    """A flight recorder that needs a healthy cluster to record records
+    nothing useful: with NO meta reachable the capture degrades to the
+    capturing process's own ring and still retains the artifact."""
+    events.EVENTS.reset()
+    events.emit("learn.start", gpid="1.0")              # not a cause class
+    events.emit("lane.breaker_trip", lane="compact.lane", op="merge")
+    events.emit("failpoint.arm", point="unit.fault", action="return()")
+    fr = FlightRecorder()
+    inc = fr.capture(UNREACHABLE, reason="unit", trigger="manual")
+    assert inc["errors"], "the unreachable meta must be NAMED, not hidden"
+    # earliest event of the cascade-starting classes wins — the breaker
+    # trip beat the arm, and learn.start is not a candidate at all
+    assert inc["first_cause"]["name"] == "lane.breaker_trip"
+    assert [e["name"] for e in inc["timeline"]] == \
+        ["learn.start", "lane.breaker_trip", "failpoint.arm"]
+    assert all("t_rel" in e for e in inc["timeline"])
+    assert os.path.exists(inc["path"])
+    assert fr.load(inc["id"])["id"] == inc["id"]
+    listing = fr.list_incidents()
+    assert listing[0]["id"] == inc["id"]
+    assert listing[0]["first_cause"] == "lane.breaker_trip"
+
+
+def test_incident_retention_prunes_to_keep(incident_dir, monkeypatch):
+    monkeypatch.setenv("PEGASUS_INCIDENT_KEEP", "2")
+    events.EVENTS.reset()
+    fr = FlightRecorder()
+    ids = [fr.capture(UNREACHABLE, reason=f"r{i}", trigger="manual")["id"]
+           for i in range(4)]
+    kept = {i["id"] for i in fr.list_incidents()}
+    assert kept == set(ids[-2:]), "oldest artifacts pruned past the cap"
+
+
+def test_observe_verdict_transition_and_cooldown(incident_dir, monkeypatch):
+    monkeypatch.setenv("PEGASUS_INCIDENT_COOLDOWN_S", "3600")
+    events.EVENTS.reset()
+    fr = FlightRecorder()
+    ok = {"verdict": "healthy", "causes": []}
+    bad = {"verdict": "critical",
+           "causes": [{"cause": "x", "severity": "critical"}]}
+    assert fr.observe_verdict(ok, UNREACHABLE) is None
+    i1 = fr.observe_verdict(bad, UNREACHABLE)
+    assert i1, "healthy->critical must capture"
+    # STAYING unhealthy: the same id keeps riding the verdict — a second
+    # doctor run minutes into one incident points at one artifact
+    assert fr.observe_verdict({"verdict": "degraded", "causes": []},
+                              UNREACHABLE) == i1
+    # recover, then degrade again INSIDE the cooldown: no spam capture —
+    # and no stale id either (the retained artifact documents a DIFFERENT
+    # excursion; attaching it to a fresh transition would mislabel it)
+    assert fr.observe_verdict(ok, UNREACHABLE) is None
+    assert fr.observe_verdict(bad, UNREACHABLE) is None
+    assert len(fr.list_incidents()) == 1
+    # cooldown cleared: a fresh transition captures a fresh artifact
+    fr.reset()
+    i2 = fr.observe_verdict(bad, UNREACHABLE)
+    assert i2 and i2 != i1
+    assert len(fr.list_incidents()) == 2
+
+
+# --------------------------------------- grouped onebox structural merge
+
+
+@pytest.fixture(scope="module")
+def gcluster(tmp_path_factory):
+    c = MiniCluster(tmp_path_factory.mktemp("fr-grp"), n_nodes=1,
+                    serve_groups=2)
+    c.cli = c.create("frg", partitions=4, replicas=1)
+    yield c
+    c.cli.close()
+    c.stop()
+
+
+def _node_cmd(conn, name, args):
+    _, body = conn.call("RPC_CLI_CLI_CALL", codec.encode(
+        RemoteCommandRequest(name, list(args))), timeout=30.0)
+    return codec.decode(RemoteCommandResponse, body).output
+
+
+def test_grouped_events_dump_merges_every_worker(gcluster):
+    """Node-level `events-dump` through the group router: the pid-keyed
+    replies merge structurally, so EVERY worker process's ring stays
+    visible side by side — nothing averages or overwrites."""
+    node = gcluster.stubs[0]
+    host, _, port = node.address.rpartition(":")
+    conn = RpcConnection((host, int(port)))
+    try:
+        # arm+heal a fail point node-wide: the fan-out plants one
+        # arm/disarm pair in EACH worker's ring
+        _node_cmd(conn, "set-fail-point", ["frg.unit.fault", "return()"])
+        _node_cmd(conn, "set-fail-point", ["frg.unit.fault", "off()"])
+        merged = json.loads(_node_cmd(conn, "events-dump", []))
+        pids = sorted(k for k in merged if k.startswith("pid:"))
+        assert len(pids) == 2, f"one ring per worker process: {merged.keys()}"
+        for pid in pids:
+            names = [e["name"] for e in merged[pid]]
+            assert "failpoint.arm" in names and "failpoint.disarm" in names
+            arm = next(e for e in merged[pid]
+                       if e["name"] == "failpoint.arm")
+            assert arm["attrs"]["point"] == "frg.unit.fault"
+            assert {"seq", "ts", "name", "sev"} <= set(arm)
+        # the history rings ride the same pid-keyed merge
+        hist = json.loads(_node_cmd(conn, "metrics-history", []))
+        assert sorted(k for k in hist if k.startswith("pid:")) == pids
+        assert all("samples" in hist[pid] for pid in pids)
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------- http route units
+
+
+def test_http_route_functions_parse_queries(incident_dir):
+    """GET /events, /metrics/history and /incidents share the remote
+    commands' data paths; what is route-specific is the query parsing —
+    filters applied, malformed numbers degrade to unfiltered."""
+    from pegasus_tpu.runtime.service_app import (_events_route,
+                                                 _incidents_route,
+                                                 _metrics_history_route)
+
+    events.EVENTS.reset()
+    events.emit("failpoint.arm", point="u", action="return()")
+    events.emit("learn.start", gpid="1.0")
+    out = _events_route("/events?prefix=failpoint.&last=5")
+    assert [e["name"] for e in out["events"]] == ["failpoint.arm"]
+    assert len(_events_route("/events?last=oops")["events"]) == 2
+    assert _events_route("/events?since=%f" % (time.time() + 5)) \
+        == {"events": []}
+    hist = _metrics_history_route("/metrics/history?seconds=60")
+    assert "samples" in hist and hist["interval_s"] > 0
+    # empty incident dir: empty listing, unknown id -> None
+    assert _incidents_route("/incidents") == {"incidents": []}
+    assert _incidents_route("/incidents?id=nope") == {"incident": None}
+
+
+# ------------------------------------------- collector scrape robustness
+
+
+def test_collector_scrape_failure_counts_not_skips(tmp_path):
+    """Regression (ISSUE 12 satellite): a node that dies mid-
+    collect_once must COUNT — error counter + collector.scrape_failed
+    event naming the node — and the round must still conclude."""
+    cluster = MiniCluster(tmp_path)
+    col = None
+    try:
+        cli = cluster.create("scr", partitions=2)
+        for i in range(10):
+            cli.set(b"k%03d" % i, b"s", b"v%d" % i)
+        col = InfoCollector([cluster.meta_addr])  # driven by hand: no loop
+        col._c_scrape_err = errs = _Cnt()
+        # kill a node the round WILL scrape (a primary), while the meta
+        # still lists it (failure-detector grace)
+        _, victim, _ = _partition_members(cluster, "scr", 0)
+        for stub in list(cluster.stubs):
+            if stub.address == victim:
+                stub.stop()
+                cluster.stubs.remove(stub)
+        events.EVENTS.reset()
+        summary = col.collect_once()
+        assert "scr" in summary, "the round must conclude despite the death"
+        assert errs.n > 0, "a dead node must count, not silently vanish"
+        failed = events.EVENTS.snapshot(prefix="collector.scrape_failed")
+        assert any(e["attrs"]["node"] == victim for e in failed), failed
+        cli.close()
+    finally:
+        if col is not None:
+            col.stop()
+        cluster.stop()
+
+
+# ------------------------------------------------------- e2e acceptance
+
+
+def test_incident_autocapture_names_planted_fault(tmp_path, monkeypatch):
+    """The acceptance shape, in-suite: `audit.digest` corruption planted
+    under concurrent load — the doctor's healthy→critical transition
+    auto-captures ONE retained incident whose first-cause entry names
+    the fault's arm event, with the arm/audit/doctor events ordered on
+    one wall-clock timeline, and the id riding every doctor verdict for
+    the duration of the incident."""
+    monkeypatch.setenv("PEGASUS_INCIDENT_DIR", str(tmp_path / "inc"))
+    cluster = MiniCluster(tmp_path)
+    fp.setup()
+    RECORDER.reset()
+    try:
+        cli = cluster.create("frinc", partitions=2)
+        for i in range(40):
+            cli.set(b"k%03d" % i, b"s", b"v%d" % i)
+        _quiet_breakers()
+        time.sleep(0.5)  # beacons land
+        assert run_cluster_doctor([cluster.meta_addr])["verdict"] \
+            == "healthy"
+        app_id, _, secondaries = _partition_members(cluster, "frinc", 0)
+        victim = secondaries[0]
+        # clean slate: the planted arm below must be the EARLIEST
+        # cascade-class event in the ring, as in a real incident window
+        events.EVENTS.reset()
+        fp.cfg("audit.digest", f"return({victim}@{app_id}.0)")
+        with _Load(cli):
+            report = run_cluster_audit([cluster.meta_addr], wait_s=20.0)
+        assert report["mismatches"], "the planted fault must be caught"
+        time.sleep(0.6)  # corrupted digest rides the next beacons
+        verdict = run_cluster_doctor([cluster.meta_addr])
+        assert verdict["verdict"] == "critical"
+        inc_id = verdict.get("incident")
+        assert inc_id, "the transition must auto-capture an incident"
+
+        inc = RECORDER.load(inc_id)
+        assert inc is not None, "the artifact must be retained on disk"
+        fc = inc["first_cause"]
+        assert fc["name"] == "failpoint.arm", fc
+        assert fc["attrs"]["point"] == "audit.digest"
+        tl = inc["timeline"]
+        assert all(tl[i]["ts"] <= tl[i + 1]["ts"]
+                   for i in range(len(tl) - 1)), "one aligned timeline"
+        names = {e["name"] for e in tl}
+        assert {"failpoint.arm", "audit.mismatch", "doctor.verdict"} \
+            <= names, names
+        # cause precedes symptom on the aligned axis
+        assert fc["ts"] <= min(e["ts"] for e in tl
+                               if e["name"] == "audit.mismatch")
+
+        # a doctor run INSIDE the cooldown, still critical: same id
+        assert run_cluster_doctor([cluster.meta_addr]).get("incident") \
+            == inc_id
+        assert any(i["id"] == inc_id and i["first_cause"] == "failpoint.arm"
+                   for i in RECORDER.list_incidents())
+
+        # the shell surfaces list it
+        out = io.StringIO()
+        from pegasus_tpu.shell.main import Shell
+
+        Shell([cluster.meta_addr], out=out).run_line("flight_recorder")
+        assert inc_id in out.getvalue()
+        cli.close()
+    finally:
+        fp.teardown()
+        cluster.stop()
+        RECORDER.reset()

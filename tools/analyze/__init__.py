@@ -9,6 +9,8 @@ passes the review rounds kept doing by hand:
   fail_points       test-armed fail points exist; source hooks documented
   metric_names      counter registrations <-> README metric table
   remote_commands   command registrations <-> README command table
+  events            events.emit() names <-> README event table (and the
+                    names must be plain string literals)
   lock_discipline   `#: guarded_by` fields only touched under their lock
   thread_lifecycle  raw Thread/ThreadPoolExecutor spawns must route
                     through runtime/tasking's tracked helpers
@@ -177,8 +179,9 @@ def pass_names() -> list:
 
 
 def _load_passes() -> None:
-    from . import (env_knobs, fail_points, lock_discipline,  # noqa: F401
-                   metric_names, remote_commands, thread_lifecycle)
+    from . import (env_knobs, events, fail_points,  # noqa: F401
+                   lock_discipline, metric_names, remote_commands,
+                   thread_lifecycle)
 
 
 def run_pass(name: str, repo: Repo = None) -> list:

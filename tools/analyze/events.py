@@ -1,0 +1,89 @@
+"""Structured-event cross-check pass (ISSUE 12 satellite).
+
+Every event name emitted in source (``events.emit("name", ...)`` — the
+flight recorder's bus, runtime/events.py) must be DOCUMENTED in
+README.md's '### Event table', and every table row must still have a
+matching emit site (both directions — the same discipline the
+metric-name and remote-command tables already get). Additionally, the
+first argument of every ``events.emit(`` call must be a PLAIN string
+literal: the event-name vocabulary is the flight recorder's
+first-cause/filter surface, and a dynamic name is invisible both to
+this lint and to anyone grepping an incident artifact.
+"""
+
+import re
+
+from . import Finding, Repo, register
+
+# a literal-name emit; group(1) = the name
+_EMIT_RE = re.compile(r"\bevents\.emit\(\s*\"([^\"]+)\"")
+# any emit call, for spotting the non-literal ones (f-strings count as
+# non-literal: a hole makes the name dynamic)
+_ANY_EMIT_RE = re.compile(r"\bevents\.emit\(\s*([^)\n]{0,60})")
+
+
+def source_event_names(repo: Repo) -> set:
+    names = set()
+    for sf in repo.package_files():
+        names.update(_EMIT_RE.findall(sf.text))
+    return names
+
+
+def nonliteral_emits(repo: Repo) -> list:
+    """[(file, line, argument-snippet)] for emit calls whose first
+    argument is not a plain string literal."""
+    out = []
+    for sf in repo.package_files():
+        for m in _ANY_EMIT_RE.finditer(sf.text):
+            if _EMIT_RE.match(sf.text, m.start()):
+                continue
+            line = sf.text.count("\n", 0, m.start()) + 1
+            out.append((sf, line, m.group(1).strip()))
+    return out
+
+
+def readme_event_rows(repo: Repo) -> list:
+    """Event names from README's '### Event table': each row's first
+    backticked token."""
+    rows = []
+    for cells in repo.readme_table_rows("Event table"):
+        first = re.search(r"`([^`\s]+)", cells[0])
+        if first:
+            rows.append(first.group(1))
+    return rows
+
+
+@register("events")
+def run(repo: Repo = None) -> list:
+    repo = repo or Repo()
+    src = source_event_names(repo)
+    rows = readme_event_rows(repo)
+    out = []
+    if src and not rows:
+        return [Finding(
+            "events", "", 0,
+            "README.md has no '### Event table' section (or it is "
+            "empty) — every events.emit() name must be documented there",
+            key="no-table")]
+    documented = set(rows)
+    for name in sorted(src):
+        if name not in documented:
+            out.append(Finding(
+                "events", "", 0,
+                f"event {name!r} is emitted in source but missing from "
+                f"README.md's Event table", key=f"undoc:{name}"))
+    for name in sorted(documented):
+        if name not in src:
+            out.append(Finding(
+                "events", "", 0,
+                f"README Event table row {name!r} has no matching "
+                f"events.emit() in source — delete the row or restore "
+                f"the emit", key=f"stale-row:{name}"))
+    for sf, line, snippet in nonliteral_emits(repo):
+        out.append(Finding(
+            "events", sf.rel, line,
+            f"events.emit() name must be a plain string literal "
+            f"(got: {snippet!r}) — dynamic names are invisible to this "
+            f"lint and to incident-artifact greps",
+            key=f"nonliteral:{sf.rel}:{snippet[:40]}"))
+    return out

@@ -332,6 +332,32 @@ def run_pressure(argv=None) -> int:
         written = set()
         written_lock = threading.Lock()
 
+        # flight recorder (ISSUE 12): the FIRST named failure of the run
+        # captures an incident artifact AT failure time (the nodes' event
+        # rings + metric history still hold the lead-up), and the
+        # artifact rides the journal. One capture per run: later
+        # failures of the same run share the same recorded past.
+        incident_box = [None]
+        incident_lock = threading.Lock()
+
+        def _capture_on_fail(ev):
+            # serialized: concurrent first failures (a node kill breaking
+            # several reads at once) must still yield ONE capture; a
+            # failed capture releases the latch so a later failure retries
+            with incident_lock:
+                if incident_box[0] is not None:
+                    return
+                from pegasus_tpu.collector.flight_recorder import RECORDER
+
+                inc = RECORDER.capture(
+                    [meta_addr], reason=f"chaos failure {ev['failure']}",
+                    trigger="chaos")
+                incident_box[0] = {"id": inc["id"], "path": inc["path"],
+                                   "first_cause": inc["first_cause"]}
+            journal.record("incident.captured", **incident_box[0])
+
+        journal.on_fail = _capture_on_fail
+
         audits = None
         if args.audit_every > 0:
             audits = AuditRounds([meta_addr], apps=[args.table],
@@ -449,6 +475,8 @@ def run_pressure(argv=None) -> int:
                 if k in xcluster}
         if doctor is not None:
             detail["doctor"] = doctor["verdict"]
+        if incident_box[0] is not None:
+            detail["incident"] = incident_box[0]
         print(json.dumps({
             "metric": f"pressure test achieved qps (target {args.qps}, "
                       f"{args.read_pct}% reads, {args.threads} threads, "

@@ -58,6 +58,41 @@ def bench_stage_event(n: int) -> float:
     return (time.perf_counter() - t0) / n
 
 
+def bench_event_emit(n: int) -> float:
+    """events.emit cost (ISSUE 12): the flight recorder's bus sits on
+    transition edges of hot paths (breaker trips, throttle engage), and
+    tier-1 runs with it always-on — it must stay ~as cheap as a counter
+    increment."""
+    from pegasus_tpu.runtime.events import EventBus
+
+    bus = EventBus(capacity=4096)
+    t0 = time.perf_counter()
+    for i in range(n):
+        bus.emit("lane.fallback", severity="warn", lane="compact.lane",
+                 op="compact")
+    return (time.perf_counter() - t0) / n
+
+
+def bench_history_sample(n: int) -> float:
+    """One metric-history sample (full registry snapshot + prefix filter
+    + ring store): runs every PEGASUS_HISTORY_INTERVAL_S per process, so
+    even a millisecond-scale cost is ~0.02% duty at the 5 s default."""
+    from pegasus_tpu.runtime.metric_history import MetricHistory
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    # a realistic registry slice for the sampler to walk
+    for i in range(40):
+        counters.rate(f"engine.overheadbench.{i}.count").increment()
+    h = MetricHistory(interval_s=5, capacity=720)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        h.sample_once()
+    dur = (time.perf_counter() - t0) / n
+    for i in range(40):
+        counters.remove(f"engine.overheadbench.{i}.count")
+    return dur
+
+
 def bench_request_trace(n: int) -> float:
     from pegasus_tpu.runtime.tracing import RequestTracer
 
@@ -82,6 +117,10 @@ def run(n: int = None) -> dict:
         # one request trace = root + 2 nested spans + finalize
         "request_trace_us": round(
             bench_request_trace(max(1, n // 10)) * 1e6, 2),
+        # flight recorder (ISSUE 12): event emit + one history sample
+        "event_emit_us": round(bench_event_emit(n) * 1e6, 2),
+        "history_sample_us": round(
+            bench_history_sample(max(1, n // 100)) * 1e6, 2),
     }
 
 
