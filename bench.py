@@ -537,6 +537,8 @@ def _arm_watchdog():
         if not _RESULT_PRINTED:
             if os.environ.get("PEGASUS_BENCH_MODE") == "ycsb":
                 _emit(_ycsb_degraded(f"watchdog fired after {budget}s"))
+            elif os.environ.get("PEGASUS_BENCH_MODE") == "learn":
+                _emit(_learn_degraded(f"watchdog fired after {budget}s"))
             else:
                 n_total, n_runs, value_size, _ = _bench_params()
                 _emit(_degraded(n_total, n_runs, value_size,
@@ -948,6 +950,175 @@ def ycsb_main():
     _emit(result)
 
 
+def _learn_params():
+    """(records, value_size) for PEGASUS_BENCH_MODE=learn — single
+    source for the lane, the watchdog and the crash handler so a
+    degraded line's metric name matches the success path's."""
+    return (int(os.environ.get("PEGASUS_BENCH_LEARN_RECORDS", 20_000)),
+            int(os.environ.get("PEGASUS_BENCH_VALUE", 100)))
+
+
+def _learn_metric_name() -> str:
+    records, value_size = _learn_params()
+    return (f"learn ship: monolithic vs streamed-delta bytes ratio "
+            f"({records} records, value={value_size}B)")
+
+
+def _learn_degraded(reason: str, detail: dict = None) -> dict:
+    d = {"degraded": True, "reason": reason}
+    d.update(detail or {})
+    return {"metric": _learn_metric_name(), "value": None, "unit": "x",
+            "vs_baseline": None, "detail": d}
+
+
+def learn_main():
+    """PEGASUS_BENCH_MODE=learn: the block-shipped learning artifact
+    (ISSUE 13) — wall clock + shipped bytes for the three ways a replica
+    can be (re-)seeded at N records, all in-process on CPU:
+
+      * monolithic: the legacy whole-state copy (every checkpoint file
+        read into memory and shipped, learner rebuilt from scratch);
+      * full ship:  the streaming block plane, learner starting empty
+        (same bytes as monolithic, but chunked/resumable/pinned);
+      * delta ship: the streaming plane re-learning a learner that
+        already holds the SSTs (the balancer-move/restart case the delta
+        handshake exists for) after a small write burst on the primary;
+      * replay:     log-replay-only catch-up of the same history — the
+        baseline the ship path replaces for bulk state.
+
+    Every learn's engine digest is compared against the primary at equal
+    committed decrees (a transfer that loses bytes must fail the bench,
+    not report a speed). One JSON line; degraded-line semantics match
+    the YCSB mode."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _enable_compile_cache()
+    import shutil
+    import tempfile
+
+    from pegasus_tpu.base.utils import epoch_now
+    from pegasus_tpu.engine import EngineOptions
+    from pegasus_tpu.engine.server_impl import RPC_MULTI_PUT
+    from pegasus_tpu.replication.replica import GroupView, Replica
+    from pegasus_tpu.rpc import messages as rpc_msg
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    records, value_size = _learn_params()
+    host_start = _host_info()
+    tmp = tempfile.mkdtemp(prefix="pegasus_learn_bench_")
+    # small memtables so the loaded state lands in SSTs (the thing the
+    # block plane ships); cpu backend end to end — no TPU lease needed
+    # to measure the replay-vs-ship win
+    opts = lambda: EngineOptions(backend="cpu", memtable_bytes=256 << 10)  # noqa: E731
+    reps = []
+
+    def open_replica(name):
+        r = Replica(name, os.path.join(tmp, name), options=opts(), quorum=1)
+        reps.append(r)
+        return r
+
+    def ship_totals():
+        return {k: counters.rate(f"learn.ship.{k}").total()
+                for k in ("blocks", "bytes", "delta_skipped_blocks")}
+
+    try:
+        prim = open_replica("prim")
+        prim.assume_view(GroupView(1, "prim", []))
+        value = os.urandom(value_size)
+        t0 = time.perf_counter()
+        per = 100
+        for base in range(0, records, per):
+            kvs = [rpc_msg.KeyValue(b"s%08d" % i, value)
+                   for i in range(base, min(base + per, records))]
+            prim.client_write(RPC_MULTI_PUT, rpc_msg.MultiPutRequest(
+                hash_key=b"h%05d" % (base % 97), kvs=kvs))
+        load_s = time.perf_counter() - t0
+        prim.server.engine.flush()
+        now = epoch_now()
+
+        def run_learn(learner, peer):
+            before, t0 = ship_totals(), time.perf_counter()
+            learner.learn_from(peer)
+            after = ship_totals()
+            ld = learner.server.engine.state_digest(now=now)
+            pd = prim.server.engine.state_digest(now=now)
+            return {
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "bytes": after["bytes"] - before["bytes"],
+                "blocks": after["blocks"] - before["blocks"],
+                "delta_skipped_blocks": (after["delta_skipped_blocks"]
+                                         - before["delta_skipped_blocks"]),
+                "digest_match": (ld["digest"] == pd["digest"]
+                                 and learner.last_committed
+                                 == prim.last_committed),
+            }
+
+        class _MonolithicPeer:
+            """Peer exposing ONLY the legacy surface, so learn_from
+            takes the monolithic path against the same primary."""
+
+            def fetch_learn_state(self):
+                return prim.fetch_learn_state()
+
+        mono = run_learn(open_replica("mono"), _MonolithicPeer())
+        streamer = open_replica("full")
+        full = run_learn(streamer, prim)
+        # the delta case: a small burst on the primary, then re-learn
+        # the SAME learner — it already holds (almost) every SST
+        burst = max(1, records // 100)
+        for base in range(0, burst, per):
+            kvs = [rpc_msg.KeyValue(b"d%08d" % i, value)
+                   for i in range(base, min(base + per, burst))]
+            prim.client_write(RPC_MULTI_PUT, rpc_msg.MultiPutRequest(
+                hash_key=b"hd%04d" % (base % 97), kvs=kvs))
+        prim.server.engine.flush()
+        delta = run_learn(streamer, prim)
+
+        # replay-only catch-up baseline: the same history applied
+        # mutation by mutation through the prepare path
+        replayer = open_replica("replay")
+        t0 = time.perf_counter()
+        window, replayed = [], 0
+        for m in prim.plog.replay(0):
+            window.append(m)
+            replayed += 1
+            if len(window) >= 64:
+                replayer.on_prepare_batch(prim.ballot, window,
+                                          window[-1].decree)
+                window = []
+        if window:
+            replayer.on_prepare_batch(prim.ballot, window,
+                                      window[-1].decree)
+        replay = {"wall_s": round(time.perf_counter() - t0, 3),
+                  "mutations": replayed}
+        # NOTE the honest asymmetry: after plog GC only the tail is
+        # replayable at all — this baseline exists because the primary
+        # here still holds its full log
+        ratio = round(mono["bytes"] / max(delta["bytes"], 1), 2)
+        detail = {
+            "records": records, "value_bytes": value_size,
+            "load_s": round(load_s, 2),
+            "monolithic": mono, "full_ship": full, "delta_ship": delta,
+            "replay_catch_up": replay,
+            "bytes_ratio_mono_over_delta": ratio,
+            "host": {"start": host_start, "end": _host_info()},
+        }
+        if not (mono["digest_match"] and full["digest_match"]
+                and delta["digest_match"]):
+            _emit(_learn_degraded(
+                "post-learn digest mismatch — a learn path lost bytes",
+                detail=detail))
+            return
+        _emit({"metric": _learn_metric_name(), "value": ratio, "unit": "x",
+               "vs_baseline": None, "detail": detail})
+    finally:
+        for r in reps:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     _arm_watchdog()
     n_total, n_runs, value_size, reps = _bench_params()
@@ -1065,6 +1236,9 @@ if __name__ == "__main__":
         if _mode == "ycsb":
             _arm_watchdog()
             ycsb_main()
+        elif _mode == "learn":
+            _arm_watchdog()
+            learn_main()
         else:
             main()
     except Exception as e:  # noqa: BLE001 - the driver needs a JSON line, always
@@ -1074,6 +1248,8 @@ if __name__ == "__main__":
         if not _RESULT_PRINTED:
             if _mode == "ycsb":
                 _emit(_ycsb_degraded(f"bench crashed: {e!r}"))
+            elif _mode == "learn":
+                _emit(_learn_degraded(f"bench crashed: {e!r}"))
             else:
                 n_total, n_runs, value_size, _ = _bench_params()
                 _emit(_degraded(n_total, n_runs, value_size,

@@ -100,8 +100,10 @@ class NodeKillRestart(FaultActor):
         self._spec = None
         self._node = None
         self._last_repair = 0.0
+        self._ship_ok = False
 
     def arm(self, node_index: int = None):
+        self._ship_ok = False
         idx = self.node_index if node_index is None else node_index
         victim = self.cluster.stubs[idx]
         addr = victim.address
@@ -178,7 +180,28 @@ class NodeKillRestart(FaultActor):
         if now - self._last_repair >= 1.0:
             self._last_repair = now
             self.cluster.meta.repair_under_replication()
-        return _fully_replicated(self.cluster, self.caller)
+        if not _fully_replicated(self.cluster, self.caller):
+            return False
+        # recovery is only REAL when the repair re-seeds went through the
+        # block-ship learn plane (ISSUE 13): counter-assert the restarted
+        # node's monotone learn.ship totals moved — a fully-replicated
+        # verdict with zero learns would mean the meta never re-seeded
+        # the partitions that lost this member
+        return self._block_ship_verified()
+
+    def _block_ship_verified(self) -> bool:
+        if self._ship_ok or self.caller is None:
+            return True
+        try:
+            out = json.loads(self.caller.remote_command(
+                self._restarted_addr(), "learn-status", []))
+        except (RpcError, OSError, ValueError):
+            return False
+        # delta_skipped counts too: a restarted node whose disk survived
+        # legitimately re-ships only what changed while it was down
+        self._ship_ok = (out.get("ship.blocks", 0)
+                         + out.get("ship.delta_skipped_blocks", 0)) > 0
+        return self._ship_ok
 
 
 class GroupWorkerKill(FaultActor):

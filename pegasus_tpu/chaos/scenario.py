@@ -247,9 +247,11 @@ def smoke_scenario() -> Scenario:
 def full_scenario() -> Scenario:
     """The production-sim flagship schedule (~30 s of load): scheduler
     token flips, a remote fail-point wedge, a mid-load partition split,
-    a group-worker kill, a balancer primary move, and a whole-node
-    kill+restart — everything at once, under periodic audit, with a
-    duplication leg (set up by the harness) compared cross-cluster at
+    a group-worker kill, a balancer primary move, a whole-node
+    kill+restart, and a mid-ship learn abort planted under the node's
+    re-seed window (the block-ship plane must resume, not re-seed from
+    scratch or wedge) — everything at once, under periodic audit, with
+    a duplication leg (set up by the harness) compared cross-cluster at
     the end."""
     return Scenario("full", [
         FaultAction("sched-defer-urgent", A_SCHED, at_s=2.0, duration_s=4.0,
@@ -266,6 +268,17 @@ def full_scenario() -> Scenario:
                     recovery_deadline_s=15.0, settle_s=2.0),
         FaultAction("kill-node", A_NODE_KILL, at_s=19.0, duration_s=3.0,
                     recovery_deadline_s=40.0, settle_s=3.0),
+        # armed on the surviving nodes between kill-node's arm and heal,
+        # so the killed node's first repair learns hit mid-ship aborts
+        # and must resume at block granularity. COUNT-bounded (first 3
+        # hits per process), not probabilistic: the runner thread blocks
+        # in kill-node's recovery wait before this action's heal can
+        # run, so the fault must self-exhaust — a lingering %-armed
+        # abort would fail every repair learn for the whole window
+        FaultAction("learn-ship-abort", A_FAILPOINT, at_s=21.0,
+                    duration_s=4.0, recovery_deadline_s=10.0, settle_s=1.0,
+                    args={"point": "learn.ship",
+                          "action": "3*raise(chaos)"}),
     ])
 
 

@@ -288,6 +288,16 @@ class LsmEngine:
         # dir would otherwise race between the maintenance timer and RPC
         # threads); RLock so callers can hold it across create+consume
         self.checkpoint_lock = lockrank.named_rlock("engine.checkpoint")
+        # learn-shipping checkpoint pins (ISSUE 13): decree -> {lease
+        # token: expiry}, one lease per active learn. A pinned decree's
+        # checkpoint.{decree} dir is held out of gc_checkpoints while a
+        # learner streams its blocks; pins are TTL leases renewed by
+        # fetch activity, so a dead learner can never wedge GC forever
+        self._ckpt_pins = {}          #: guarded_by self.checkpoint_lock
+        self._pin_token = 0           #: guarded_by self.checkpoint_lock
+        # decree -> cached decree-anchored digest of that checkpoint
+        # (one scan per pinned checkpoint, not one per learner)
+        self._ckpt_digests = {}       #: guarded_by self.checkpoint_lock
         # one flush drainer at a time
         self._flush_lock = lockrank.named_lock("engine.flush")
         # serializes compact()/_maybe_cascade()/manual_compact() merge
@@ -1597,7 +1607,12 @@ class LsmEngine:
         keep_min = max(1, self.opts.checkpoint_reserve_min_count)
         dropped = 0
         now = time.time()
+        pinned = self._pinned_decrees_locked()
         for d in decrees[:-keep_min] if len(decrees) > keep_min else []:
+            if d in pinned:
+                # an active learn streams this checkpoint's blocks
+                # lock-free; dropping the dir would dangle its fetches
+                continue
             cdir = os.path.join(self.path, f"{CHECKPOINT_PREFIX}{d}")
             if self.opts.checkpoint_reserve_time_seconds > 0:
                 age = now - os.path.getmtime(cdir)
@@ -1606,6 +1621,88 @@ class LsmEngine:
             shutil.rmtree(cdir, ignore_errors=True)
             dropped += 1
         return dropped
+
+    # ------------------------------------------------- learn-ship pinning
+
+    def pin_checkpoint(self, decree: int, ttl_s: float = 600.0) -> int:
+        """Hold checkpoint.{decree} out of gc_checkpoints for one learn
+        (ISSUE 13). Each pin is an independent TTL LEASE identified by
+        the returned token: renew/unpin act on exactly that lease, so an
+        expired learner's reap can never release a LIVE learner's pin on
+        the same decree. Fetch activity renews; expiry releases —
+        learner death bounds the hold, not learn duration."""
+        with self.checkpoint_lock:
+            self._pin_token += 1
+            token = self._pin_token
+            self._ckpt_pins.setdefault(decree, {})[token] = \
+                time.monotonic() + ttl_s
+            return token
+
+    def renew_checkpoint_pin(self, decree: int, token: int,
+                             ttl_s: float) -> None:
+        with self.checkpoint_lock:
+            pins = self._ckpt_pins.get(decree)
+            if pins and token in pins:
+                pins[token] = time.monotonic() + ttl_s
+
+    def unpin_checkpoint(self, decree: int, token: int) -> None:
+        with self.checkpoint_lock:
+            pins = self._ckpt_pins.get(decree)
+            if pins:
+                pins.pop(token, None)
+            if not pins:
+                self._ckpt_pins.pop(decree, None)
+                self._ckpt_digests.pop(decree, None)
+
+    def _pinned_decrees_locked(self) -> set:  #: requires self.checkpoint_lock
+        now = time.monotonic()
+        for d in list(self._ckpt_pins):
+            live = {t: e for t, e in self._ckpt_pins[d].items() if e > now}
+            if live:
+                self._ckpt_pins[d] = live
+            else:
+                self._ckpt_pins.pop(d)
+                self._ckpt_digests.pop(d, None)
+        return set(self._ckpt_pins)
+
+    def pinned_checkpoints(self) -> dict:
+        """{decree: active pin count} (learn-status surface)."""
+        with self.checkpoint_lock:
+            self._pinned_decrees_locked()
+            return {d: len(p) for d, p in self._ckpt_pins.items()}
+
+    def checkpoint_digest(self, decree: int) -> dict:
+        """Decree-anchored digest of checkpoint.{decree}'s contents (the
+        PR 8 state_digest fold over a read-only engine opened on the
+        checkpoint dir) — what a shipped replica must reproduce from its
+        staged blocks before swapping them in. Cached per decree, with
+        the TTL `now` anchor and ownership mask chosen at first
+        computation, so every learner of one checkpoint compares against
+        the same instant. Caller must hold a pin (the dir must not GC
+        mid-scan)."""
+        from ..base.utils import epoch_now
+
+        with self.checkpoint_lock:
+            hit = self._ckpt_digests.get(decree)
+            if hit is not None:
+                return dict(hit)
+            cdir = self.get_checkpoint_dir(decree)
+        # the scan runs OUTSIDE the checkpoint lock: a multi-second fold
+        # must not stall the maintenance timer's sync_checkpoint. Racing
+        # computers produce byte-identical folds apart from the `now`
+        # anchor; setdefault keeps whichever landed first coherent.
+        ver = LsmEngine(cdir, EngineOptions(
+            backend="cpu", pidx=self.opts.pidx,
+            prefix_u32=self.opts.prefix_u32))
+        try:
+            d = ver.state_digest(now=epoch_now(),
+                                 pmask=self.opts.partition_mask)
+        finally:
+            ver.close()
+        entry = {"digest": d["digest"], "records": d["records"],
+                 "now": d["now"], "pmask": self.opts.partition_mask}
+        with self.checkpoint_lock:
+            return dict(self._ckpt_digests.setdefault(decree, entry))
 
     def get_checkpoint_dir(self, decree: int = None) -> str:
         """Latest (or specific) checkpoint dir for learner shipping

@@ -1451,8 +1451,16 @@ class MetaServer:
                 secondaries=pc.secondaries + [node],
                 learn_from=pc.primary, envs_json=app.envs_json,
                 partition_count=app.partition_count)
-            if self._send_to_node(node, RPC_OPEN_REPLICA, lreq,
-                                  ignore_errors=True) is None:
+            try:
+                self._send_to_node(node, RPC_OPEN_REPLICA, lreq)
+            except (RpcError, OSError) as e:
+                # seed failures are retried by the caller's next pass, but
+                # never silently: an operator chasing "why does this
+                # partition stay under-replicated" needs the learner's
+                # actual error (PEGASUS_REPAIR_DEBUG=1)
+                if os.environ.get("PEGASUS_REPAIR_DEBUG"):
+                    print(f"[meta] seed {app.app_name}.{pc.pidx} learner "
+                          f"{node} failed: {e!r}"[:400], flush=True)
                 seeded = False
         return seeded
 

@@ -1,0 +1,307 @@
+"""Block-shipped learning: streaming, delta-aware SST transfer (ISSUE 13).
+
+The learn/rebalance/bootstrap plane's shared machinery — replacing the
+monolithic "read every checkpoint file into one dict under the primary's
+lock" re-seed with a manifest-diff handshake plus chunked block
+streaming (the RDMA index-replication shape from PAPERS.md: ship
+compacted engine state and replay only the log tail):
+
+  1. the learner sends its live SST set (filename + content digest);
+  2. the primary pins an immutable checkpoint (checkpoint GC and plog GC
+     of covered segments are held while pinned — TTL leases, so a dead
+     learner can never wedge GC forever) and replies with the full block
+     manifest plus which blocks the learner is missing;
+  3. the learner stages blocks into ``learn_ckpt/``: already-staged
+     blocks from an interrupted ship and digest-matching live files are
+     reused (delta + resume at block granularity), the rest stream as
+     bounded chunks with a per-chunk CRC over the existing ``call_many``
+     wave machinery, and every landed block re-verifies its whole-file
+     digest before it counts;
+  4. the swap into the serving engine happens in a short critical
+     section, after the staged state proved itself byte-consistent via
+     the PR 8 decree-anchored digest compared at the checkpoint decree.
+
+Three "copy a partition" flows ride this one implementation: learner
+re-seed (replication/replica.py), the meta balancer's add-secondary path
+(which seeds over the same learn RPC surface), and duplicator bootstrap
+of a fresh remote cluster (replication/bootstrap.py).
+
+Counters (learner-side, so the replay-vs-ship win is measurable on CPU):
+``learn.ship.{blocks,bytes,duration_us,delta_skipped_blocks}`` and
+``learn.replay.mutations``.
+"""
+
+import hashlib
+import os
+import zlib
+
+from ..rpc import codec
+from ..rpc import messages as rpc_msg
+from ..rpc.transport import RpcError
+from ..runtime.fail_points import inject
+from ..runtime.perf_counters import counters
+
+
+class LearnShipError(ConnectionError):
+    """A block ship failed (chunk CRC, digest mismatch, expired pin).
+    ConnectionError subclass: every learn caller already treats peer
+    ConnectionErrors as "this learn failed, retry later"."""
+
+
+def chunk_bytes() -> int:
+    """PEGASUS_LEARN_CHUNK_BYTES: bounded block-streaming chunk size."""
+    return max(4096, int(os.environ.get("PEGASUS_LEARN_CHUNK_BYTES",
+                                        str(1 << 20))))
+
+
+def delta_enabled() -> bool:
+    """PEGASUS_LEARN_DELTA=0 is the delta kill switch: every learn ships
+    the full checkpoint (the streaming/resume machinery still applies)."""
+    return os.environ.get("PEGASUS_LEARN_DELTA", "1") != "0"
+
+
+def verify_enabled() -> bool:
+    """PEGASUS_LEARN_VERIFY=0 skips the decree-anchored digest proof on
+    arrival (the per-chunk CRC + per-block digest checks always run)."""
+    return os.environ.get("PEGASUS_LEARN_VERIFY", "1") != "0"
+
+
+def pin_ttl_s() -> float:
+    """PEGASUS_LEARN_PIN_TTL_S: checkpoint/log pin lease per learn;
+    renewed by fetch activity, so it bounds learner DEATH, not learn
+    duration."""
+    return float(os.environ.get("PEGASUS_LEARN_PIN_TTL_S", "600"))
+
+
+def file_digest(path: str) -> str:
+    """Content digest for block identity (md5: C-speed streaming; this
+    is a transfer-dedup key, not a security boundary — corruption on the
+    wire is caught by the per-chunk CRC and this digest together)."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def dir_manifest(dirpath: str, suffix: str = None) -> list:
+    """[{"name", "size", "digest"}] for the regular files in `dirpath`
+    (optionally only names ending with `suffix`), sorted by name.
+    Vanishing files (a live engine unlinking mid-scan) are skipped —
+    the manifest is a best-effort "what do I already hold" set."""
+    out = []
+    if not os.path.isdir(dirpath):
+        return out
+    for name in sorted(os.listdir(dirpath)):
+        if suffix is not None and not name.endswith(suffix):
+            continue
+        if name.endswith(".part"):
+            continue  # torn partial from an interrupted ship
+        p = os.path.join(dirpath, name)
+        try:
+            if not os.path.isfile(p):
+                continue
+            out.append({"name": name, "size": os.path.getsize(p),
+                        "digest": file_digest(p)})
+        except OSError:
+            continue
+    return out
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    import shutil
+
+    if os.path.exists(dst):
+        os.unlink(dst)
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+def _fetch_block(source, learn_id: int, entry: dict, dest_dir: str) -> int:
+    """Stream one block from the source as bounded chunks (per-chunk
+    CRC), land it atomically (.part + rename) after the whole-file
+    digest matched the manifest entry. -> bytes fetched."""
+    inject("learn.ship")  # chaos seam: a mid-ship abort on the learner
+    name, total = entry["name"], entry["size"]
+    cb = chunk_bytes()
+    offs = list(range(0, total, cb)) or [0]
+    part = os.path.join(dest_dir, name + ".part")
+    fetched = 0
+    # one wave per bounded group of chunks: pipelined over call_many for
+    # an RPC source, a plain loop for an in-process one — either way the
+    # in-flight byte volume stays bounded by wave_chunks * chunk_bytes
+    wave_chunks = max(1, (8 << 20) // cb)
+    with open(part, "wb") as f:
+        for i in range(0, len(offs), wave_chunks):
+            reqs = [(name, off, min(cb, max(0, total - off)))
+                    for off in offs[i:i + wave_chunks]]
+            chunks = source.fetch_learn_chunks(learn_id, reqs)
+            for (_, off, ln), ch in zip(reqs, chunks):
+                data = ch["data"]
+                if len(data) != ln or zlib.crc32(data) != ch["crc"]:
+                    raise LearnShipError(
+                        f"chunk CRC/length mismatch for {name}@{off}")
+                f.write(data)
+                fetched += len(data)
+    if file_digest(part) != entry["digest"]:
+        os.unlink(part)
+        raise LearnShipError(f"shipped block {name} digest mismatch")
+    os.replace(part, os.path.join(dest_dir, name))
+    return fetched
+
+
+def stage_blocks(source, st: dict, dest_dir: str, reuse: dict = None,
+                 delta: bool = None) -> dict:
+    """Materialize the learn manifest ``st["blocks"]`` into `dest_dir`,
+    exactly: already-staged blocks whose digest matches are kept
+    (resume), digest-matching local files from `reuse` ({digest: path},
+    built by the caller from its ALREADY-computed have-manifest — no
+    second directory scan) are hardlinked in (delta skip), everything
+    else streams from `source` in CRC-checked chunks. delta=False (the
+    PEGASUS_LEARN_DELTA kill switch) disables BOTH reuse and resume:
+    every block re-fetches from the source. Files not in the manifest
+    are pruned, so the staged dir is swap-ready. -> stats dict."""
+    os.makedirs(dest_dir, exist_ok=True)
+    delta = delta_enabled() if delta is None else bool(delta)
+    stats = {"blocks": len(st["blocks"]), "fetched": 0, "bytes": 0,
+             "skipped": 0, "resumed": 0}
+    reuse = dict(reuse or {}) if delta else {}
+    want = {e["name"] for e in st["blocks"]}
+    for name in os.listdir(dest_dir):
+        if name not in want:
+            try:
+                os.unlink(os.path.join(dest_dir, name))
+            except OSError:
+                pass
+    c_blocks = counters.rate("learn.ship.blocks")
+    c_bytes = counters.rate("learn.ship.bytes")
+    c_skip = counters.rate("learn.ship.delta_skipped_blocks")
+    for entry in st["blocks"]:
+        dst = os.path.join(dest_dir, entry["name"])
+        if delta:
+            try:
+                if os.path.isfile(dst) \
+                        and file_digest(dst) == entry["digest"]:
+                    stats["resumed"] += 1  # staged by an interrupted ship
+                    c_skip.increment()
+                    continue
+            except OSError:
+                pass
+            src = reuse.get(entry["digest"])
+            if src is not None:
+                try:
+                    _link_or_copy(src, dst)
+                    if file_digest(dst) == entry["digest"]:
+                        stats["skipped"] += 1  # delta: learner had it
+                        c_skip.increment()
+                        continue
+                    os.unlink(dst)
+                except OSError:
+                    pass  # vanished under us: stream it instead
+        stats["bytes"] += _fetch_block(source, st["learn_id"], entry,
+                                       dest_dir)
+        stats["fetched"] += 1
+        c_blocks.increment()
+    c_bytes.increment(stats["bytes"])
+    return stats
+
+
+class RemoteLearnSource:
+    """Learn-protocol client over the RPC transport — the one
+    implementation behind ``_RemotePeer``'s learn surface and the
+    duplicator bootstrap. Chunk fetches pipeline through ``call_many``
+    (one coalesced send per wave)."""
+
+    def __init__(self, pool, addr: str, app_id: int, pidx: int,
+                 timeout: float = 30.0):
+        self.pool = pool
+        self.addr = addr
+        self.app_id = app_id
+        self.pidx = pidx
+        self.timeout = timeout
+
+    def _conn(self):
+        host, _, port = self.addr.rpartition(":")
+        return self.pool.get((host, int(port)),
+                             shard=("rep", self.app_id, self.pidx))
+
+    def _call(self, code: str, req, resp_cls):
+        try:
+            _, body = self._conn().call(
+                code, codec.encode(req), app_id=self.app_id,
+                partition_index=self.pidx, timeout=self.timeout)
+        except (RpcError, OSError) as e:
+            raise ConnectionError(str(e))
+        resp = codec.decode(resp_cls, body)
+        if resp.error:
+            raise LearnShipError(f"{code} failed: {resp.error_text}")
+        return resp
+
+    def prepare_learn_state(self, have=None, delta=None) -> dict:
+        from .replica_stub import RPC_LEARN_PREPARE
+
+        req = rpc_msg.LearnPrepareRequest(
+            app_id=self.app_id, pidx=self.pidx,
+            delta=delta_enabled() if delta is None else bool(delta),
+            have=[rpc_msg.LearnBlockEntry(e["name"], e["size"], e["digest"])
+                  for e in (have or [])])
+        resp = self._call(RPC_LEARN_PREPARE, req,
+                          rpc_msg.LearnPrepareResponse)
+        return {
+            "learn_id": resp.learn_id, "ckpt_decree": resp.ckpt_decree,
+            "ballot": resp.ballot, "last_committed": resp.last_committed,
+            "blocks": [{"name": e.name, "size": e.size, "digest": e.digest}
+                       for e in resp.blocks],
+            "missing": list(resp.missing), "digest": resp.digest,
+            "digest_now": resp.digest_now, "digest_pmask": resp.digest_pmask,
+        }
+
+    def fetch_learn_chunks(self, learn_id: int, reqs) -> list:
+        from .replica_stub import RPC_LEARN_FETCH
+
+        calls = [(RPC_LEARN_FETCH,
+                  codec.encode(rpc_msg.LearnFetchRequest(
+                      app_id=self.app_id, pidx=self.pidx, learn_id=learn_id,
+                      name=name, offset=off, length=ln)),
+                  self.app_id, self.pidx, 0) for (name, off, ln) in reqs]
+        try:
+            results = self._conn().call_many(calls, timeout=self.timeout)
+        except (RpcError, OSError) as e:
+            raise ConnectionError(str(e))
+        out = []
+        for _, body in results:
+            resp = codec.decode(rpc_msg.LearnFetchResponse, body)
+            if resp.error:
+                raise LearnShipError(f"learn fetch failed: {resp.error_text}")
+            out.append({"data": resp.data, "crc": resp.crc,
+                        "total": resp.total})
+        return out
+
+    def fetch_learn_tail(self, learn_id: int) -> dict:
+        from .mutation_log import LogMutation
+        from .replica_stub import RPC_LEARN_TAIL
+
+        resp = self._call(RPC_LEARN_TAIL,
+                          rpc_msg.LearnTailRequest(
+                              app_id=self.app_id, pidx=self.pidx,
+                              learn_id=learn_id),
+                          rpc_msg.LearnTailResponse)
+        return {"tail": [codec.decode(LogMutation, t) for t in resp.tail],
+                "last_committed": resp.last_committed, "ballot": resp.ballot}
+
+    def finish_learn(self, learn_id: int) -> None:
+        from .replica_stub import RPC_LEARN_FINISH
+
+        try:
+            self._call(RPC_LEARN_FINISH,
+                       rpc_msg.LearnFinishRequest(
+                           app_id=self.app_id, pidx=self.pidx,
+                           learn_id=learn_id),
+                       rpc_msg.LearnFetchResponse)
+        except (ConnectionError, LearnShipError):
+            pass  # pin TTL covers an unreachable primary

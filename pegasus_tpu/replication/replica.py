@@ -108,6 +108,24 @@ class Replica:
         self.status = INACTIVE  #: guarded_by self._lock
         self.ballot = 0         #: guarded_by self._lock
         self.view = None        #: guarded_by self._lock
+        # a streamed learn is staging blocks with self._lock RELEASED
+        # (ISSUE 13): prepares arriving meanwhile are rejected instead of
+        # interleaving with the staged state (the primary treats the
+        # rejection as a missing ack; the post-swap gap path catches up)
+        self._learning = False  #: guarded_by self._lock
+        # primary-side learn pins (ISSUE 13): learn_id -> pin record.
+        # While pinned, plog GC floors at the pinned checkpoint decree
+        # (the tail fetch must stay replayable) and the engine holds the
+        # pinned checkpoint out of its own GC. Leaf lock (never nests
+        # another lock under it).
+        self._learn_lock = lockrank.named_lock("replica.learn_pins")
+        self._learn_pins = {}   #: guarded_by self._learn_lock
+        self._learn_next_id = 0  #: guarded_by self._learn_lock
+        # learner-side serialization: the transfer runs with self._lock
+        # released, so without this a meta retry (its open RPC timing out
+        # while the first learn still streams) would start a SECOND learn
+        # staging into the same learn_ckpt/ dir mid-flight
+        self._learn_serial = lockrank.named_lock("replica.learn_serial")
         self.server = PegasusServer(os.path.join(path, "data"), app_id=app_id,
                                     pidx=pidx, options=options, server=name,
                                     cluster_id=cluster_id)
@@ -385,6 +403,13 @@ class Replica:
                                  decree=ms[-1].decree if ms
                                  else committed_decree,
                                  batch=len(ms)), self._lock:
+            if self._learning:
+                # mid-learn: the staged state is about to replace this
+                # replica wholesale — interleaving prepares would be
+                # wiped (or worse, survive the swap). The primary treats
+                # this as a missing ack; post-swap the gap path catches
+                # up from the primary's log.
+                raise PrepareRejected("learning", self.last_prepared)
             if ballot < self.ballot:
                 raise PrepareRejected("stale_ballot", self.last_prepared)
             self.ballot = ballot
@@ -441,6 +466,8 @@ class Replica:
     def on_prepare(self, ballot: int, m: LogMutation, committed_decree: int):
         with REQUEST_TRACER.span("replica.on_prepare", decree=m.decree), \
                 self._lock:
+            if self._learning:
+                raise PrepareRejected("learning", self.last_prepared)
             if ballot < self.ballot:
                 raise PrepareRejected("stale_ballot", self.last_prepared)
             self.ballot = ballot
@@ -533,20 +560,113 @@ class Replica:
             self._export_gauges()
 
     def _learn_from(self, primary):
+        with self._learn_serial:
+            self._learn_from_serialized(primary)
+
+    def _learn_from_serialized(self, primary):
         with self._lock:
             self.status = LEARNER
+            self._learning = True
             self._uncommitted.clear()
-            state = primary.fetch_learn_state()
-            self.server.close()
-            ckpt_dir = os.path.join(self.path, "learn_ckpt")
-            if os.path.exists(ckpt_dir):
-                import shutil
+        try:
+            if hasattr(primary, "prepare_learn_state"):
+                self._learn_streamed(primary)
+            else:  # legacy peer: monolithic whole-state copy
+                self._learn_monolithic(primary)
+        finally:
+            with self._lock:
+                self._learning = False
 
-                shutil.rmtree(ckpt_dir)
-            os.makedirs(ckpt_dir)
-            for fname, blob in state["files"]:
-                with open(os.path.join(ckpt_dir, fname), "wb") as f:
-                    f.write(blob)
+    def _learn_streamed(self, primary):
+        """Block-shipped learn (ISSUE 13): manifest-diff handshake, then
+        chunked delta streaming into learn_ckpt/ with BOTH locks released
+        (the primary serves pinned immutable files, this replica rejects
+        prepares via _learning), then a decree-anchored digest proof of
+        the staged state, and only then a short swap critical section."""
+        import shutil
+
+        from . import learn as learn_mod
+        from ..runtime import events
+
+        t0 = time.perf_counter()
+        ckpt_dir = os.path.join(self.path, "learn_ckpt")
+        data_dir = os.path.join(self.path, "data")
+        # the delta handshake: what this replica already holds — staged
+        # blocks from an interrupted ship (resume) plus the live engine's
+        # current files (a re-learn that still has 99% of the SSTs). The
+        # live manifest is computed ONCE and reused as stage_blocks'
+        # link-reuse index — no second full-directory digest scan.
+        delta_on = learn_mod.delta_enabled()
+        live = learn_mod.dir_manifest(data_dir) if delta_on else []
+        have = (learn_mod.dir_manifest(ckpt_dir) + live) if delta_on else []
+        st = primary.prepare_learn_state(have=have, delta=delta_on)
+        try:
+            stats = learn_mod.stage_blocks(
+                primary, st, ckpt_dir, delta=delta_on,
+                reuse={e["digest"]: os.path.join(data_dir, e["name"])
+                       for e in live})
+            tail_state = primary.fetch_learn_tail(st["learn_id"])
+        finally:
+            primary.finish_learn(st["learn_id"])
+        if st.get("digest"):
+            # the shipped replica proves itself byte-consistent on
+            # arrival: the staged state's decree-anchored digest must
+            # equal the primary's at the checkpoint decree (same TTL
+            # clock, same ownership mask) BEFORE it may serve. Mismatch
+            # fails the learn loudly — never a silent divergent serve.
+            from ..engine import EngineOptions
+            from ..engine.db import LsmEngine
+
+            ver = LsmEngine(ckpt_dir, EngineOptions(
+                backend="cpu", pidx=self.pidx))
+            try:
+                d = ver.state_digest(now=st["digest_now"],
+                                     pmask=st["digest_pmask"])
+            finally:
+                ver.close()
+            if d["digest"] != st["digest"]:
+                raise ReplicaError(
+                    f"{self.name}: shipped state digest mismatch at "
+                    f"checkpoint decree {st['ckpt_decree']}: "
+                    f"{d['digest']} != primary {st['digest']}")
+        replayed = self._swap_learned_state(ckpt_dir, tail_state)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)  # staged blocks are
+        # hardlinked into data/ now; keeping them would feed stale names
+        # into the NEXT learn's have-set
+        counters.percentile("learn.ship.duration_us").set(
+            int((time.perf_counter() - t0) * 1e6))
+        events.emit("learn.ship", gpid=f"{self.app_id}.{self.pidx}",
+                    decree=st["ckpt_decree"], fetched=stats["fetched"],
+                    bytes=stats["bytes"], delta_skipped=stats["skipped"],
+                    resumed=stats["resumed"], replayed=replayed)
+
+    def _learn_monolithic(self, primary):
+        """Legacy whole-state learn (a peer without the block-ship
+        surface): the transfer still runs with this replica's lock
+        released — only the swap is a critical section."""
+        state = primary.fetch_learn_state()
+        ckpt_dir = os.path.join(self.path, "learn_ckpt")
+        if os.path.exists(ckpt_dir):
+            import shutil
+
+            shutil.rmtree(ckpt_dir)
+        os.makedirs(ckpt_dir)
+        nbytes = 0
+        for fname, blob in state["files"]:
+            with open(os.path.join(ckpt_dir, fname), "wb") as f:
+                f.write(blob)
+            nbytes += len(blob)
+        counters.rate("learn.ship.blocks").increment(len(state["files"]))
+        counters.rate("learn.ship.bytes").increment(nbytes)
+        self._swap_learned_state(ckpt_dir, state)
+
+    def _swap_learned_state(self, ckpt_dir: str, tail_state: dict) -> int:
+        """The learn's ONLY critical section: swap the staged checkpoint
+        in as the serving engine, reset the plog, stage + apply the log
+        tail above the checkpoint decree. -> tail mutations replayed."""
+        replayed = 0
+        with self._lock:
+            self.server.close()
             from ..engine.db import LsmEngine
 
             engine = LsmEngine.apply_checkpoint(
@@ -560,31 +680,187 @@ class Replica:
             self.plog.reset()
             self.last_committed = self.server.engine.last_committed_decree()
             self.last_prepared = self.last_committed
-            # pull the tail beyond the checkpoint
-            for m in state["tail"]:
+            # replay ONLY the log tail beyond the checkpoint decree —
+            # the whole point of shipping compacted state
+            for m in tail_state["tail"]:
                 if m.decree <= self.last_prepared:
                     continue
                 self.plog.append(m)
                 self.last_prepared = m.decree
                 self._uncommitted[m.decree] = m
-            self._apply_up_to(min(state["last_committed"], self.last_prepared))
-            self.ballot = max(self.ballot, state["ballot"])
+                replayed += 1
+            self._apply_up_to(min(tail_state["last_committed"],
+                                  self.last_prepared))
+            self.ballot = max(self.ballot, tail_state["ballot"])
             self.status = SECONDARY
+        counters.rate("learn.replay.mutations").increment(replayed)
+        return replayed
+
+    # ------------------------------------------------------ learn: primary
+
+    def prepare_learn_state(self, have=None, delta=None) -> dict:
+        """Manifest-diff handshake, primary side (ISSUE 13): pin an
+        immutable checkpoint (checkpoint GC + plog GC of covered
+        segments held while pinned), diff its block manifest against the
+        learner's `have` set, and return only the missing blocks'
+        metadata plus the checkpoint's decree-anchored digest. The
+        replica lock is held only for the watermark snapshot — never
+        across checkpointing or file reads (the old fetch_learn_state
+        stalled the prepare path for the whole transfer)."""
+        from . import learn as learn_mod
+
+        eng = self.server.engine
+        ttl = learn_mod.pin_ttl_s()
+        with eng.checkpoint_lock:
+            # flush=False: snapshot the DURABLE state only. Sequential
+            # learns (the balancer moving many partitions, repair
+            # retries) then share ONE checkpoint dir and its cached
+            # digest instead of forcing a memtable flush + a fresh
+            # full-state scan per learn — the un-flushed window rides
+            # the log tail, which is exactly what the tail is for
+            decree = eng.sync_checkpoint(flush=False)
+            ckpt = eng.get_checkpoint_dir(decree)
+            token = eng.pin_checkpoint(decree, ttl_s=ttl)
+        try:
+            manifest = learn_mod.dir_manifest(ckpt)
+            digest = (eng.checkpoint_digest(decree)
+                      if learn_mod.verify_enabled() else {})
+        except BaseException:
+            eng.unpin_checkpoint(decree, token)
+            raise
+        with self._learn_lock:
+            self._learn_next_id += 1
+            learn_id = self._learn_next_id
+            self._learn_pins[learn_id] = {
+                "decree": decree, "dir": ckpt, "token": token,
+                "expires": time.monotonic() + ttl}
+        delta_on = learn_mod.delta_enabled() if delta is None else bool(delta)
+        have_set = {(e["name"], e["digest"])
+                    for e in (have or [])} if delta_on else set()
+        missing = [e["name"] for e in manifest
+                   if (e["name"], e["digest"]) not in have_set]
+        with self._lock:
+            ballot, committed = self.ballot, self.last_committed
+        return {"learn_id": learn_id, "ckpt_decree": decree,
+                "ballot": ballot, "last_committed": committed,
+                "blocks": manifest, "missing": missing,
+                "digest": digest.get("digest", ""),
+                "digest_now": digest.get("now", 0),
+                "digest_pmask": digest.get("pmask", 0)}
+
+    def _learn_pin(self, learn_id: int, renew: bool = True) -> dict:
+        """Resolve (and lease-renew) an active learn pin; expired or
+        unknown pins fail the fetch loudly so the learner restarts its
+        learn instead of shipping from a GC-racing checkpoint."""
+        from . import learn as learn_mod
+
+        now = time.monotonic()
+        ttl = learn_mod.pin_ttl_s()
+        snap = None
+        with self._learn_lock:
+            pin = self._learn_pins.get(learn_id)
+            if pin is not None and now < pin["expires"]:
+                if renew:
+                    pin["expires"] = now + ttl
+                snap = dict(pin)
+        if snap is None:
+            raise ReplicaError(
+                f"{self.name}: learn {learn_id} expired/unknown")
+        if renew:  # engine lease renewed OUTSIDE the leaf pin lock
+            self.server.engine.renew_checkpoint_pin(snap["decree"],
+                                                    snap["token"], ttl)
+        return snap
+
+    def fetch_learn_block(self, learn_id: int, name: str, offset: int,
+                          length: int) -> dict:
+        """Serve one chunk of one pinned checkpoint block — LOCK-FREE:
+        pinned files are immutable (checkpoint hardlinks are independent
+        dir entries) and held out of GC by the pin."""
+        from ..runtime.fail_points import inject
+        import zlib
+
+        inject("learn.ship")  # chaos seam: a mid-ship abort on the primary
+        pin = self._learn_pin(learn_id)
+        path = os.path.join(pin["dir"], os.path.basename(name))
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(length)
+        return {"data": data, "crc": zlib.crc32(data),
+                "total": os.path.getsize(path)}
+
+    def fetch_learn_chunks(self, learn_id: int, reqs) -> list:
+        """In-process chunk wave (the RPC peer pipelines the same shape
+        through call_many — learn.RemoteLearnSource)."""
+        return [self.fetch_learn_block(learn_id, name, off, ln)
+                for (name, off, ln) in reqs]
+
+    def fetch_learn_tail(self, learn_id: int) -> dict:
+        """Log tail above the pinned checkpoint decree + watermarks.
+        The watermark snapshot is the only locked moment; the plog
+        replay runs lock-free (segments covering the pin are held by
+        gc_log's pin floor)."""
+        pin = self._learn_pin(learn_id)
+        with self._lock:
+            ballot, committed = self.ballot, self.last_committed
+        tail = list(self.plog.replay(pin["decree"]))
+        return {"tail": tail, "last_committed": committed, "ballot": ballot}
+
+    def finish_learn(self, learn_id: int) -> None:
+        """Release the learn pin (GC of the checkpoint + covered log
+        segments resumes). Idempotent; expiry covers a dead learner."""
+        with self._learn_lock:
+            pin = self._learn_pins.pop(learn_id, None)
+        if pin is not None:
+            self.server.engine.unpin_checkpoint(pin["decree"], pin["token"])
+
+    def _live_learn_pin_floor(self) -> int:
+        """Lowest pinned checkpoint decree (or a huge sentinel) — the
+        plog GC floor while learns are in flight; expired pins reaped."""
+        now = time.monotonic()
+        dead = []
+        with self._learn_lock:
+            for lid, pin in list(self._learn_pins.items()):
+                if now >= pin["expires"]:
+                    dead.append(self._learn_pins.pop(lid))
+            floor = min((p["decree"] for p in self._learn_pins.values()),
+                        default=None)
+        for pin in dead:
+            self.server.engine.unpin_checkpoint(pin["decree"], pin["token"])
+        return floor
+
+    def learn_state(self) -> dict:
+        """Learner-side learn snapshot (learn-status surface)."""
+        with self._lock:
+            return {"learning": self._learning, "status": self.status}
+
+    def learn_pins(self) -> list:
+        """Active primary-side learn pins (learn-status surface)."""
+        now = time.monotonic()
+        with self._learn_lock:
+            return [{"learn_id": lid, "decree": p["decree"],
+                     "expires_in_s": round(max(0.0, p["expires"] - now), 1)}
+                    for lid, p in self._learn_pins.items()]
 
     def fetch_learn_state(self) -> dict:
-        """Primary side of learn: checkpoint files + log tail + watermarks."""
-        with self._lock:
-            self.server.engine.sync_checkpoint()
-            ckpt = self.server.engine.get_checkpoint_dir()
+        """Legacy monolithic learn state (old peers; the bench's
+        monolithic A/B lane). Now pin-then-release: the checkpoint is
+        pinned and every file read runs with NO replica lock held, so a
+        learn can't stall this primary's prepare path for the duration
+        of a multi-MB read (ISSUE 13 satellite)."""
+        st = self.prepare_learn_state(have=(), delta=False)
+        lid = st["learn_id"]
+        try:
+            pin = self._learn_pin(lid, renew=False)
             files = []
-            for fname in sorted(os.listdir(ckpt)):
-                p = os.path.join(ckpt, fname)
-                if os.path.isfile(p):
-                    with open(p, "rb") as f:
-                        files.append((fname, f.read()))
-            tail = list(self.plog.replay(self.server.engine.last_durable_decree()))
-            return {"files": files, "tail": tail,
-                    "last_committed": self.last_committed, "ballot": self.ballot}
+            for e in st["blocks"]:
+                with open(os.path.join(pin["dir"], e["name"]), "rb") as f:
+                    files.append((e["name"], f.read()))
+            tail_state = self.fetch_learn_tail(lid)
+            return {"files": files, "tail": tail_state["tail"],
+                    "last_committed": tail_state["last_committed"],
+                    "ballot": tail_state["ballot"]}
+        finally:
+            self.finish_learn(lid)
 
     # ------------------------------------------------------------- plumbing
 
@@ -598,6 +874,12 @@ class Replica:
         if flush:
             self.server.engine.flush()
         floor = self.server.engine.last_durable_decree()
+        # active learn pins hold the log at their checkpoint decree: the
+        # learner's tail fetch replays (pin decree, ...] and a segment
+        # GC'd out from under it would open an unreplayable gap
+        pin_floor = self._live_learn_pin_floor()
+        if pin_floor is not None:
+            floor = min(floor, pin_floor)
         # Per dup entry the holdback decree is the freshest confirmed point
         # we know: our own shipper's progress when we run one (primary),
         # else the meta-confirmed decree the env carries (secondaries hold

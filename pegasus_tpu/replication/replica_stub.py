@@ -28,6 +28,12 @@ from .replica import GroupView, PRIMARY, PrepareRejected, Replica, ReplicaError
 
 RPC_PREPARE = "RPC_PREPARE"
 RPC_LEARN = "RPC_LEARN"
+# block-shipped learn plane (ISSUE 13): manifest-diff handshake, chunked
+# pinned-block fetch, log-tail pull, pin release
+RPC_LEARN_PREPARE = "RPC_LEARN_PREPARE"
+RPC_LEARN_FETCH = "RPC_LEARN_FETCH"
+RPC_LEARN_TAIL = "RPC_LEARN_TAIL"
+RPC_LEARN_FINISH = "RPC_LEARN_FINISH"
 RPC_REMOTE_COMMAND = "RPC_CLI_CLI_CALL"
 
 
@@ -112,6 +118,30 @@ class _RemotePeer:
             "ballot": resp.ballot,
         }
 
+    # block-shipped learn surface (ISSUE 13): one client implementation
+    # (learn.RemoteLearnSource) shared with the duplicator bootstrap —
+    # chunk fetches pipeline through call_many waves on the shard's
+    # dedicated connection
+    def _learn_source(self):
+        if getattr(self, "_learn_src", None) is None:
+            from .learn import RemoteLearnSource
+
+            self._learn_src = RemoteLearnSource(
+                self.stub.pool, self.addr, self.app_id, self.pidx)
+        return self._learn_src
+
+    def prepare_learn_state(self, have=None, delta=None) -> dict:
+        return self._learn_source().prepare_learn_state(have, delta)
+
+    def fetch_learn_chunks(self, learn_id, reqs) -> list:
+        return self._learn_source().fetch_learn_chunks(learn_id, reqs)
+
+    def fetch_learn_tail(self, learn_id) -> dict:
+        return self._learn_source().fetch_learn_tail(learn_id)
+
+    def finish_learn(self, learn_id) -> None:
+        self._learn_source().finish_learn(learn_id)
+
 
 class ReplicaStub:
     def __init__(self, root: str, meta_addrs, host: str = "127.0.0.1",
@@ -154,6 +184,10 @@ class ReplicaStub:
         self.rpc.register(RPC_BULK_LOAD, self._on_bulk_load)
         self.rpc.register(RPC_PREPARE, self._on_prepare)
         self.rpc.register(RPC_LEARN, self._on_learn)
+        self.rpc.register(RPC_LEARN_PREPARE, self._on_learn_prepare)
+        self.rpc.register(RPC_LEARN_FETCH, self._on_learn_fetch)
+        self.rpc.register(RPC_LEARN_TAIL, self._on_learn_tail)
+        self.rpc.register(RPC_LEARN_FINISH, self._on_learn_finish)
         from ..runtime.remote_command import RemoteCommandService
 
         self.commands = RemoteCommandService()
@@ -174,6 +208,7 @@ class ReplicaStub:
                                self._cmd_compact_sched_policy)
         self.commands.register("compact-sched-status",
                                self._cmd_compact_sched_status)
+        self.commands.register("learn-status", self._cmd_learn_status)
         self.rpc.register(RPC_REMOTE_COMMAND, self.commands.rpc_handler)
         self.rpc.start()
         self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
@@ -795,6 +830,80 @@ class ReplicaStub:
             tail=[codec.encode(m) for m in state["tail"]],
             last_committed=state["last_committed"], ballot=state["ballot"]))
 
+    # -------------------------------------------- block-shipped learn RPCs
+
+    def _learn_replica(self, req):
+        with self._lock:
+            return self._replicas.get((req.app_id, req.pidx))
+
+    def _on_learn_prepare(self, header, body) -> bytes:
+        from ..rpc import messages as rpc_msg
+
+        req = codec.decode(rpc_msg.LearnPrepareRequest, body)
+        rep = self._learn_replica(req)
+        if rep is None:
+            return codec.encode(rpc_msg.LearnPrepareResponse(
+                error=1, error_text="no_replica"))
+        try:
+            st = rep.prepare_learn_state(
+                have=[{"name": e.name, "size": e.size, "digest": e.digest}
+                      for e in req.have],
+                delta=req.delta)
+        except Exception as e:  # noqa: BLE001 - the learner retries
+            return codec.encode(rpc_msg.LearnPrepareResponse(
+                error=1, error_text=repr(e)))
+        return codec.encode(rpc_msg.LearnPrepareResponse(
+            learn_id=st["learn_id"], ckpt_decree=st["ckpt_decree"],
+            ballot=st["ballot"], last_committed=st["last_committed"],
+            blocks=[rpc_msg.LearnBlockEntry(e["name"], e["size"],
+                                            e["digest"])
+                    for e in st["blocks"]],
+            missing=st["missing"], digest=st["digest"],
+            digest_now=st["digest_now"], digest_pmask=st["digest_pmask"]))
+
+    def _on_learn_fetch(self, header, body) -> bytes:
+        from ..rpc import messages as rpc_msg
+
+        req = codec.decode(rpc_msg.LearnFetchRequest, body)
+        rep = self._learn_replica(req)
+        if rep is None:
+            return codec.encode(rpc_msg.LearnFetchResponse(
+                error=1, error_text="no_replica"))
+        try:
+            ch = rep.fetch_learn_block(req.learn_id, req.name, req.offset,
+                                       req.length)
+        except Exception as e:  # noqa: BLE001 - incl. expired pins
+            return codec.encode(rpc_msg.LearnFetchResponse(
+                error=1, error_text=repr(e)))
+        return codec.encode(rpc_msg.LearnFetchResponse(
+            data=ch["data"], crc=ch["crc"], total=ch["total"]))
+
+    def _on_learn_tail(self, header, body) -> bytes:
+        from ..rpc import messages as rpc_msg
+
+        req = codec.decode(rpc_msg.LearnTailRequest, body)
+        rep = self._learn_replica(req)
+        if rep is None:
+            return codec.encode(rpc_msg.LearnTailResponse(
+                error=1, error_text="no_replica"))
+        try:
+            st = rep.fetch_learn_tail(req.learn_id)
+        except Exception as e:  # noqa: BLE001
+            return codec.encode(rpc_msg.LearnTailResponse(
+                error=1, error_text=repr(e)))
+        return codec.encode(rpc_msg.LearnTailResponse(
+            tail=[codec.encode(m) for m in st["tail"]],
+            last_committed=st["last_committed"], ballot=st["ballot"]))
+
+    def _on_learn_finish(self, header, body) -> bytes:
+        from ..rpc import messages as rpc_msg
+
+        req = codec.decode(rpc_msg.LearnFinishRequest, body)
+        rep = self._learn_replica(req)
+        if rep is not None:
+            rep.finish_learn(req.learn_id)
+        return codec.encode(rpc_msg.LearnFetchResponse())
+
     def _on_cold_backup(self, header, body) -> bytes:
         """Checkpoint this partition, then upload through the block service
         (reference: copy_checkpoint_to_dir -> block service upload)."""
@@ -1043,6 +1152,32 @@ class ReplicaStub:
                          "pending_installs": debt["pending_installs"],
                          "ceiling_files": debt["ceiling_files"],
                          "node": self.address}
+        return json.dumps(out)
+
+    def _cmd_learn_status(self, args: list) -> str:
+        """learn-status — this process's block-ship totals (monotone, so
+        the chaos harness can counter-assert the ship path was used)
+        plus each hosted replica's learning flag and active primary-side
+        learn pins. Shape is group-router-merge-friendly: the flat
+        numeric `ship.*` totals SUM across worker processes and the
+        per-gpid `replica.*` dicts are disjoint."""
+        from ..runtime.perf_counters import counters
+
+        with self._lock:
+            targets = list(self._replicas.items())
+        out = {
+            "ship.blocks": counters.rate("learn.ship.blocks").total(),
+            "ship.bytes": counters.rate("learn.ship.bytes").total(),
+            "ship.delta_skipped_blocks": counters.rate(
+                "learn.ship.delta_skipped_blocks").total(),
+            "ship.replay_mutations": counters.rate(
+                "learn.replay.mutations").total(),
+        }
+        for (a, p), rep in targets:
+            ent = rep.learn_state()
+            ent["pins"] = rep.learn_pins()
+            ent["node"] = self.address
+            out[f"replica.{a}.{p}"] = ent
         return json.dumps(out)
 
     def _cmd_flush_log(self, args: list) -> str:

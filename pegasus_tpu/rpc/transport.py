@@ -203,6 +203,8 @@ class RpcServer:
     POOL_WORKERS = 16
     PRIORITY_CODES = frozenset({
         "RPC_PREPARE", "RPC_LEARN", "RPC_FD_FAILURE_DETECTOR_PING",
+        "RPC_LEARN_PREPARE", "RPC_LEARN_FETCH", "RPC_LEARN_TAIL",
+        "RPC_LEARN_FINISH",
         "RPC_CONFIG_PROPOSAL_OPEN_REPLICA",
         "RPC_CONFIG_PROPOSAL_CLOSE_REPLICA",
     })

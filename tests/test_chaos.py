@@ -319,4 +319,5 @@ def test_chaos_full_scenario_survives(tmp_path):
     assert doc["verdict"] == "healthy"
     armed = {e["action"] for e in j["events"] if e["kind"] == "fault.armed"}
     assert {"sched-defer-urgent", "dispatch-wedge", "split-double",
-            "kill-group", "primary-move", "kill-node"} <= armed
+            "kill-group", "primary-move", "kill-node",
+            "learn-ship-abort"} <= armed

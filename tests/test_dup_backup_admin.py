@@ -105,6 +105,52 @@ def test_duplication_lifecycle_between_clusters(two_clusters):
     cb.close()
 
 
+def test_duplicator_bootstrap_via_block_ship(two_clusters, tmp_path):
+    """ISSUE 13: a fresh remote cluster seeds by BLOCK SHIP — source
+    checkpoints stream (same pin/manifest/chunk protocol learners use)
+    into a bulk-load provider layout, the destination ingests them
+    replicated — and the cross-cluster decree-anchored audit is still
+    conclusive (and matching) after the bootstrap + a live dup leg."""
+    from pegasus_tpu.collector.cluster_doctor import run_cross_cluster_audit
+    from pegasus_tpu.replication.bootstrap import bootstrap_remote_cluster
+
+    a, b = two_clusters
+    ca = make_client(a, app="bs", partitions=2)
+    cb = make_client(b, app="bs", partitions=2)
+    for i in range(60):
+        ca.set(b"bk%03d" % i, b"s", b"bv%d" % i)
+    # durable SSTs on the source so the checkpoints carry the history
+    for stub in a.nodes.values():
+        for rep in list(stub._replicas.values()):
+            rep.server.engine.flush()
+    stats = bootstrap_remote_cluster(
+        [a.meta_addr], [b.meta_addr], "bs",
+        provider_root=str(tmp_path / "provider"))
+    assert stats["partitions"] == 2
+    assert stats["blocks"] > 0 and stats["bytes"] > 0
+    assert stats["ingested_records"] == 60
+    # the bootstrap alone (no duplication yet) delivered the history
+    assert all(cb.get(b"bk%03d" % i, b"s") == b"bv%d" % i
+               for i in range(60))
+    # a re-run is delta/resume: the provider dir already holds the
+    # blocks, so nothing re-ships
+    stats2 = bootstrap_remote_cluster(
+        [a.meta_addr], [b.meta_addr], "bs",
+        provider_root=str(tmp_path / "provider"))
+    assert stats2["blocks"] == 0 and stats2["resumed"] > 0
+    # now the live leg: dup ships the post-bootstrap window
+    assert "succeed" in shell_run(a, "add_dup bs west")
+    for i in range(60, 80):
+        ca.set(b"bk%03d" % i, b"s", b"bv%d" % i)
+    assert wait_until(lambda: all(
+        cb.get(b"bk%03d" % i, b"s") == b"bv%d" % i for i in range(60, 80)))
+    x = run_cross_cluster_audit([a.meta_addr], [b.meta_addr], "bs")
+    assert x["match"] is True, x
+    assert x["src"]["records"] == x["dst"]["records"] > 0
+    ca.close()
+    cb.close()
+
+
 def test_duplication_freeze_then_start(two_clusters):
     a, b = two_clusters
     ca = make_client(a, app="fz", partitions=1)

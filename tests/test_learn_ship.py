@@ -1,0 +1,475 @@
+"""Block-shipped learning (ISSUE 13): byte-identity across learn paths,
+block-granular resume after a mid-ship kill, GC/unlink holds while a
+checkpoint is pinned, the digest proof failing loudly, and the streamed
+re-seed over real sockets with bounded chunks.
+
+Every learn here ends with the PR 8 decree-anchored digest compared
+against the primary at equal decrees — a transfer that loses bytes must
+fail these tests, not pass as a faster learn.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from pegasus_tpu.base.utils import epoch_now
+from pegasus_tpu.engine import EngineOptions
+from pegasus_tpu.engine.server_impl import RPC_MULTI_PUT
+from pegasus_tpu.replication.replica import (GroupView, PrepareRejected,
+                                             Replica, ReplicaError)
+from pegasus_tpu.replication.mutation_log import LogMutation
+from pegasus_tpu.rpc import messages as msg
+from pegasus_tpu.runtime.perf_counters import counters
+
+
+def _opts(**kw):
+    """Many small SSTs (no L0 merge) so the block manifest has real
+    granularity for delta/resume assertions."""
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("memtable_bytes", 32 << 10)
+    kw.setdefault("l0_compaction_trigger", 100)
+    return EngineOptions(**kw)
+
+
+def _mk_primary(root, n=1500, **okw):
+    prim = Replica("prim", str(root / "prim"), options=_opts(**okw),
+                   quorum=1)
+    prim.assume_view(GroupView(1, "prim", []))
+    _load(prim, 0, n)
+    prim.server.engine.flush()
+    return prim
+
+
+def _load(prim, lo, hi):
+    for base in range(lo, hi, 50):
+        kvs = [msg.KeyValue(b"s%06d" % i, b"v%04d" % (i % 7919) + b"x" * 30)
+               for i in range(base, min(base + 50, hi))]
+        prim.client_write(RPC_MULTI_PUT, msg.MultiPutRequest(
+            hash_key=b"h%03d" % (base % 31), kvs=kvs))
+
+
+def _learner(root, name, **okw):
+    return Replica(name, str(root / name), options=_opts(**okw), quorum=1)
+
+
+def _totals():
+    return {k: counters.rate("learn.ship." + k).total()
+            for k in ("blocks", "bytes", "delta_skipped_blocks")}
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in before}
+
+
+def _assert_identical(prim, learner, now):
+    assert learner.last_committed == prim.last_committed
+    a = prim.server.engine.state_digest(now=now)
+    b = learner.server.engine.state_digest(now=now)
+    assert a["digest"] == b["digest"], "post-learn digest diverged"
+    assert a["records"] == b["records"] > 0
+
+
+class _MonolithicPeer:
+    """Only the legacy surface: forces learn_from down the monolithic
+    whole-state path against the same primary."""
+
+    def __init__(self, prim):
+        self.prim = prim
+
+    def fetch_learn_state(self):
+        return self.prim.fetch_learn_state()
+
+
+# ------------------------------------------------------ byte identity
+
+
+def test_full_delta_and_monolithic_learns_are_byte_identical(tmp_path):
+    """The three learn paths produce identical engine digests at equal
+    decrees, and the delta re-learn moves >=5x fewer bytes than either
+    full path (the acceptance ratio) while skipping the blocks the
+    learner already held."""
+    prim = _mk_primary(tmp_path, n=1500)
+    now = epoch_now()
+    try:
+        t0 = _totals()
+        mono = _learner(tmp_path, "mono")
+        mono.learn_from(_MonolithicPeer(prim))
+        t1 = _totals()
+        _assert_identical(prim, mono, now)
+        mono_bytes = _delta(t0, t1)["bytes"]
+        assert mono_bytes > 0
+
+        full = _learner(tmp_path, "full")
+        full.learn_from(prim)
+        t2 = _totals()
+        _assert_identical(prim, full, now)
+        d_full = _delta(t1, t2)
+        assert d_full["bytes"] > 0 and d_full["blocks"] > 1
+        # fresh learner: nothing to delta-skip
+        assert d_full["delta_skipped_blocks"] == 0
+
+        # small burst, then RE-learn the same learner: it already holds
+        # every old SST, so only the new blocks (+ manifest) ship
+        _load(prim, 1500, 1700)
+        prim.server.engine.flush()
+        now2 = epoch_now()
+        full.learn_from(prim)
+        t3 = _totals()
+        _assert_identical(prim, full, now2)
+        d_delta = _delta(t2, t3)
+        assert d_delta["delta_skipped_blocks"] > 0, \
+            "delta learn re-shipped blocks the learner already held"
+        assert d_delta["bytes"] * 5 <= mono_bytes, (
+            f"delta learn moved {d_delta['bytes']}B, monolithic "
+            f"{mono_bytes}B — not the >=5x win")
+        assert d_delta["bytes"] * 5 <= d_full["bytes"]
+    finally:
+        for r in (prim, mono, full):
+            r.close()
+
+
+def test_delta_kill_switch_ships_everything(tmp_path, monkeypatch):
+    """PEGASUS_LEARN_DELTA=0 must disable the delta for REAL: a
+    re-learn of a learner that already holds every block still
+    re-fetches all of them (counter-asserted, not just the advisory
+    missing list), and the handshake's missing diff reflects the
+    switch both ways."""
+    from pegasus_tpu.replication.learn import dir_manifest
+
+    prim = _mk_primary(tmp_path, n=500)
+    lrn = _learner(tmp_path, "lrn")
+    try:
+        lrn.learn_from(prim)  # learner now holds every block
+        t0 = _totals()
+        monkeypatch.setenv("PEGASUS_LEARN_DELTA", "0")
+        lrn.learn_from(prim)  # kill switch: nothing reused, all fetched
+        d = _delta(t0, _totals())
+        assert d["delta_skipped_blocks"] == 0, \
+            "kill switch left the delta reuse path active"
+        assert d["blocks"] > 1 and d["bytes"] > 0
+        _assert_identical(prim, lrn, epoch_now())
+        monkeypatch.delenv("PEGASUS_LEARN_DELTA")
+        # handshake diff: delta=False ignores the have-set entirely
+        prim.server.engine.sync_checkpoint()
+        have = dir_manifest(prim.server.engine.get_checkpoint_dir())
+        st = prim.prepare_learn_state(have=have, delta=False)
+        try:
+            assert st["missing"] == [e["name"] for e in st["blocks"]]
+        finally:
+            prim.finish_learn(st["learn_id"])
+        st2 = prim.prepare_learn_state(have=have, delta=True)
+        try:
+            assert st2["missing"] == []  # everything digest-matched
+        finally:
+            prim.finish_learn(st2["learn_id"])
+    finally:
+        prim.close()
+        lrn.close()
+
+
+# ------------------------------------------------- mid-ship kill + resume
+
+
+class _FlakyPeer:
+    """Drops the connection after N block waves on the FIRST attempt —
+    the mid-ship learner-kill stand-in."""
+
+    def __init__(self, prim, fail_after_blocks):
+        self.prim = prim
+        self.fail_after = fail_after_blocks
+        self.calls = 0
+        self.armed = True
+
+    def prepare_learn_state(self, have=None, delta=None):
+        return self.prim.prepare_learn_state(have=have, delta=delta)
+
+    def fetch_learn_chunks(self, learn_id, reqs):
+        self.calls += 1
+        if self.armed and self.calls > self.fail_after:
+            raise ConnectionError("mid-ship drop")
+        return self.prim.fetch_learn_chunks(learn_id, reqs)
+
+    def fetch_learn_tail(self, learn_id):
+        return self.prim.fetch_learn_tail(learn_id)
+
+    def finish_learn(self, learn_id):
+        self.prim.finish_learn(learn_id)
+
+
+def test_mid_ship_kill_resumes_at_block_granularity(tmp_path):
+    """A learn dropped mid-ship leaves the partition re-learnable, and
+    the retry fetches ONLY the blocks the first attempt did not land —
+    counter-asserted."""
+    prim = _mk_primary(tmp_path, n=1200)
+    now = epoch_now()
+    lrn = _learner(tmp_path, "lrn")
+    try:
+        flaky = _FlakyPeer(prim, fail_after_blocks=3)
+        t0 = _totals()
+        with pytest.raises(ConnectionError):
+            lrn.learn_from(flaky)
+        t1 = _totals()
+        first = _delta(t0, t1)
+        assert first["blocks"] == 3  # three blocks landed before the drop
+        assert not prim.learn_pins(), "failed learn leaked its pin"
+        # partition is re-learnable; the resume skips the landed blocks
+        flaky.armed = False
+        lrn.learn_from(flaky)
+        t2 = _totals()
+        second = _delta(t1, t2)
+        _assert_identical(prim, lrn, now)
+        assert second["delta_skipped_blocks"] >= 3, \
+            "resume re-fetched blocks the first attempt already landed"
+        total_blocks = len(os.listdir(os.path.join(prim.path, "data"))) \
+            - len([n for n in os.listdir(os.path.join(prim.path, "data"))
+                   if not n.endswith(".sst") and n != "MANIFEST"])
+        assert second["blocks"] + first["blocks"] \
+            + second["delta_skipped_blocks"] >= total_blocks
+    finally:
+        prim.close()
+        lrn.close()
+
+
+def test_mid_ship_fail_point_aborts_then_resumes(tmp_path):
+    """The chaos seam: `learn.ship` armed with raise() aborts a learn
+    mid-ship; healing it lets the SAME learner finish via resume."""
+    from pegasus_tpu.runtime import fail_points as fp
+
+    prim = _mk_primary(tmp_path, n=800)
+    now = epoch_now()
+    lrn = _learner(tmp_path, "lrn")
+    fp.setup()
+    try:
+        # the learner-side hook fires once per block: let 2 pass, then
+        # kill every later fetch for the first attempt
+        fp.cfg("learn.ship", "2*off()")
+        fp.cfg("learn.ship", "off()")
+        fp.cfg("learn.ship", "100%raise(chaos)")
+        with pytest.raises((ConnectionError, ReplicaError, Exception)):
+            lrn.learn_from(prim)
+        fp.cfg("learn.ship", "off()")
+        lrn.learn_from(prim)
+        _assert_identical(prim, lrn, now)
+    finally:
+        fp.teardown()
+        prim.close()
+        lrn.close()
+
+
+# ------------------------------------------------------- pin semantics
+
+
+def test_gc_and_log_held_while_checkpoint_pinned(tmp_path):
+    """While a learn pin is live: checkpoint GC must not drop the pinned
+    dir (no dangling block fetch) and plog GC must not drop segments
+    above the pinned decree (the tail fetch must stay replayable).
+    Releasing the pin restores both."""
+    prim = _mk_primary(tmp_path, n=600,
+                       checkpoint_reserve_min_count=1)
+    prim.plog.segment_bytes = 2048  # roll segments fast so GC has prey
+    try:
+        st = prim.prepare_learn_state(have=())
+        lid, pinned_decree = st["learn_id"], st["ckpt_decree"]
+        eng = prim.server.engine
+        pinned_dir = eng.get_checkpoint_dir(pinned_decree)
+        # advance the world: more writes, newer checkpoints, GC rounds
+        _load(prim, 600, 1200)
+        prim.server.engine.flush()
+        eng.sync_checkpoint()
+        assert pinned_decree in eng.pinned_checkpoints()
+        assert os.path.isdir(pinned_dir), \
+            "checkpoint GC dropped a pinned checkpoint"
+        prim.gc_log(flush=True)
+        tail = [m.decree for m in prim.plog.replay(pinned_decree)]
+        assert tail and tail[0] == pinned_decree + 1, \
+            "plog GC opened a gap above the pinned checkpoint decree"
+        # fetches still serve from the pinned dir
+        entry = next(e for e in st["blocks"] if e["name"] != "MANIFEST")
+        ch = prim.fetch_learn_block(lid, entry["name"], 0, entry["size"])
+        assert len(ch["data"]) == entry["size"]
+        # release: GC reclaims on the next rounds
+        prim.finish_learn(lid)
+        assert pinned_decree not in eng.pinned_checkpoints()
+        eng.sync_checkpoint()  # runs gc_checkpoints with the pin gone
+        assert not os.path.isdir(pinned_dir)
+        with pytest.raises(ReplicaError):
+            prim.fetch_learn_block(lid, entry["name"], 0, 16)
+    finally:
+        prim.close()
+
+
+def test_expired_pin_is_reaped_and_fetch_fails_loudly(tmp_path, monkeypatch):
+    monkeypatch.setenv("PEGASUS_LEARN_PIN_TTL_S", "0.05")
+    prim = _mk_primary(tmp_path, n=300)
+    try:
+        st = prim.prepare_learn_state(have=())
+        time.sleep(0.1)
+        with pytest.raises(ReplicaError):
+            prim.fetch_learn_block(st["learn_id"], st["blocks"][0]["name"],
+                                   0, 16)
+        prim.gc_log()  # reaps the expired pin
+        assert not prim.learn_pins()
+        assert not prim.server.engine.pinned_checkpoints()
+    finally:
+        prim.close()
+
+
+# --------------------------------------------------- digest proof + locks
+
+
+class _TamperingPeer:
+    """Corrupts the handshake digest: the learn must fail loudly, never
+    silently serve."""
+
+    def __init__(self, prim):
+        self.prim = prim
+
+    def prepare_learn_state(self, have=None, delta=None):
+        st = self.prim.prepare_learn_state(have=have, delta=delta)
+        st["digest"] = "0" * 32
+        return st
+
+    def fetch_learn_chunks(self, learn_id, reqs):
+        return self.prim.fetch_learn_chunks(learn_id, reqs)
+
+    def fetch_learn_tail(self, learn_id):
+        return self.prim.fetch_learn_tail(learn_id)
+
+    def finish_learn(self, learn_id):
+        self.prim.finish_learn(learn_id)
+
+
+def test_digest_mismatch_fails_learn_loudly(tmp_path):
+    prim = _mk_primary(tmp_path, n=400)
+    lrn = _learner(tmp_path, "lrn")
+    try:
+        with pytest.raises(ReplicaError, match="digest mismatch"):
+            lrn.learn_from(_TamperingPeer(prim))
+        assert lrn.status != "SECONDARY"  # never silently serves
+        lrn.learn_from(prim)  # honest retry succeeds
+        _assert_identical(prim, lrn, epoch_now())
+    finally:
+        prim.close()
+        lrn.close()
+
+
+def test_learning_replica_rejects_prepares(tmp_path):
+    """Mid-learn (lock RELEASED while staging), prepares are rejected
+    instead of interleaving with the state about to be swapped in."""
+    rep = _learner(tmp_path, "rep")
+    try:
+        with rep._lock:
+            rep._learning = True
+        m = LogMutation(decree=1, ballot=1, codes=["RPC_RRDB_RRDB_PUT"],
+                        bodies=[b"x"])
+        with pytest.raises(PrepareRejected) as ei:
+            rep.on_prepare_batch(1, [m], 0)
+        assert ei.value.reason == "learning"
+        with rep._lock:
+            rep._learning = False
+        assert rep.on_prepare_batch(1, [m], 0) == 1
+    finally:
+        rep.close()
+
+
+def test_fetch_learn_state_reads_outside_replica_lock(tmp_path):
+    """Satellite 1 regression: the legacy monolithic state fetch must
+    not hold the replica lock across its file reads — a concurrent
+    lock acquisition must succeed while the fetch is mid-read."""
+    import threading
+
+    prim = _mk_primary(tmp_path, n=1000)
+    try:
+        locked_during_fetch = []
+        stop = threading.Event()
+
+        def prober():
+            while not stop.is_set():
+                got = prim._lock.acquire(timeout=0.02)
+                if got:
+                    prim._lock.release()
+                locked_during_fetch.append(got)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=prober)
+        t.start()
+        try:
+            for _ in range(3):
+                state = prim.fetch_learn_state()
+                assert state["files"]
+        finally:
+            stop.set()
+            t.join()
+        # the lock was acquirable essentially throughout (the watermark
+        # snapshot is the only locked moment)
+        assert locked_during_fetch and \
+            sum(locked_during_fetch) >= len(locked_during_fetch) * 0.8
+    finally:
+        prim.close()
+
+
+# ----------------------------------------------------- over real sockets
+
+
+def test_rpc_reseed_uses_block_ship_and_learn_status(tmp_path, monkeypatch):
+    """A replacement node re-seeds over real sockets with bounded chunks
+    (4 KiB: every block is a multi-chunk call_many wave), the learn-status
+    remote command reports the ship totals the chaos harness asserts on,
+    and the rebuilt replica digests identically to its primary."""
+    monkeypatch.setenv("PEGASUS_LEARN_CHUNK_BYTES", "4096")
+    from pegasus_tpu.collector.cluster_doctor import ClusterCaller
+    from pegasus_tpu.replication.replica_stub import ReplicaStub
+    from tests.test_cluster import Cluster, make_client
+
+    c = Cluster(tmp_path, n_nodes=3)
+    caller = None
+    try:
+        cli = make_client(c, app="ls", partitions=2)
+        for i in range(250):
+            cli.set(b"k%04d" % i, b"s", b"v%d" % i)
+        for stub in c.nodes.values():
+            for rep in list(stub._replicas.values()):
+                rep.server.engine.flush()
+        victim = sorted(c.nodes)[0]
+        c.kill_node(victim)
+        for i in range(250, 320):
+            cli.set(b"k%04d" % i, b"s", b"v%d" % i)
+        fresh = ReplicaStub(
+            str(tmp_path / "node_new"), [c.meta_addr],
+            options_factory=lambda: EngineOptions(backend="cpu"),
+            cluster_id=1).start(beacon_interval=0.2)
+        c.nodes[fresh.address] = fresh
+        time.sleep(0.3)  # a beacon must land before repair sees the node
+        assert c.meta.repair_under_replication() > 0
+        assert fresh._replicas, "repair seeded no replica on the new node"
+        caller = ClusterCaller([c.meta_addr])
+        out = json.loads(caller.remote_command(fresh.address,
+                                               "learn-status", []))
+        assert out["ship.blocks"] + out["ship.delta_skipped_blocks"] > 0
+        assert out["ship.bytes"] > 0
+        for key, ent in out.items():
+            if key.startswith("replica."):
+                assert ent["learning"] is False
+        # the rebuilt replicas are byte-consistent with their primaries
+        now = epoch_now()
+        for (a, p), rep in fresh._replicas.items():
+            src = next(r for stub in c.nodes.values()
+                       for (a2, p2), r in stub._replicas.items()
+                       if (a2, p2) == (a, p) and r.status == "PRIMARY")
+            src.broadcast_commit_point()
+
+            def caught_up(rep=rep, src=src):
+                return rep.last_committed == src.last_committed
+            deadline = time.time() + 10
+            while not caught_up() and time.time() < deadline:
+                time.sleep(0.05)
+            assert caught_up()
+            assert rep.server.engine.state_digest(now=now)["digest"] \
+                == src.server.engine.state_digest(now=now)["digest"]
+        cli.close()
+    finally:
+        if caller is not None:
+            caller.close()
+        c.stop()
