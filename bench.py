@@ -1119,6 +1119,113 @@ def learn_main():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _offload_params():
+    """(records, runs, value_size) for PEGASUS_BENCH_MODE=offload."""
+    return (int(os.environ.get("PEGASUS_BENCH_OFFLOAD_RECORDS", 200_000)),
+            4, int(os.environ.get("PEGASUS_BENCH_VALUE", 100)))
+
+
+def _offload_metric_name() -> str:
+    records, n_runs, value_size = _offload_params()
+    return (f"compaction offload: remote-vs-local wall ratio "
+            f"({records} records, {n_runs} runs, value={value_size}B)")
+
+
+def _offload_degraded(reason: str, detail: dict = None) -> dict:
+    d = {"degraded": True, "reason": reason}
+    d.update(detail or {})
+    return {"metric": _offload_metric_name(), "value": None, "unit": "x",
+            "vs_baseline": None, "detail": d}
+
+
+def offload_main():
+    """PEGASUS_BENCH_MODE=offload: the rack-scale compaction-offload
+    artifact (ISSUE 14) — the same merge run locally on cpu and through
+    an in-process CompactOffloadService over real sockets, all on CPU
+    (no TPU lease needed): wall clock for both lanes, bytes shipped and
+    fetched, and the per-stage breakdown (offload.ship / offload.merge /
+    offload.fetch spans). Byte identity between the lanes is asserted —
+    a transfer that changes bytes must fail the bench, not report a
+    speed — and a round the lane guard had to serve via the LOCAL cpu
+    fallback reports a degraded line (the number would not be an offload
+    measurement). One JSON line, learn-mode semantics."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _enable_compile_cache()
+    import shutil
+    import tempfile
+
+    from pegasus_tpu.ops.compact import CompactOptions, compact_blocks
+    from pegasus_tpu.replication.compact_offload import (
+        OFFLOAD_LANE_GUARD, CompactOffloadService, offload_compact_blocks)
+    from pegasus_tpu.runtime.perf_counters import counters
+    from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+
+    records, n_runs, value_size = _offload_params()
+    host_start = _host_info()
+    runs, fill_s = _fill(records, n_runs, value_size)
+    opts = CompactOptions(backend="cpu", now=100, bottommost=True,
+                          runs_sorted=True)
+    tmp = tempfile.mkdtemp(prefix="pegasus_offload_bench_")
+    svc = None
+    try:
+        t0 = time.perf_counter()
+        local = compact_blocks(runs, opts)
+        local_s = time.perf_counter() - t0
+        local_digest = _out_digest(local.block)
+
+        svc = CompactOffloadService(tmp, backend="cpu").start()
+        OFFLOAD_LANE_GUARD.reset()
+
+        def totals():
+            return {k: counters.rate(f"offload.client.{k}").total()
+                    for k in ("ship_bytes", "fetch_bytes", "ship_blocks",
+                              "skipped_blocks")}
+
+        before = totals()
+        with COMPACT_TRACER.session() as sess:
+            t0 = time.perf_counter()
+            remote = offload_compact_blocks(runs, opts, svc.address,
+                                            tenant="bench")
+            offload_s = time.perf_counter() - t0
+        after = totals()
+        remote_digest = _out_digest(remote.block)
+        lane = OFFLOAD_LANE_GUARD.state()
+        detail = {
+            "records": records, "n_runs": n_runs,
+            "value_bytes": value_size, "fill_s": round(fill_s, 2),
+            "local_compact_s": round(local_s, 3),
+            "offload_compact_s": round(offload_s, 3),
+            "shipped_bytes": after["ship_bytes"] - before["ship_bytes"],
+            "fetched_bytes": after["fetch_bytes"] - before["fetch_bytes"],
+            "shipped_runs": after["ship_blocks"] - before["ship_blocks"],
+            "service": svc.status(),
+            "lane": lane,
+            "trace": sess.summary(),
+            "host": {"start": host_start, "end": _host_info()},
+        }
+        if lane["fallbacks"]:
+            # the guard served this merge via the LOCAL cpu path: the
+            # wall number is not an offload measurement
+            _emit(_offload_degraded(
+                f"offload lane fell back to local cpu "
+                f"({lane['last_fallback']})", detail=detail))
+            return
+        if remote_digest != local_digest:
+            _emit(_offload_degraded(
+                "offloaded output diverges from local compaction "
+                f"(local {local_digest} vs remote {remote_digest})",
+                detail=detail))
+            return
+        detail["byte_equal"] = True
+        _emit({"metric": _offload_metric_name(),
+               "value": round(offload_s / local_s, 3), "unit": "x",
+               "vs_baseline": None, "detail": detail})
+    finally:
+        if svc is not None:
+            svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     _arm_watchdog()
     n_total, n_runs, value_size, reps = _bench_params()
@@ -1239,6 +1346,9 @@ if __name__ == "__main__":
         elif _mode == "learn":
             _arm_watchdog()
             learn_main()
+        elif _mode == "offload":
+            _arm_watchdog()
+            offload_main()
         else:
             main()
     except Exception as e:  # noqa: BLE001 - the driver needs a JSON line, always
@@ -1250,6 +1360,8 @@ if __name__ == "__main__":
                 _emit(_ycsb_degraded(f"bench crashed: {e!r}"))
             elif _mode == "learn":
                 _emit(_learn_degraded(f"bench crashed: {e!r}"))
+            elif _mode == "offload":
+                _emit(_offload_degraded(f"bench crashed: {e!r}"))
             else:
                 n_total, n_runs, value_size, _ = _bench_params()
                 _emit(_degraded(n_total, n_runs, value_size,

@@ -371,6 +371,41 @@ class BalanceActor(FaultActor):
             and _fully_replicated(self.cluster, self.caller)
 
 
+class OffloadServiceKill(FaultActor):
+    """Hard-stop the rack's compaction-offload service mid-merge
+    (ISSUE 14): every cpu-only node whose placement lease still names it
+    must degrade through the offload lane guard to its LOCAL cpu merge —
+    byte-identical by construction, never a stall, zero lost acked
+    writes — then pick the service back up when it restarts. `ctl` is a
+    handle with ``stop()`` / ``restart()`` / ``address`` (the harness's
+    in-process service or a process wrapper); recovered() = the service
+    answers ``offload-status`` on its address again."""
+
+    def __init__(self, ctl, caller=None):
+        self.ctl = ctl
+        self.caller = caller
+
+    def arm(self):
+        self.ctl.stop()
+
+    def heal(self):
+        self.ctl.restart()
+
+    def recovered(self) -> bool:
+        from ..collector.cluster_doctor import ClusterCaller
+
+        caller = self.caller or ClusterCaller([])
+        try:
+            out = caller.remote_command(self.ctl.address, "offload-status",
+                                        [])
+            return bool(json.loads(out).get("address"))
+        except (RpcError, OSError, ValueError):
+            return False
+        finally:
+            if self.caller is None:
+                caller.close()
+
+
 class SchedFlipActor(FaultActor):
     """Compaction-scheduler token flips: deliver DEFER tokens for every
     partition of the app at arm (the engines hold elective L0 merges),

@@ -229,6 +229,7 @@ A_NODE_KILL = "node_kill"
 A_SPLIT = "split"
 A_BALANCE = "balance"
 A_SCHED = "sched_flip"
+A_OFFLOAD = "offload_kill"
 
 
 def smoke_scenario() -> Scenario:
@@ -282,4 +283,22 @@ def full_scenario() -> Scenario:
     ])
 
 
-SCENARIOS = {"smoke": smoke_scenario, "full": full_scenario}
+def offload_scenario() -> Scenario:
+    """Rack-scale offload leg (ISSUE 14), for a harness that wired an
+    offload service + placements: a `compact.offload` wire wedge, then
+    a hard service kill mid-merge — both windows must close with the
+    nodes' offload lane having degraded to byte-identical local cpu
+    merges (zero lost acked writes; the driving test compares post-run
+    digests against an un-offloaded control)."""
+    return Scenario("offload", [
+        FaultAction("offload-wire-wedge", A_FAILPOINT, at_s=1.0,
+                    duration_s=3.0, recovery_deadline_s=10.0, settle_s=1.0,
+                    args={"point": "compact.offload",
+                          "action": "3*sleep(100)"}),
+        FaultAction("kill-offload-service", A_OFFLOAD, at_s=5.0,
+                    duration_s=4.0, recovery_deadline_s=20.0, settle_s=2.0),
+    ])
+
+
+SCENARIOS = {"smoke": smoke_scenario, "full": full_scenario,
+             "offload": offload_scenario}

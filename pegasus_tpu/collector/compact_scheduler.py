@@ -90,11 +90,110 @@ def _knobs() -> dict:
         # decision lease: engines revert to local triggers this many
         # seconds after the last delivery
         "ttl_s": float(os.environ.get("PEGASUS_SCHED_TTL_S", "30")),
+        # compaction-offload placement (ISSUE 14): the rack's device-
+        # owning compaction services; each tick scrapes their free merge
+        # budget and the fold assigns (when, where) pairs against it
+        "offload_services": [s.strip() for s in os.environ.get(
+            "PEGASUS_OFFLOAD_SERVICES", "").split(",") if s.strip()],
+        # feedback tuning (ISSUE 14 satellite): PEGASUS_SCHED_AUTOTUNE=1
+        # replaces the static urgent thresholds with ones tuned from the
+        # measured compact.stage.* durations (EWMA over the nodes'
+        # metric-history rings)
+        "autotune": os.environ.get("PEGASUS_SCHED_AUTOTUNE", "") == "1",
+        "tune_alpha": float(os.environ.get("PEGASUS_SCHED_TUNE_ALPHA",
+                                           "0.3")),
+        "tune_slow_us": float(os.environ.get("PEGASUS_SCHED_TUNE_SLOW_US",
+                                             "2000000")),
+        "tune_fast_us": float(os.environ.get("PEGASUS_SCHED_TUNE_FAST_US",
+                                             "250000")),
     }
 
 
+# the stage series the feedback tuner folds: one whole-merge cost is
+# (approximately) the sum of the per-stage p99s a node's metric-history
+# ring sampled in the window
+_STAGE_SERIES = tuple(f"compact.stage.{s}.duration_us.p99"
+                      for s in ("pack", "h2d", "device", "gather",
+                                "sst_write"))
+
+
+def stage_cost_us(window: dict) -> float:
+    """Worst observed whole-merge stage cost in one metrics-history
+    window (``{"samples": [{"ts", "values": {...}}]}``): per sample the
+    compact.stage.* duration p99s sum to ~one merge's wall cost; the max
+    over the window is the recent worst. 0.0 = no compaction ran."""
+    worst = 0.0
+    for s in window.get("samples", ()):
+        vals = s.get("values", {})
+        worst = max(worst, sum(float(vals.get(k, 0.0))
+                               for k in _STAGE_SERIES))
+    return worst
+
+
+def tune_knobs(ewma_us: float, knobs: dict) -> tuple:
+    """Feedback-tune the fold's urgency thresholds from the measured
+    merge cost (EWMA of stage_cost_us across ticks). Pure. Rationale:
+    expensive merges (slow device/tunnel, big partitions) amortize their
+    fixed cost over more debt — promote LATER (doubled thresholds);
+    cheap merges should keep read amplification low — promote EARLIER
+    (halved thresholds, floored). -> (tuned knobs, report dict)."""
+    k = dict(knobs)
+    if ewma_us >= k["tune_slow_us"]:
+        mode = "slow_merges"
+        k["urgent_l0"] = k["urgent_l0"] * 2
+        k["backlog_urgent"] = k["backlog_urgent"] * 2
+    elif 0.0 < ewma_us <= k["tune_fast_us"]:
+        mode = "fast_merges"
+        k["urgent_l0"] = max(2, k["urgent_l0"] // 2)
+        k["backlog_urgent"] = max(8, k["backlog_urgent"] // 2)
+    else:
+        mode = "base"
+    return k, {"ewma_us": round(ewma_us, 1), "mode": mode,
+               "urgent_l0": k["urgent_l0"],
+               "backlog_urgent": k["backlog_urgent"]}
+
+
+def assign_placements(decisions: dict, places: dict,
+                      weights: dict = None) -> dict:
+    """The WHERE half of the fold (ISSUE 14): hand each service's free
+    merge budget to the partitions that need compaction most. Pure and
+    deterministic: non-defer partitions with debt, highest debt first,
+    fill the service with the most remaining slots (address tie-break);
+    everyone else keeps ``where == ""`` (compact locally). ``weights``
+    ({gpid: replica count, default 1}) sizes each placement honestly:
+    the token is delivered to EVERY replica of the partition and each
+    compacts independently, so one placement can present up to
+    replica-count concurrent merges at the service — it is charged
+    min(weight, remaining) slots (never refused outright: the budget is
+    advisory, the service's admission gate is the hard bound). Mutates
+    and returns `decisions` (each entry gains "where")."""
+    free = {a: max(0, int(n)) for a, n in (places or {}).items()}
+    weights = weights or {}
+    for d in decisions.values():
+        d.setdefault("where", "")
+    if not free:
+        return decisions
+    order = sorted(
+        (g for g, d in decisions.items()
+         if d["policy"] != "defer"
+         and (d["l0_files"] > 0 or d["debt_bytes"] > 0)),
+        key=lambda g: (decisions[g]["debt_bytes"],
+                       decisions[g]["l0_files"], g),
+        reverse=True)
+    for g in order:
+        addr = sorted(free, key=lambda a: (-free[a], a))[0]
+        if free[addr] <= 0:
+            break
+        free[addr] -= min(max(1, int(weights.get(g, 1))), free[addr])
+        decisions[g]["where"] = addr
+        decisions[g]["reasons"] = list(decisions[g]["reasons"]) \
+            + ["offload_budget"]
+    return decisions
+
+
 def fold_decisions(parts: dict, hot=(), slow_count: int = 0,
-                   knobs: dict = None) -> dict:
+                   knobs: dict = None, places: dict = None,
+                   weights: dict = None) -> dict:
     """The deterministic CLUSTER-level decision fold — what each
     partition needs, independent of which node serves it. Pure: no RPC,
     no clock. Per-NODE bounding (breaker-open skip, the urgent budget)
@@ -106,8 +205,12 @@ def fold_decisions(parts: dict, hot=(), slow_count: int = 0,
     "pending_installs", "apply_gap", "ceiling_files"}} — the primary's
     beacon-reported debt/lag state. ``hot``: gpids with a confirmed
     read-hot verdict. ``slow_count``: size of the cluster slow-request
-    rollup. -> {gpid: {"policy", "reasons", "node", "l0_files",
-    "debt_bytes"}}."""
+    rollup. ``places``: {offload service addr: free merge slots} — when
+    given, the fold also decides WHERE (ISSUE 14): the debtiest
+    non-defer partitions are placed onto services with free device
+    budget (``assign_placements``), so each decision is a (when, where)
+    pair. -> {gpid: {"policy", "reasons", "node", "l0_files",
+    "debt_bytes", "where"}}."""
     k = dict(_knobs(), **(knobs or {}))
     hot = set(hot)
     out = {}
@@ -138,7 +241,7 @@ def fold_decisions(parts: dict, hot=(), slow_count: int = 0,
         out[gpid] = {"policy": policy, "reasons": reasons,
                      "node": st.get("node", ""), "l0_files": l0,
                      "debt_bytes": int(st.get("debt_bytes", 0))}
-    return out
+    return assign_placements(out, places, weights=weights)
 
 
 def localize_decisions(decisions: dict, hosts: dict, node: str,
@@ -178,32 +281,43 @@ def localize_decisions(decisions: dict, hosts: dict, node: str,
         elif policy == "defer" and d.get("node") and node != d["node"]:
             policy = "normal"
             reasons.append("defer_primary_only")
-        mine[g] = {"policy": policy, "reasons": reasons}
+        # the WHERE half passes through untouched: every replica of the
+        # partition ships to the same service (content-addressed staging
+        # dedups the runs they share)
+        mine[g] = {"policy": policy, "reasons": reasons,
+                   "where": d.get("where", "")}
     return mine
 
 
 def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
                        slow_count: int = 0, caller: ClusterCaller = None,
-                       deliver: bool = True, knobs: dict = None) -> dict:
+                       deliver: bool = True, knobs: dict = None,
+                       tune_state: dict = None) -> dict:
     """One scheduler round over the live cluster. -> report dict:
     ``{"decisions": {gpid: {...}}, "delivered": {node: {gpid: policy}},
-    "nodes": N, "errors": [...]}``.
+    "nodes": N, "services": {addr: {...}}, "errors": [...]}`` (plus
+    ``"autotune"`` when the feedback tuner is armed).
 
     Folds the meta's cluster-state snapshot (partition configs + the
     beacon-carried per-replica ``compact`` debt and committed/applied
-    decrees) with per-node compact-lane breaker scrapes, then delivers
-    each alive node the decisions for every partition it hosts (primary
-    AND secondaries — each replica compacts independently) over
-    ``compact-sched-policy``. Every failure is an entry in ``errors``,
-    never an exception: a half-delivered round is strictly better than
-    none, and undelivered tokens simply expire."""
+    decrees) with per-node compact-lane breaker scrapes and — when
+    ``PEGASUS_OFFLOAD_SERVICES`` names compaction services — their free
+    merge budget, then delivers each alive node the (when, where)
+    decisions for every partition it hosts (primary AND secondaries —
+    each replica compacts independently) over ``compact-sched-policy``.
+    ``tune_state`` (a dict the caller keeps across ticks, holding
+    ``ewma_us``) arms the feedback tuner when the autotune knob is on.
+    Every failure is an entry in ``errors``, never an exception: a
+    half-delivered round is strictly better than none, and undelivered
+    tokens simply expire."""
     inject("compact.sched")  # chaos seam: a wedged/crashed tick must
     # never block writes or compactions (engine-local triggers + token
     # expiry are the fallback; see tests/test_compact_scheduler.py)
     counters.rate("sched.tick_count").increment()
     own = caller is None
     caller = caller or ClusterCaller(meta_addrs, pool=pool)
-    report = {"decisions": {}, "delivered": {}, "nodes": 0, "errors": []}
+    report = {"decisions": {}, "delivered": {}, "nodes": 0,
+              "services": {}, "errors": []}
     k = dict(_knobs(), **(knobs or {}))
     try:
         state = caller.meta_state()
@@ -224,6 +338,43 @@ def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
                 # unknown lane state: treat as healthy — a scrape hiccup
                 # must not strip a node of promotions it may need
                 breakers[node] = False
+        # offload services (ISSUE 14): free device budget per service; a
+        # dead/unreachable service simply gets no placements this round
+        places = {}
+        for svc in k["offload_services"]:
+            try:
+                st = json.loads(caller.remote_command(svc, "offload-status",
+                                                      []))
+                places[svc] = int(st.get("free_slots", 0))
+                report["services"][svc] = {
+                    "free_slots": places[svc],
+                    "running_merges": st.get("running_merges", 0),
+                    "jobs": st.get("jobs", 0)}
+            except (RpcError, OSError, ValueError) as e:
+                report["services"][svc] = {"error": str(e)}
+                report["errors"].append(f"offload {svc}: {e}")
+        if k["autotune"] and tune_state is not None:
+            # feedback tuning (ISSUE 14 satellite): fold the nodes'
+            # recorded compact.stage.* durations into an EWMA of the
+            # whole-merge cost and rescale the urgency thresholds
+            obs = 0.0
+            for node in alive:
+                try:
+                    hist = json.loads(caller.remote_command(
+                        node, "metrics-history",
+                        ["60", "compact.stage."]))
+                    for window in hist.values():  # pid-keyed per process
+                        obs = max(obs, stage_cost_us(window))
+                except (RpcError, OSError, ValueError):
+                    continue  # a scrape hiccup must not zero the EWMA
+            if obs > 0.0:
+                prev = tune_state.get("ewma_us")
+                alpha = k["tune_alpha"]
+                tune_state["ewma_us"] = obs if prev is None else \
+                    alpha * obs + (1.0 - alpha) * prev
+            k, tuned = tune_knobs(tune_state.get("ewma_us", 0.0), k)
+            report["autotune"] = tuned
+            counters.number("sched.autotune.urgent_l0").set(k["urgent_l0"])
         parts, hosts = {}, {}
         rs = state.get("replica_states", {})
         for app in state.get("apps", {}).values():
@@ -247,7 +398,13 @@ def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
                 }
                 hosts[gpid] = members
         decisions = fold_decisions(parts, hot=hot_gpids or (),
-                                   slow_count=slow_count, knobs=k)
+                                   slow_count=slow_count, knobs=k,
+                                   places=places,
+                                   # a placement reaches every replica,
+                                   # each compacting independently —
+                                   # budget it by member count
+                                   weights={g: len(m)
+                                            for g, m in hosts.items()})
         report["decisions"] = decisions
         counters.number("sched.decisions.defer").set(
             sum(1 for d in decisions.values() if d["policy"] == "defer"))
@@ -299,6 +456,9 @@ class CompactScheduler:
         # status command reads on an RPC thread)
         self._lock = lockrank.named_lock("sched.state")
         self._last = {}  #: guarded_by self._lock
+        # feedback-tuner state (EWMA of measured merge cost), carried
+        # across ticks; only the loop thread touches it
+        self._tune_state = {}
         self._thread = spawn_thread(self._loop, daemon=True, start=False,
                                     name="compact-sched")
 
@@ -327,7 +487,8 @@ class CompactScheduler:
     def tick(self) -> dict:
         report = run_scheduler_tick(self.meta_addrs, pool=self.pool,
                                     hot_gpids=self.hot_fn(),
-                                    slow_count=self.slow_fn())
+                                    slow_count=self.slow_fn(),
+                                    tune_state=self._tune_state)
         with self._lock:
             self._last = report
         return report

@@ -99,6 +99,8 @@ def write_metadata(provider_root: str, app_name: str, partition_count: int) -> d
         files = []
         if os.path.isdir(pdir):
             for name in sorted(os.listdir(pdir)):
+                if name.startswith("."):
+                    continue  # tool state (learn-ship sidecars), not data
                 p = os.path.join(pdir, name)
                 files.append({"name": name, "size": os.path.getsize(p)})
         meta["partitions"][str(pidx)] = files
@@ -120,6 +122,8 @@ def ingest_partition(engine, provider_root: str, app_name: str,
         return {"files": 0, "records": 0}
     blocks = []
     for name in sorted(os.listdir(pdir)):
+        if name.startswith("."):
+            continue  # tool state (learn-ship sidecars), not data
         blocks.append(load_ingest_file(os.path.join(pdir, name), schema))
     if not blocks:
         return {"files": 0, "records": 0}

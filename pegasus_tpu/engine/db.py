@@ -332,6 +332,13 @@ class LsmEngine:
         self._sched_policy = "normal"  #: guarded_by self._lock
         self._sched_reasons = ()       #: guarded_by self._lock
         self._sched_expire = 0.0       #: guarded_by self._lock
+        # compaction-offload placement (ISSUE 14): the WHERE half of the
+        # scheduler's (when, where) token — a remote compaction service
+        # address this cpu-only engine ships its merges to. Same lease
+        # semantics as the policy token: expiry reverts to local
+        # compaction, so a dead scheduler or service strands nothing
+        self._offload_addr = ""        #: guarded_by self._lock
+        self._offload_expire = 0.0     #: guarded_by self._lock
         # hard debt ceiling (L0 files) above which the engine-local
         # trigger ALWAYS wins, defer token or not — the availability
         # floor under any scheduler decision. 0 = 3x the L0 trigger.
@@ -351,6 +358,7 @@ class LsmEngine:
             "engine.compact.sched.urgent_count")
         self._c_sched_gate_deferred = counters.rate(
             "engine.compact.sched.gate_deferred_count")
+        self._c_offload = counters.rate("engine.compact.offload_count")
         # device-read knobs resolved ONCE (the coalescer consults them on
         # every point read — no per-get environ parse); the backend check
         # stays dynamic because app-envs can flip it at runtime
@@ -1023,6 +1031,34 @@ class LsmEngine:
                         was=expired, engine=self.path)
         return out
 
+    def set_offload_target(self, addr: str, ttl_s: float = None) -> None:
+        """Install the scheduler's compaction-offload placement (ISSUE
+        14) — the WHERE half of the (when, where) token: while the lease
+        is live, this engine's merges ship to the compaction service at
+        `addr` (empty = compact locally). A lapsed lease reverts to
+        local compaction — a dead scheduler can never strand merges on
+        a gone service (and the offload lane guard's cpu fallback covers
+        the window where the lease outlives the service)."""
+        with self._lock:
+            changed = self._offload_addr != (addr or "")
+            self._offload_addr = addr or ""
+            self._offload_expire = time.monotonic() + (
+                self._sched_ttl_s if ttl_s is None else float(ttl_s))
+        if changed:
+            events.emit("offload.placement", engine=self.path,
+                        service=addr or "")
+
+    def offload_target(self):
+        """The live placement address, or None (none set / lease
+        lapsed)."""
+        with self._lock:
+            if not self._offload_addr:
+                return None
+            if time.monotonic() >= self._offload_expire:
+                self._offload_addr = ""
+                return None
+            return self._offload_addr
+
     def compact_policy_fast(self) -> str:
         """Lock-free policy peek for the per-write admission path (the
         debt throttle keys its slope on whether a defer token is
@@ -1240,11 +1276,26 @@ class LsmEngine:
         from ..runtime.perf_counters import counters
 
         t0 = time.perf_counter()
+        # compaction-offload placement (ISSUE 14): a cpu-only engine with
+        # a live (when, where) lease ships this merge — elective trigger,
+        # cascade or manual — to the rack's compaction service instead of
+        # merging locally; the offload lane guard inside falls back to
+        # the byte-identical local cpu merge on any service trouble
+        offload_addr = (self.offload_target()
+                        if mesh is None and self.opts.backend == "cpu"
+                        else None)
         if mesh is not None:
             from ..parallel import sharded_compact_block
 
             result = sharded_compact_block(input_blocks, mesh, opts)
             counters.rate("engine.sharded_compaction_count").increment()
+        elif offload_addr:
+            from ..replication.compact_offload import offload_compact_blocks
+
+            result = offload_compact_blocks(
+                input_blocks, opts, offload_addr,
+                tenant=f"{self.opts.pidx}@{os.path.basename(self.path)}")
+            self._c_offload.increment()
         else:
             device_runs = None
             if self.opts.backend == "tpu":
@@ -1835,6 +1886,7 @@ class LsmEngine:
                 "compact_ceiling_files": debt["ceiling_files"],
                 "compact_policy": policy,
                 "compact_policy_reasons": reasons,
+                "compact_offload": self._offload_addr,
                 "memtable_records": len(self._mem),
                 "memtable_bytes": self._mem.approximate_bytes,
                 "immutable_memtables": len(self._imm),

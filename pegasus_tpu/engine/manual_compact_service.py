@@ -257,6 +257,21 @@ class ManualCompactService:
         wedged = self._watchdog().wedged_at_stage
         if wedged is not None:
             out += f"; device wedged at stage {wedged}"
+        target = getattr(self.server.engine, "offload_target",
+                         lambda: None)()
+        if target:
+            # merges ship to the rack's compaction service (ISSUE 14);
+            # surface the wire lane's degradation totals alongside it
+            out += f"; compaction offload -> {target}"
+            from ..replication.compact_offload import OFFLOAD_LANE_GUARD
+
+            olane = OFFLOAD_LANE_GUARD.state()
+            if olane["breaker_open"]:
+                out += (f"; offload lane breaker OPEN "
+                        f"(cooldown "
+                        f"{olane['breaker_cooldown_remaining_s']}s)")
+            if olane["fallbacks"]:
+                out += f"; offload local fallbacks: {olane['fallbacks']}"
         lane = self._lane_guard().state()
         if lane["breaker_open"]:
             out += (f"; device lane breaker OPEN "

@@ -22,9 +22,65 @@ So (window, rank, len) equality <=> full-key equality, and its order is full
 byte order — no host comparisons outside collision groups.
 """
 
+import json
+import struct
+
 import numpy as np
 
 DEFAULT_PREFIX_U32 = 8  # 32-byte prefix window
+
+# ---------------------------------------------------------------- run wire
+# The pack/serialize boundary for shipping whole runs between processes
+# (ISSUE 14 compaction offload): a KVBlock flattened to one deterministic
+# byte string — tiny json header (column dtypes/shapes) + the raw column
+# buffers in declaration order. Distinct from the SST file format on
+# purpose: no bloom, no engine meta, no fsync — this is a TRANSFER form
+# whose md5 is a content-address, not a storage format.
+
+_RUN_MAGIC = b"PGRN1\n"
+_RUN_COLUMNS = (
+    ("key_arena", np.uint8), ("key_off", np.int64), ("key_len", np.int32),
+    ("val_arena", np.uint8), ("val_off", np.int64), ("val_len", np.int32),
+    ("expire_ts", np.uint32), ("hash32", np.uint32), ("deleted", np.bool_),
+)
+
+
+def pack_run_bytes(block) -> bytes:
+    """One KVBlock -> deterministic wire bytes (same block, same bytes —
+    the offload resume/dedup key is the md5 of this)."""
+    cols = {}
+    parts = []
+    for name, dtype in _RUN_COLUMNS:
+        arr = np.ascontiguousarray(getattr(block, name), dtype=dtype)
+        raw = arr.tobytes()
+        cols[name] = {"dtype": np.dtype(dtype).str, "shape": list(arr.shape),
+                      "nbytes": len(raw)}
+        parts.append(raw)
+    hdr = json.dumps({"n": int(block.n), "cols": cols},
+                     sort_keys=True).encode()
+    return b"".join([_RUN_MAGIC, struct.pack("<I", len(hdr)), hdr] + parts)
+
+
+def unpack_run_bytes(data: bytes):
+    """Wire bytes -> KVBlock (inverse of pack_run_bytes)."""
+    from ..engine.block import KVBlock
+
+    if data[:len(_RUN_MAGIC)] != _RUN_MAGIC:
+        raise ValueError("bad run wire magic")
+    (hlen,) = struct.unpack_from("<I", data, len(_RUN_MAGIC))
+    base = len(_RUN_MAGIC) + 4
+    hdr = json.loads(data[base:base + hlen])
+    off = base + hlen
+    kwargs = {}
+    for name, _ in _RUN_COLUMNS:
+        sec = hdr["cols"][name]
+        raw = data[off:off + sec["nbytes"]]
+        if len(raw) != sec["nbytes"]:
+            raise ValueError(f"truncated run wire column {name}")
+        kwargs[name] = np.frombuffer(raw, dtype=np.dtype(sec["dtype"])) \
+            .reshape(sec["shape"]).copy()
+        off += sec["nbytes"]
+    return KVBlock(**kwargs)
 
 
 def pack_sbytes(prefix_cols, klen, rank=None):

@@ -1,4 +1,6 @@
 from .mesh import init_multihost, make_mesh
-from .sharded_compact import sharded_compact, sharded_compact_block
+from .sharded_compact import (compact_blocks_meshed, sharded_compact,
+                              sharded_compact_block)
 
-__all__ = ["init_multihost", "make_mesh", "sharded_compact", "sharded_compact_block"]
+__all__ = ["init_multihost", "make_mesh", "sharded_compact",
+           "sharded_compact_block", "compact_blocks_meshed"]

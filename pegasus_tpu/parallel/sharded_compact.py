@@ -250,3 +250,26 @@ def sharded_compact_block(blocks, mesh, opts: CompactOptions,
     # collective / dead chip degrades to the single-node cpu merge, whose
     # output this function is byte-equal to by construction
     return LANE_GUARD.run(_device_lane, _cpu_lane, op="sharded_compact")
+
+
+def compact_blocks_meshed(blocks, opts: CompactOptions,
+                          mesh=None) -> CompactResult:
+    """Merge entry for the compaction-offload service (ISSUE 14): one
+    call that multiplexes tenants across whatever the host owns — the
+    all_to_all hash-sharded kernel when the mesh spans >1 device, the
+    guarded single-chip merge for a device backend, the plain host merge
+    otherwise. Every path is byte-equal to ``compact_blocks(blocks,
+    opts)`` on cpu (the sharded path by sharded_compact_block's
+    reassembly argument, the single-chip path by the standing
+    device-vs-host contract), so a cpu-only tenant's local fallback and
+    the service's merged output can never diverge."""
+    from ..ops.compact import compact_blocks
+
+    if mesh is not None and mesh.devices.size > 1:
+        return sharded_compact_block(blocks, mesh, opts)
+    if opts.backend != "cpu":
+        return LANE_GUARD.run(
+            lambda: compact_blocks(blocks, opts),
+            lambda: compact_blocks(blocks, replace(opts, backend="cpu")),
+            op="offload_merge")
+    return compact_blocks(blocks, opts)

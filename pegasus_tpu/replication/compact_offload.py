@@ -1,0 +1,670 @@
+"""Rack-scale compaction offload: one device-owning compaction service
+serving many CPU-only replica nodes (ISSUE 14).
+
+Every replica node used to need its own chip to compact on device;
+production racks don't ship that way. LUDA (PAPERS.md) shows the winning
+deployment shape is compaction offload to a shared accelerator host, and
+this module builds it out of machinery the repo already trusts:
+
+  * **Service** (``CompactOffloadService``): one process per TPU host,
+    owning the device. Tenants open a job with a manifest of packed runs
+    (``ops.packing.pack_run_bytes`` — the pack/serialize boundary), ship
+    the runs as bounded CRC-checked chunks (the PR 13 learn-plane
+    streaming shape: content-addressed staging, so an interrupted ship
+    RESUMES — a retry ships only what never landed), then ask for the
+    merge. The service multiplexes tenants across whatever it owns via
+    ``parallel.compact_blocks_meshed`` (all_to_all sharded kernel on a
+    multi-chip mesh, guarded single-chip merge otherwise) under its own
+    admission gate (at most ``PEGASUS_OFFLOAD_MAX_CONCURRENT`` merges in
+    flight; the rest are refused, not queued — the tenant's lane policy
+    decides whether to retry or compact locally). Jobs are TTL leases:
+    a dead tenant's job dir is reaped, never wedges the service.
+
+  * **Client** (``offload_compact_blocks``): the node-side merge entry
+    ``engine/db.py`` routes through when a scheduler placement names a
+    remote service. lane_guard semantics extend across the wire — the
+    whole ship/merge/fetch round runs under ``OFFLOAD_LANE_GUARD``
+    (deadline, bounded retries, circuit breaker, counters
+    ``offload.lane.*``), whose fallback is the node's LOCAL cpu
+    compaction, byte-identical by construction: the service merges with
+    user rules and the default-TTL rewrite masked off and the client
+    applies them after return, exactly the ``sharded_compact_block``
+    post-filter pattern. A dead, slow or breaker-open service therefore
+    costs latency on one merge, never availability — and never different
+    bytes.
+
+Placement (WHERE) rides the same leased policy tokens as timing (WHEN):
+``collector/compact_scheduler.fold_decisions`` assigns partitions to
+services with free device budget, ``compact-sched-policy`` delivers
+``where`` alongside ``policy``, and ``LsmEngine.set_offload_target``
+holds it as a TTL lease — a dead scheduler expires nodes back to local
+compaction, the same degradation story every other token has.
+
+Chaos seam: the ``compact.offload`` fail point fires at the ship, merge
+and return (fetch) stages on both sides of the wire.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+from dataclasses import replace
+
+from ..ops.compact import CompactOptions, CompactResult, apply_post_filters
+from ..ops.packing import pack_run_bytes, unpack_run_bytes
+from ..rpc import codec
+from ..rpc import messages as rpc_msg
+from ..rpc.transport import ConnectionPool, RpcError, RpcServer
+from ..runtime import events, lockrank
+from ..runtime.fail_points import inject
+from ..runtime.lane_guard import LaneGuard, LaneGuardConfig
+from ..runtime.perf_counters import counters
+from ..runtime.remote_command import RemoteCommandService
+from ..runtime.tracing import COMPACT_TRACER as _TRACE
+
+RPC_COMPACT_OFFLOAD_BEGIN = "RPC_COMPACT_OFFLOAD_BEGIN"
+RPC_COMPACT_OFFLOAD_SHIP = "RPC_COMPACT_OFFLOAD_SHIP"
+RPC_COMPACT_OFFLOAD_MERGE = "RPC_COMPACT_OFFLOAD_MERGE"
+RPC_COMPACT_OFFLOAD_FETCH = "RPC_COMPACT_OFFLOAD_FETCH"
+RPC_COMPACT_OFFLOAD_FINISH = "RPC_COMPACT_OFFLOAD_FINISH"
+
+# CompactOptions fields that cross the wire. user_ops (parsed rule
+# objects) and default_ttl deliberately do NOT: they run tenant-side as
+# post filters, so the service needs no rule vocabulary and the output
+# stays byte-identical to the tenant's local merge.
+_WIRE_OPT_FIELDS = ("now", "pidx", "partition_mask", "bottommost",
+                    "filter", "prefix_u32", "runs_sorted")
+
+
+class OffloadError(ConnectionError):
+    """An offload round failed (service dead/busy, chunk CRC, digest
+    mismatch, expired job). ConnectionError subclass so the lane guard's
+    retry/fallback treats it like any other transient device error."""
+
+
+def chunk_bytes() -> int:
+    """PEGASUS_OFFLOAD_CHUNK_BYTES: bounded ship/fetch chunk size."""
+    return max(4096, int(os.environ.get("PEGASUS_OFFLOAD_CHUNK_BYTES",
+                                        str(1 << 20))))
+
+
+def rpc_timeout_s() -> float:
+    """PEGASUS_OFFLOAD_RPC_TIMEOUT_S: per-RPC bound for begin/ship/fetch
+    waves (the merge call gets its own, longer bound)."""
+    return float(os.environ.get("PEGASUS_OFFLOAD_RPC_TIMEOUT_S", "30"))
+
+
+def merge_timeout_s() -> float:
+    """PEGASUS_OFFLOAD_MERGE_TIMEOUT_S: bound on the blocking merge RPC
+    (covers the service-side device merge incl. a cold jit)."""
+    return float(os.environ.get("PEGASUS_OFFLOAD_MERGE_TIMEOUT_S", "300"))
+
+
+def _md5(data: bytes) -> str:
+    # transfer-dedup content address, not a security boundary (the same
+    # contract as learn.file_digest); corruption on the wire is caught by
+    # the per-chunk CRC and this digest together
+    return hashlib.md5(data).hexdigest()
+
+
+def wire_opts(opts: CompactOptions) -> str:
+    """The merge options a tenant ships — `now` must already be resolved
+    (both sides' TTL drops must agree on the clock)."""
+    return json.dumps({f: getattr(opts, f) for f in _WIRE_OPT_FIELDS},
+                      sort_keys=True)
+
+
+def opts_from_wire(opts_json: str, backend: str) -> CompactOptions:
+    raw = json.loads(opts_json or "{}")
+    kw = {f: raw[f] for f in _WIRE_OPT_FIELDS if f in raw}
+    return CompactOptions(backend=backend, user_ops=(), default_ttl=0, **kw)
+
+
+def _warm_offload_counters() -> None:
+    """Literal registrations for every offload counter (the guard and
+    the client increment through prefixes/f-strings): /metrics shows
+    zeros before the first merge and tools/analyze ties README rows to
+    registrations."""
+    counters.rate("offload.lane.fallback_count")
+    counters.rate("offload.lane.retry_count")
+    counters.rate("offload.lane.deadline_abandon_count")
+    counters.rate("offload.lane.breaker_trip_count")
+    counters.number("offload.lane.breaker_open")
+    counters.rate("offload.client.merge_count")
+    counters.rate("offload.client.ship_bytes")
+    counters.rate("offload.client.ship_blocks")
+    counters.rate("offload.client.skipped_blocks")
+    counters.rate("offload.client.fetch_bytes")
+
+
+_warm_offload_counters()
+
+# The wire lane: its OWN breaker/totals (counters ``offload.lane.*``), so
+# a dead offload service degrades remote merges to local cpu without
+# touching the node's other lanes. The 120 s default deadline bounds a
+# whole ship+merge+fetch round even if every per-RPC timeout is dodged by
+# a slow-dripping service.
+OFFLOAD_LANE_GUARD = LaneGuard(
+    LaneGuardConfig.from_env("PEGASUS_OFFLOAD_LANE", deadline_s=120.0),
+    metric_prefix="offload.lane")
+
+
+# ================================================================ service
+
+
+class CompactOffloadService:
+    """One device-owning compaction service process (see module
+    docstring). Construct, then ``start()``; ``address`` is what tenants
+    and the scheduler's placement scrape dial."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "cpu", mesh=None, max_concurrent: int = None,
+                 job_ttl_s: float = None):
+        self.root = root
+        self.backend = backend
+        self.mesh = mesh
+        self.max_concurrent = max(1, int(
+            os.environ.get("PEGASUS_OFFLOAD_MAX_CONCURRENT", "2")
+            if max_concurrent is None else max_concurrent))
+        self.job_ttl_s = float(
+            os.environ.get("PEGASUS_OFFLOAD_JOB_TTL_S", "600")
+            if job_ttl_s is None else job_ttl_s)
+        self._blocks_dir = os.path.join(root, "blocks")
+        self._jobs_dir = os.path.join(root, "jobs")
+        os.makedirs(self._blocks_dir, exist_ok=True)
+        os.makedirs(self._jobs_dir, exist_ok=True)
+        # leaf lock over job/staging state; never held across a merge,
+        # a disk write or an RPC
+        self._lock = lockrank.named_lock("offload.service")
+        self._jobs = {}       #: guarded_by self._lock
+        self._next_job = 0    #: guarded_by self._lock
+        self._running = 0     #: guarded_by self._lock
+        # digest -> {"got": set(offsets), "size": int} for blocks mid-ship
+        self._inflight = {}   #: guarded_by self._lock
+        self._merge_total = 0  #: guarded_by self._lock
+        self._c_jobs = counters.number("offload.service.jobs_active")
+        self._c_running = counters.number("offload.service.running_merges")
+        self._c_merges = counters.rate("offload.service.merge_count")
+        self._c_rejects = counters.rate("offload.service.reject_count")
+        self._c_in = counters.rate("offload.service.bytes_in")
+        self._c_out = counters.rate("offload.service.bytes_out")
+        self._c_resumed = counters.rate("offload.service.resumed_blocks")
+        self.rpc = RpcServer(host, port)
+        self.rpc.register(RPC_COMPACT_OFFLOAD_BEGIN, self._on_begin)
+        self.rpc.register(RPC_COMPACT_OFFLOAD_SHIP, self._on_ship)
+        self.rpc.register(RPC_COMPACT_OFFLOAD_MERGE, self._on_merge)
+        self.rpc.register(RPC_COMPACT_OFFLOAD_FETCH, self._on_fetch)
+        self.rpc.register(RPC_COMPACT_OFFLOAD_FINISH, self._on_finish)
+        self.commands = RemoteCommandService()
+        self.commands.register_defaults(node_kind="compact_offload",
+                                        describe=self.status)
+        self.commands.register("offload-status",
+                               lambda a: json.dumps(self.status()))
+        self.rpc.register("RPC_CLI_CLI_CALL", self.commands.rpc_handler)
+        self.address = f"{self.rpc.address[0]}:{self.rpc.address[1]}"
+
+    def start(self) -> "CompactOffloadService":
+        self.rpc.start()
+        return self
+
+    def stop(self) -> None:
+        self.rpc.stop()
+
+    # ------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        """The placement scrape: free device budget (merge slots) is what
+        the scheduler's fold turns into (when, where) pairs."""
+        with self._lock:
+            jobs = len(self._jobs)
+            running = self._running
+            merges = self._merge_total
+        staged = 0
+        try:
+            staged = sum(e.stat().st_size for e in os.scandir(self._blocks_dir)
+                         if e.is_file())
+        except OSError:
+            pass
+        return {"address": self.address, "backend": self.backend,
+                "max_concurrent": self.max_concurrent,
+                "running_merges": running,
+                "free_slots": max(0, self.max_concurrent - running),
+                "jobs": jobs, "merges_done": merges,
+                "staged_bytes": staged}
+
+    # ------------------------------------------------------------ plumbing
+
+    def _block_path(self, digest: str) -> str:
+        return os.path.join(self._blocks_dir, digest)
+
+    def _job(self, job_id: int) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                raise OffloadError(f"offload job {job_id} expired/unknown")
+            job["expires"] = now + self.job_ttl_s  # every RPC renews
+            return job
+
+    def _reap_locked(self, now: float) -> None:  #: requires self._lock
+        for jid in [j for j, job in self._jobs.items()
+                    if now >= job["expires"]]:
+            job = self._jobs.pop(jid)
+            shutil.rmtree(job["dir"], ignore_errors=True)
+        self._c_jobs.set(len(self._jobs))
+
+    def _gc_blocks(self) -> None:
+        """Drop staged runs (and torn .part files, and their in-memory
+        staging state) no live job references once their TTL lapsed —
+        content-addressed blocks outlive jobs ON PURPOSE (that is what
+        makes a retry's ship resumable), but an abandoned mid-ship
+        tenant must not leak disk or ``_inflight`` entries forever."""
+        with self._lock:
+            live = {e.digest for job in self._jobs.values()
+                    for e in job["runs"]}
+        cutoff = time.time() - self.job_ttl_s
+        try:
+            entries = list(os.scandir(self._blocks_dir))
+        except OSError:
+            return
+        for e in entries:
+            digest = e.name[:-5] if e.name.endswith(".part") else e.name
+            try:
+                if digest not in live and e.stat().st_mtime < cutoff:
+                    os.unlink(e.path)
+                    with self._lock:
+                        self._inflight.pop(digest, None)
+            except OSError:
+                continue
+        # inflight entries whose .part never landed and is gone
+        # (abandoned before any GC-able file aged out, or unlinked by a
+        # failed finalize) go with the job references; the stat runs
+        # outside the leaf lock
+        with self._lock:
+            stale = [d for d in self._inflight if d not in live]
+        for digest in stale:
+            if not os.path.exists(self._block_path(digest) + ".part"):
+                with self._lock:
+                    self._inflight.pop(digest, None)
+
+    # ------------------------------------------------------------ handlers
+
+    def _on_begin(self, header, body) -> bytes:
+        req = codec.decode(rpc_msg.OffloadBeginRequest, body)
+        inject("compact.offload")  # chaos seam: ship stage, service side
+        now = time.monotonic()
+        with self._lock:
+            self._reap_locked(now)
+            if len(self._jobs) >= self.max_concurrent * 4:
+                self._c_rejects.increment()
+                events.emit("offload.reject", severity="warn",
+                            tenant=req.tenant, gpid=req.gpid,
+                            reason="job_cap", jobs=len(self._jobs))
+                return codec.encode(rpc_msg.OffloadBeginResponse(
+                    error=1, error_text=f"busy: {len(self._jobs)} jobs "
+                    f"active (cap {self.max_concurrent * 4})"))
+            self._next_job += 1
+            job_id = self._next_job
+            job = {"id": job_id, "tenant": req.tenant, "gpid": req.gpid,
+                   "runs": list(req.runs), "opts_json": req.opts_json,
+                   "dir": os.path.join(self._jobs_dir, str(job_id)),
+                   "outputs": [], "stats": {},
+                   "expires": now + self.job_ttl_s}
+            self._jobs[job_id] = job
+            self._c_jobs.set(len(self._jobs))
+        self._gc_blocks()
+        staged = []
+        for e in req.runs:
+            p = self._block_path(e.digest)
+            try:
+                if os.path.getsize(p) == e.size:
+                    staged.append(e.name)
+                    self._c_resumed.increment()
+            except OSError:
+                continue
+        return codec.encode(rpc_msg.OffloadBeginResponse(
+            job_id=job_id, staged=staged))
+
+    def _on_ship(self, header, body) -> bytes:
+        req = codec.decode(rpc_msg.OffloadShipRequest, body)
+        try:
+            inject("compact.offload")  # chaos seam: per shipped chunk
+            job = self._job(req.job_id)
+            entry = next((e for e in job["runs"] if e.name == req.name), None)
+            if entry is None:
+                raise OffloadError(f"unknown run {req.name!r}")
+            if zlib.crc32(req.data) != req.crc:
+                raise OffloadError(f"chunk CRC mismatch for {req.name}"
+                                   f"@{req.offset}")
+            landed = self._land_chunk(entry, req.offset, req.data)
+        except (OffloadError, OSError, ValueError) as e:
+            return codec.encode(rpc_msg.OffloadShipResponse(
+                error=1, error_text=repr(e)))
+        self._c_in.increment(len(req.data))
+        return codec.encode(rpc_msg.OffloadShipResponse(landed=landed))
+
+    def _land_chunk(self, entry, offset: int, data: bytes) -> bool:
+        """Write one chunk at its offset into the content-addressed
+        staging file; when every byte has arrived, verify the whole-file
+        digest and atomically publish. Chunks may arrive out of order
+        (the client's call_many wave fans across the RPC pool). -> True
+        once the block is fully landed and verified."""
+        final = self._block_path(entry.digest)
+        part = final + ".part"
+        with self._lock:
+            if os.path.exists(final):
+                return True  # a sibling shipper already landed it
+            st = self._inflight.setdefault(entry.digest,
+                                           {"got": set(), "size": entry.size,
+                                            "finalizing": False})
+            if st["got"] and not os.path.exists(part):
+                # stale state from an ABANDONED ship whose .part was
+                # GC'd (or finalize-failed): a fresh shipper must start
+                # with an empty got-set, or the first chunk would read
+                # as "complete" and fail the whole round on a torn file
+                st["got"] = set()
+                st["finalizing"] = False
+        open(part, "ab").close()  # ensure exists before the r+b seek-write
+        with open(part, "r+b") as f:
+            f.seek(offset)
+            f.write(data)
+        with self._lock:
+            # the got-set records a chunk only AFTER its bytes are in the
+            # file, and exactly ONE handler finalizes (chunks of a wave
+            # land on concurrent pool threads; the last writers race here)
+            st["got"].add((offset, len(data)))
+            complete = (sum(ln for _, ln in st["got"]) >= entry.size
+                        and not st["finalizing"])
+            if complete:
+                st["finalizing"] = True
+        if not complete:
+            return os.path.exists(final)
+        try:
+            with open(part, "rb") as f:
+                whole = f.read()
+        except OSError:
+            return os.path.exists(final)  # a sibling already published
+        if len(whole) != entry.size or _md5(whole) != entry.digest:
+            # torn/overlapping ship: drop the staging state so a retry
+            # starts the block clean instead of re-verifying garbage
+            with self._lock:
+                self._inflight.pop(entry.digest, None)
+            try:
+                os.unlink(part)
+            except OSError:
+                pass
+            raise OffloadError(f"staged run {entry.name} digest mismatch")
+        os.replace(part, final)
+        with self._lock:
+            self._inflight.pop(entry.digest, None)
+        return True
+
+    def _on_merge(self, header, body) -> bytes:
+        req = codec.decode(rpc_msg.OffloadMergeRequest, body)
+        try:
+            inject("compact.offload")  # chaos seam: merge stage
+            job = self._job(req.job_id)
+            with self._lock:
+                if job["outputs"]:
+                    # idempotent: a retried merge call returns the done job
+                    return codec.encode(rpc_msg.OffloadMergeResponse(
+                        outputs=list(job["outputs"]),
+                        stats_json=json.dumps(job["stats"])))
+                if self._running >= self.max_concurrent:
+                    # admission gate: refuse, never queue — the tenant's
+                    # lane policy decides between retry and local cpu
+                    self._c_rejects.increment()
+                    events.emit("offload.reject", severity="warn",
+                                tenant=job["tenant"], gpid=job["gpid"],
+                                reason="merge_cap", running=self._running)
+                    return codec.encode(rpc_msg.OffloadMergeResponse(
+                        error=1, error_text=f"busy: {self._running} merges "
+                        f"in flight (cap {self.max_concurrent})"))
+                self._running += 1
+                self._c_running.set(self._running)
+            try:
+                outputs, stats = self._merge_job(job)
+            finally:
+                with self._lock:
+                    self._running -= 1
+                    self._c_running.set(self._running)
+        except (OffloadError, OSError, ValueError) as e:
+            return codec.encode(rpc_msg.OffloadMergeResponse(
+                error=1, error_text=repr(e)))
+        return codec.encode(rpc_msg.OffloadMergeResponse(
+            outputs=outputs, stats_json=json.dumps(stats)))
+
+    def _merge_job(self, job: dict) -> tuple:
+        """Load the job's staged runs (manifest order = merge priority),
+        merge across whatever this host owns, publish the packed output
+        under the job dir. -> (outputs manifest, stats)."""
+        t0 = time.perf_counter()
+        blocks = []
+        for e in job["runs"]:
+            try:
+                with open(self._block_path(e.digest), "rb") as f:
+                    data = f.read()
+            except OSError:
+                raise OffloadError(f"run {e.name} not staged (re-begin)")
+            if _md5(data) != e.digest:
+                raise OffloadError(f"staged run {e.name} corrupt on disk")
+            blocks.append(unpack_run_bytes(data))
+        from ..parallel import compact_blocks_meshed
+
+        opts = opts_from_wire(job["opts_json"], self.backend)
+        result = compact_blocks_meshed(blocks, opts, self.mesh)
+        out_bytes = pack_run_bytes(result.block)
+        os.makedirs(job["dir"], exist_ok=True)
+        with open(os.path.join(job["dir"], "out.0"), "wb") as f:
+            f.write(out_bytes)
+        outputs = [rpc_msg.LearnBlockEntry("out.0", len(out_bytes),
+                                           _md5(out_bytes))]
+        stats = dict(result.stats)
+        with self._lock:
+            job["outputs"] = list(outputs)
+            job["stats"] = stats
+            self._merge_total += 1
+        self._c_merges.increment()
+        events.emit("offload.merge", tenant=job["tenant"], gpid=job["gpid"],
+                    records_in=stats.get("input_records", 0),
+                    records_out=stats.get("output_records", 0),
+                    ms=round((time.perf_counter() - t0) * 1e3, 1))
+        return outputs, stats
+
+    def _on_fetch(self, header, body) -> bytes:
+        req = codec.decode(rpc_msg.OffloadFetchRequest, body)
+        try:
+            inject("compact.offload")  # chaos seam: return (fetch) stage
+            job = self._job(req.job_id)
+            path = os.path.join(job["dir"], os.path.basename(req.name))
+            with open(path, "rb") as f:
+                f.seek(req.offset)
+                data = f.read(req.length)
+            total = os.path.getsize(path)
+        except (OffloadError, OSError) as e:
+            return codec.encode(rpc_msg.LearnFetchResponse(
+                error=1, error_text=repr(e)))
+        self._c_out.increment(len(data))
+        return codec.encode(rpc_msg.LearnFetchResponse(
+            data=data, crc=zlib.crc32(data), total=total))
+
+    def _on_finish(self, header, body) -> bytes:
+        req = codec.decode(rpc_msg.OffloadFinishRequest, body)
+        with self._lock:
+            job = self._jobs.pop(req.job_id, None)
+            self._c_jobs.set(len(self._jobs))
+        if job is not None:
+            shutil.rmtree(job["dir"], ignore_errors=True)
+        return codec.encode(rpc_msg.OffloadShipResponse(landed=True))
+
+
+# ================================================================= client
+
+# one pool per tenant process: offload traffic multiplexes the same
+# connection per service like any other peer
+_POOL = ConnectionPool()
+
+
+def _call(addr: str, code: str, req, resp_cls, timeout: float = None):
+    host, _, port = addr.rpartition(":")
+    try:
+        conn = _POOL.get((host, int(port)))
+        _, body = conn.call(code, codec.encode(req),
+                            timeout=rpc_timeout_s() if timeout is None
+                            else timeout)
+    except (RpcError, OSError, ValueError) as e:
+        raise OffloadError(f"{code} to {addr}: {e}")
+    resp = codec.decode(resp_cls, body)
+    if resp.error:
+        raise OffloadError(f"{code}: {resp.error_text}")
+    return resp
+
+
+def _call_wave(addr: str, calls: list, what: str) -> list:
+    try:
+        host, _, port = addr.rpartition(":")
+        return _POOL.get((host, int(port))).call_many(
+            calls, timeout=rpc_timeout_s())
+    except (RpcError, OSError) as e:
+        raise OffloadError(f"{what} {addr}: {e}")
+
+
+def _ship_runs(addr: str, job_id: int, entries, payloads, staged) -> dict:
+    """Ship every run the service does not already hold, as bounded
+    CRC'd chunks pipelined through call_many waves (the learn plane's
+    chunk_waves grid). -> stats."""
+    from .learn import chunk_waves
+
+    shipped = skipped = nbytes = 0
+    c_blocks = counters.rate("offload.client.ship_blocks")
+    c_skip = counters.rate("offload.client.skipped_blocks")
+    c_bytes = counters.rate("offload.client.ship_bytes")
+    for entry, payload in zip(entries, payloads):
+        if entry.name in staged:
+            skipped += 1
+            c_skip.increment()
+            continue
+        inject("compact.offload")  # chaos seam: per shipped run
+        for wave in chunk_waves(entry.size, chunk_bytes()):
+            calls = []
+            for off, ln in wave:
+                data = payload[off:off + ln]
+                calls.append((RPC_COMPACT_OFFLOAD_SHIP, codec.encode(
+                    rpc_msg.OffloadShipRequest(
+                        job_id=job_id, name=entry.name, offset=off,
+                        data=data, crc=zlib.crc32(data)))))
+            for _, rbody in _call_wave(addr, calls, "ship to"):
+                resp = codec.decode(rpc_msg.OffloadShipResponse, rbody)
+                if resp.error:
+                    raise OffloadError(f"ship failed: {resp.error_text}")
+        shipped += 1
+        nbytes += entry.size
+        c_blocks.increment()
+    c_bytes.increment(nbytes)
+    return {"shipped_runs": shipped, "skipped_runs": skipped,
+            "shipped_bytes": nbytes}
+
+
+def _fetch_output(addr: str, job_id: int, entry) -> bytes:
+    """Stream one merged output block back (per-chunk CRC + whole-block
+    digest), pipelined through call_many waves on the same grid."""
+    from .learn import chunk_waves
+
+    inject("compact.offload")  # chaos seam: return stage, client side
+    parts = []
+    for wave in chunk_waves(entry.size, chunk_bytes()):
+        calls = [(RPC_COMPACT_OFFLOAD_FETCH, codec.encode(
+            rpc_msg.OffloadFetchRequest(
+                job_id=job_id, name=entry.name, offset=off, length=ln)))
+            for off, ln in wave]
+        for _, rbody in _call_wave(addr, calls, "fetch from"):
+            resp = codec.decode(rpc_msg.LearnFetchResponse, rbody)
+            if resp.error:
+                raise OffloadError(f"fetch failed: {resp.error_text}")
+            if zlib.crc32(resp.data) != resp.crc:
+                raise OffloadError(f"fetch chunk CRC mismatch ({entry.name})")
+            parts.append(resp.data)
+    data = b"".join(parts)
+    if len(data) != entry.size or _md5(data) != entry.digest:
+        raise OffloadError(f"fetched output {entry.name} digest mismatch")
+    counters.rate("offload.client.fetch_bytes").increment(len(data))
+    return data
+
+
+def _offload_once(blocks, opts: CompactOptions, addr: str,
+                  tenant: str) -> CompactResult:
+    """One remote ship/merge/fetch round (the lane guard retries this
+    whole function; content-addressed staging makes a retry resume)."""
+    runs = [b for b in blocks if b.n]
+    payloads = [pack_run_bytes(b) for b in runs]
+    entries = [rpc_msg.LearnBlockEntry(f"run.{i}", len(p), _md5(p))
+               for i, p in enumerate(payloads)]
+    with _TRACE.span("offload.ship", records=sum(b.n for b in runs),
+                     nbytes=sum(len(p) for p in payloads)):
+        begin = _call(addr, RPC_COMPACT_OFFLOAD_BEGIN,
+                      rpc_msg.OffloadBeginRequest(
+                          tenant=tenant, gpid=f"{opts.pidx}",
+                          runs=entries, opts_json=wire_opts(opts)),
+                      rpc_msg.OffloadBeginResponse)
+        ship = _ship_runs(addr, begin.job_id, entries, payloads,
+                          set(begin.staged))
+    try:
+        with _TRACE.span("offload.merge", records=sum(b.n for b in runs)):
+            inject("compact.offload")  # chaos seam: merge stage, client side
+            m = _call(addr, RPC_COMPACT_OFFLOAD_MERGE,
+                      rpc_msg.OffloadMergeRequest(job_id=begin.job_id),
+                      rpc_msg.OffloadMergeResponse,
+                      timeout=merge_timeout_s())
+        with _TRACE.span("offload.fetch",
+                         nbytes=sum(e.size for e in m.outputs)) as sp:
+            out_parts = [_fetch_output(addr, begin.job_id, e)
+                         for e in m.outputs]
+            out = unpack_run_bytes(out_parts[0]) if out_parts else None
+            sp["records"] = out.n if out is not None else 0
+    finally:
+        try:
+            _call(addr, RPC_COMPACT_OFFLOAD_FINISH,
+                  rpc_msg.OffloadFinishRequest(job_id=begin.job_id),
+                  rpc_msg.OffloadShipResponse)
+        except OffloadError:
+            pass  # the job TTL covers an unreachable service
+    from ..engine.block import KVBlock
+
+    out = out if out is not None else KVBlock.empty()
+    # tenant-side post passes (user rules, default-TTL rewrite) — the
+    # sharded_compact_block pattern; the service merged with them masked
+    out = apply_post_filters(out, opts, opts.now)
+    stats = json.loads(m.stats_json or "{}")
+    stats.update(ship)
+    stats.update({"offloaded": True, "service": addr,
+                  "output_records": out.n,
+                  "fetched_bytes": sum(e.size for e in m.outputs)})
+    counters.rate("offload.client.merge_count").increment()
+    return CompactResult(out, stats)
+
+
+def offload_compact_blocks(blocks, opts: CompactOptions, addr: str,
+                           tenant: str = "",
+                           guard: LaneGuard = None) -> CompactResult:
+    """Node-side merge entry: compact `blocks` on the remote offload
+    service at `addr`, byte-identical to ``compact_blocks(blocks, opts)``
+    on cpu. Runs under OFFLOAD_LANE_GUARD: a dead/slow/breaker-open
+    service falls back to the LOCAL cpu merge — latency, never
+    availability, never different bytes."""
+    from ..ops.compact import compact_blocks
+
+    guard = OFFLOAD_LANE_GUARD if guard is None else guard
+    # resolve the clock ONCE: remote kernel drops and local post filters
+    # (and the cpu fallback) must agree on `now` or TTL edges diverge
+    opts = replace(opts, now=opts.resolved_now())
+
+    def _remote() -> CompactResult:
+        return _offload_once(blocks, opts, addr, tenant)
+
+    def _local() -> CompactResult:
+        return compact_blocks(blocks, replace(opts, backend="cpu"))
+
+    return guard.run(_remote, _local, op="offload_compact")

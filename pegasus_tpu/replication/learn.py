@@ -32,6 +32,7 @@ Counters (learner-side, so the replay-vs-ship win is measurable on CPU):
 """
 
 import hashlib
+import json
 import os
 import zlib
 
@@ -40,6 +41,16 @@ from ..rpc import messages as rpc_msg
 from ..rpc.transport import RpcError
 from ..runtime.fail_points import inject
 from ..runtime.perf_counters import counters
+
+
+def _warm_verify_counters() -> None:
+    """Pre-register the arrival-proof counters (zeros before the first
+    learn; the chaos/satellite tests counter-assert against them)."""
+    counters.rate("learn.verify.incremental_count")
+    counters.rate("learn.verify.rescan_count")
+
+
+_warm_verify_counters()
 
 
 class LearnShipError(ConnectionError):
@@ -73,6 +84,56 @@ def pin_ttl_s() -> float:
     return float(os.environ.get("PEGASUS_LEARN_PIN_TTL_S", "600"))
 
 
+def incremental_digest_enabled() -> bool:
+    """PEGASUS_LEARN_INCREMENTAL_DIGEST=0 forces the learner's arrival
+    proof back to the full staged-state rescan (the incremental
+    per-block fold is the default — O(delta), see manifest_fold)."""
+    return os.environ.get("PEGASUS_LEARN_INCREMENTAL_DIGEST", "1") != "0"
+
+
+def manifest_fold(entries) -> str:
+    """Commutative fold over a block manifest's (name, digest) pairs —
+    the incremental staged-state digest (ISSUE 14 satellite, learn
+    follow-on c). ``stage_blocks`` maintains the same fold over the
+    blocks it VERIFIED; since every staging path verifies against the
+    manifest's digest, equality with the manifest fold is a
+    COMPLETENESS invariant (every manifest entry went through a
+    verification path — a future staging edit that skips one breaks the
+    fold), not an independent re-derivation of the bytes. The per-block
+    integrity itself comes from the stage-time checks: fetched blocks
+    hash on landing, reused blocks hash (or share the just-hashed
+    inode), and previously-verified blocks are trusted via the
+    sidecar's stat identity — the O(delta) contract's one residual
+    trust window (an in-place rewrite that preserves size AND mtime_ns
+    evades it; ``PEGASUS_LEARN_INCREMENTAL_DIGEST=0`` restores the full
+    record-level rescan for deployments that cannot accept that). XOR +
+    additive-sum of a crc64 per entry, the state_digest combine shape,
+    so block order cannot matter."""
+    from ..base.crc64 import crc64
+
+    xor = add = 0
+    for e in entries:
+        name = e["name"] if isinstance(e, dict) else e[0]
+        digest = e["digest"] if isinstance(e, dict) else e[1]
+        c = crc64(name.encode() + b"\x00" + digest.encode())
+        xor ^= c
+        add = (add + c) & 0xFFFFFFFFFFFFFFFF
+    return f"{xor:016x}{add:016x}"
+
+
+def chunk_waves(total: int, chunk: int, wave_bytes: int = 8 << 20):
+    """Yield bounded waves of (offset, length) descriptors covering a
+    `total`-byte block — the ONE chunk grid under every chunked
+    transfer plane (learn fetch, offload ship, offload fetch): each
+    wave's in-flight byte volume stays under `wave_bytes`, and a
+    zero-byte block still yields its single empty-chunk descriptor."""
+    offs = list(range(0, total, chunk)) or [0]
+    per = max(1, wave_bytes // chunk)
+    for i in range(0, len(offs), per):
+        yield [(off, min(chunk, max(0, total - off)))
+               for off in offs[i:i + per]]
+
+
 def file_digest(path: str) -> str:
     """Content digest for block identity (md5: C-speed streaming; this
     is a transfer-dedup key, not a security boundary — corruption on the
@@ -100,6 +161,8 @@ def dir_manifest(dirpath: str, suffix: str = None) -> list:
             continue
         if name.endswith(".part"):
             continue  # torn partial from an interrupted ship
+        if name.startswith("."):
+            continue  # sidecar state (.staged.json), never a block
         p = os.path.join(dirpath, name)
         try:
             if not os.path.isfile(p):
@@ -109,6 +172,39 @@ def dir_manifest(dirpath: str, suffix: str = None) -> list:
         except OSError:
             continue
     return out
+
+
+_SIDECAR = ".staged.json"
+
+
+def _load_sidecar(dest_dir: str) -> dict:
+    """{name: {"digest", "size", "mtime_ns"}} of blocks a PRIOR
+    stage_blocks verified into `dest_dir` — the O(1) resume check: a
+    stat match against the recorded identity replaces the md5 rescan,
+    a mismatch falls back to hashing. The stat identity is a TRUST
+    decision (see manifest_fold's docstring for the window it leaves);
+    PEGASUS_LEARN_INCREMENTAL_DIGEST=0 removes it entirely."""
+    try:
+        with open(os.path.join(dest_dir, _SIDECAR)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_sidecar(dest_dir: str, entries: dict) -> None:
+    tmp = os.path.join(dest_dir, _SIDECAR + ".tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(entries, f)
+        os.replace(tmp, os.path.join(dest_dir, _SIDECAR))
+    except OSError:
+        pass  # best-effort: a lost sidecar just re-hashes next learn
+
+
+def _stat_entry(path: str, digest: str) -> dict:
+    st = os.stat(path)
+    return {"digest": digest, "size": st.st_size,
+            "mtime_ns": st.st_mtime_ns}
 
 
 def _link_or_copy(src: str, dst: str) -> None:
@@ -128,18 +224,14 @@ def _fetch_block(source, learn_id: int, entry: dict, dest_dir: str) -> int:
     digest matched the manifest entry. -> bytes fetched."""
     inject("learn.ship")  # chaos seam: a mid-ship abort on the learner
     name, total = entry["name"], entry["size"]
-    cb = chunk_bytes()
-    offs = list(range(0, total, cb)) or [0]
     part = os.path.join(dest_dir, name + ".part")
     fetched = 0
-    # one wave per bounded group of chunks: pipelined over call_many for
-    # an RPC source, a plain loop for an in-process one — either way the
-    # in-flight byte volume stays bounded by wave_chunks * chunk_bytes
-    wave_chunks = max(1, (8 << 20) // cb)
+    # one RPC round per bounded wave: pipelined over call_many for an
+    # RPC source, a plain loop for an in-process one — either way the
+    # in-flight byte volume stays wave-bounded (chunk_waves)
     with open(part, "wb") as f:
-        for i in range(0, len(offs), wave_chunks):
-            reqs = [(name, off, min(cb, max(0, total - off)))
-                    for off in offs[i:i + wave_chunks]]
+        for wave in chunk_waves(total, chunk_bytes()):
+            reqs = [(name, off, ln) for off, ln in wave]
             chunks = source.fetch_learn_chunks(learn_id, reqs)
             for (_, off, ln), ch in zip(reqs, chunks):
                 data = ch["data"]
@@ -171,9 +263,16 @@ def stage_blocks(source, st: dict, dest_dir: str, reuse: dict = None,
     stats = {"blocks": len(st["blocks"]), "fetched": 0, "bytes": 0,
              "skipped": 0, "resumed": 0}
     reuse = dict(reuse or {}) if delta else {}
+    # the sidecar records every block a prior stage VERIFIED (digest +
+    # stat identity): an untouched staged block resumes on a stat match
+    # — no re-hash — which is what makes the whole stage, and the
+    # arrival proof built on its fold, O(delta) per learn
+    sidecar = _load_sidecar(dest_dir) if delta else {}
+    verified = []  # (name, digest) pairs proven this stage -> stats["fold"]
     want = {e["name"] for e in st["blocks"]}
     for name in os.listdir(dest_dir):
-        if name not in want:
+        if name not in want and not name.startswith("."):
+            sidecar.pop(name, None)
             try:
                 os.unlink(os.path.join(dest_dir, name))
             except OSError:
@@ -181,33 +280,69 @@ def stage_blocks(source, st: dict, dest_dir: str, reuse: dict = None,
     c_blocks = counters.rate("learn.ship.blocks")
     c_bytes = counters.rate("learn.ship.bytes")
     c_skip = counters.rate("learn.ship.delta_skipped_blocks")
-    for entry in st["blocks"]:
-        dst = os.path.join(dest_dir, entry["name"])
-        if delta:
-            try:
-                if os.path.isfile(dst) \
-                        and file_digest(dst) == entry["digest"]:
-                    stats["resumed"] += 1  # staged by an interrupted ship
-                    c_skip.increment()
-                    continue
-            except OSError:
-                pass
-            src = reuse.get(entry["digest"])
-            if src is not None:
+    try:
+        for entry in st["blocks"]:
+            dst = os.path.join(dest_dir, entry["name"])
+            if delta:
+                side = sidecar.get(entry["name"])
                 try:
-                    _link_or_copy(src, dst)
-                    if file_digest(dst) == entry["digest"]:
-                        stats["skipped"] += 1  # delta: learner had it
+                    if side is not None and side["digest"] == entry["digest"] \
+                            and _stat_entry(dst, entry["digest"]) == side:
+                        # sidecar fast path: identity unchanged since the
+                        # last verified stage — O(1), no re-hash
+                        stats["resumed"] += 1
+                        verified.append((entry["name"], entry["digest"]))
                         c_skip.increment()
                         continue
-                    os.unlink(dst)
                 except OSError:
-                    pass  # vanished under us: stream it instead
-        stats["bytes"] += _fetch_block(source, st["learn_id"], entry,
-                                       dest_dir)
-        stats["fetched"] += 1
-        c_blocks.increment()
+                    pass
+                try:
+                    if os.path.isfile(dst) \
+                            and file_digest(dst) == entry["digest"]:
+                        stats["resumed"] += 1  # staged by an interrupted ship
+                        sidecar[entry["name"]] = _stat_entry(
+                            dst, entry["digest"])
+                        verified.append((entry["name"], entry["digest"]))
+                        c_skip.increment()
+                        continue
+                except OSError:
+                    pass
+                src = reuse.get(entry["digest"])
+                if src is not None:
+                    try:
+                        _link_or_copy(src, dst)
+                        # a HARDLINK shares the source inode, whose digest
+                        # the caller's have-manifest just computed — the
+                        # O(n) re-hash of every reused block is only
+                        # needed when the link degraded to a copy
+                        same_inode = os.stat(dst).st_ino == \
+                            os.stat(src).st_ino
+                        if same_inode or file_digest(dst) == entry["digest"]:
+                            stats["skipped"] += 1  # delta: learner had it
+                            sidecar[entry["name"]] = _stat_entry(
+                                dst, entry["digest"])
+                            verified.append((entry["name"], entry["digest"]))
+                            c_skip.increment()
+                            continue
+                        os.unlink(dst)
+                    except OSError:
+                        pass  # vanished under us: stream it instead
+            stats["bytes"] += _fetch_block(source, st["learn_id"], entry,
+                                           dest_dir)
+            stats["fetched"] += 1
+            sidecar[entry["name"]] = _stat_entry(dst, entry["digest"])
+            verified.append((entry["name"], entry["digest"]))
+            c_blocks.increment()
+    finally:
+        # partial progress persists: an aborted ship's retry resumes
+        # against what landed (the sidecar only ever names VERIFIED
+        # blocks, so a torn write can't be trusted by mistake)
+        _save_sidecar(dest_dir, sidecar)
     c_bytes.increment(stats["bytes"])
+    # the incremental staged-state digest: fold of exactly the verified
+    # set — equals manifest_fold(st["blocks"]) iff the staged dir holds
+    # the checkpoint's bytes, block for block
+    stats["fold"] = manifest_fold(verified)
     return stats
 
 

@@ -1127,6 +1127,12 @@ class ReplicaStub:
             except ValueError as e:
                 applied[gpid] = f"error: {e}"
                 continue
+            if "where" in dec:
+                # the placement half of the (when, where) pair (ISSUE
+                # 14): same lease as the policy token — expiry reverts
+                # this engine to local compaction
+                rep.server.engine.set_offload_target(dec.get("where") or "",
+                                                     ttl_s=ttl)
             applied[gpid] = policy
         return json.dumps(applied)
 
@@ -1147,6 +1153,10 @@ class ReplicaStub:
             debt = rep.server.engine.compaction_debt()
             out[gpid] = {"policy": policy, "reasons": reasons,
                          "expires_in_s": round(expires_in, 3),
+                         # the WHERE half (ISSUE 14): which compaction
+                         # service this engine's merges ship to ("" =
+                         # local), with the live-lease check applied
+                         "offload": rep.server.engine.offload_target() or "",
                          "l0_files": debt["l0_files"],
                          "debt_bytes": debt["debt_bytes"],
                          "pending_installs": debt["pending_installs"],

@@ -422,6 +422,89 @@ class LearnFinishRequest:
     learn_id: int = 0
 
 
+# ------------------------------------------------- compaction offload (ISSUE 14)
+# One device-owning compaction service per TPU host serves many CPU-only
+# replica nodes: a tenant ships packed runs (content-addressed, chunked,
+# CRC-checked — the learn plane's streaming shape), the service merges
+# them on its device and the tenant fetches the merged output back.
+# Block identity reuses LearnBlockEntry (name + size + content digest);
+# chunk fetches reuse LearnFetchResponse (data + crc + total).
+
+
+@dataclass
+class OffloadBeginRequest:
+    """Open one merge job: the manifest of packed runs (newest first —
+    run order IS merge priority) plus the merge options as JSON (the
+    wire-safe CompactOptions subset; user rules and default-TTL rewrite
+    stay tenant-side, the sharded_compact_block post-filter pattern)."""
+
+    tenant: str = ""
+    gpid: str = ""
+    runs: List[LearnBlockEntry] = field(default_factory=list)
+    opts_json: str = ""
+
+
+@dataclass
+class OffloadBeginResponse:
+    error: int = 0
+    error_text: str = ""
+    job_id: int = 0
+    # run names already fully staged (content-address hit from an earlier
+    # interrupted ship or a sibling tenant) — the resume/dedup set
+    staged: List[str] = field(default_factory=list)
+
+
+@dataclass
+class OffloadShipRequest:
+    """One bounded chunk of one packed run, written at its offset (chunks
+    of a block may land out of order across the RPC pool)."""
+
+    job_id: int = 0
+    name: str = ""
+    offset: int = 0
+    data: bytes = b""
+    crc: int = 0               # crc32 of `data`
+
+
+@dataclass
+class OffloadShipResponse:
+    error: int = 0
+    error_text: str = ""
+    landed: bool = False       # block complete + whole-file digest verified
+
+
+@dataclass
+class OffloadMergeRequest:
+    job_id: int = 0
+
+
+@dataclass
+class OffloadMergeResponse:
+    error: int = 0
+    error_text: str = ""
+    outputs: List[LearnBlockEntry] = field(default_factory=list)
+    stats_json: str = ""
+
+
+@dataclass
+class OffloadFetchRequest:
+    """One bounded chunk of a merged output block (response:
+    LearnFetchResponse — data + per-chunk crc + whole-block size)."""
+
+    job_id: int = 0
+    name: str = ""
+    offset: int = 0
+    length: int = 0
+
+
+@dataclass
+class OffloadFinishRequest:
+    """Release the job (staged runs stay content-addressed for reuse;
+    the job dir and its outputs drop)."""
+
+    job_id: int = 0
+
+
 def match_filter(filter_type: int, pattern: bytes, data: bytes) -> bool:
     """The anywhere/prefix/postfix matcher shared by scans and multi_get."""
     if filter_type == FilterType.NO_FILTER or not pattern:

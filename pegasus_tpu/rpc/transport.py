@@ -218,6 +218,12 @@ class RpcServer:
                                       thread_name_prefix="rpc-serve")
         self._busy = 0
         self._busy_lock = threading.Lock()
+        # live accepted connections: stop() shuts them down so a stopped
+        # server looks like a KILLED one to its peers (in-flight calls
+        # fail fast instead of dangling until the client timeout — the
+        # chaos service-kill actor depends on this)
+        self._conn_lock = threading.Lock()
+        self._conns = set()  #: guarded_by self._conn_lock
         self._depth_gauge = counters.number("rpc.server.dispatch_queue_depth")
         outer = self
 
@@ -244,6 +250,8 @@ class RpcServer:
             return
         wlock = threading.Lock()
         dispatch = self._dispatch
+        with self._conn_lock:
+            self._conns.add(sock)
         try:
             reader = make_frame_reader(sock, initial)
             while True:
@@ -251,6 +259,9 @@ class RpcServer:
                     dispatch(sock, wlock, header, body)
         except (ConnectionError, OSError):
             pass
+        finally:
+            with self._conn_lock:
+                self._conns.discard(sock)
 
     def serve_adopted(self, sock, initial: bytes = b"") -> None:
         """Adopt a connection accepted elsewhere (the partition-group
@@ -287,6 +298,17 @@ class RpcServer:
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # shutdown (never close — the handler thread owns the fd and a
+        # cross-thread close could race a reused descriptor) every live
+        # connection: peers see EOF now, exactly like a process kill,
+        # instead of requests silently dangling until their timeouts
+        with self._conn_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._pool.shutdown(wait=False)
 
     def _dispatch(self, sock, wlock, header: RpcHeader, body: bytes) -> None:

@@ -44,10 +44,13 @@ from .perf_counters import counters
 from .tasking import spawn_thread
 
 _DEFAULT_PREFIXES = (
-    "compact.lane.", "read.lane.", "engine.", "rpc.server.",
+    "compact.lane.", "read.lane.", "offload.", "engine.", "rpc.server.",
     "plog.", "serve.group.", "replica.", "dup.lag.", "events.",
     "request.trace.", "manual_compact.", "doctor.", "incident.",
     "collector.", "sched.", "audit.",
+    # the compaction stage spans' duration p99s: the series the
+    # scheduler's feedback tuner folds (ISSUE 14 satellite)
+    "compact.stage.",
 )
 
 

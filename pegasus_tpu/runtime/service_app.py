@@ -723,6 +723,58 @@ class CollectorApp:
         self.rpc.stop()
 
 
+class CompactOffloadApp:
+    """The fourth server role (ISSUE 14): one device-owning compaction
+    service per TPU host, serving many cpu-only replica nodes. Config:
+
+        [apps.compact_offload]
+        run = true
+        port = 34901            ; what nodes' placement leases dial
+        backend = tpu           ; default: pegasus.server compaction_backend
+        job_dir = ...           ; staged-run + job spool (default per-app)
+
+    Point the collector's scheduler at it with
+    ``PEGASUS_OFFLOAD_SERVICES=host:34901`` and the fold starts emitting
+    (when, where) pairs against its free merge budget."""
+
+    def __init__(self, name, config: Config, section: str):
+        from ..replication.compact_offload import CompactOffloadService
+
+        backend = config.get_string(
+            section, "backend",
+            config.get_string("pegasus.server", "compaction_backend", "cpu"))
+        root = config.get_string(section, "job_dir",
+                                 os.path.join("pegasus-data", name))
+        self.svc = CompactOffloadService(
+            root,
+            host=config.get_string(section, "host", "127.0.0.1"),
+            port=config.get_int(section, "port", 0),
+            backend=backend)
+
+    @property
+    def address(self):
+        return self.svc.address
+
+    def start(self):
+        from .metric_history import HISTORY
+
+        self.svc.start()
+        HISTORY.start()
+        self._history_ref = True
+        print(f"[pegasus-tpu] compaction offload service on "
+              f"{self.svc.address} (backend {self.svc.backend})", flush=True)
+        return self
+
+    def stop(self):
+        if getattr(self, "_history_ref", False):
+            self._history_ref = False
+            from .metric_history import HISTORY
+
+            HISTORY.stop()
+        self.svc.stop()
+
+
 register_app_factory("meta", MetaApp)
 register_app_factory("replica", ReplicaApp)
 register_app_factory("collector", CollectorApp)
+register_app_factory("compact_offload", CompactOffloadApp)

@@ -80,6 +80,10 @@ class Shell:
                               "(defer/normal/urgent + the reasons that "
                               "drove them + live debt) from every node's "
                               "compact-sched-status"),
+            "offload_status": (self.cmd_offload_status,
+                               "offload_status <host:port> — a compaction-"
+                               "offload service's free merge budget, "
+                               "running merges, jobs and staged bytes"),
             "remote_command": (self.cmd_remote_command,
                                "remote_command <node|all> <cmd> [args...]"),
             "server_info": (self.cmd_server_info, "server-info on every node"),
@@ -564,12 +568,23 @@ class Shell:
                     self.p(f"  {gpid}: {d}")
                     continue
                 reasons = ",".join(d.get("reasons", [])) or "-"
-                self.p(f"  {gpid}: {d['policy']:<7} reasons={reasons} "
+                where = d.get("offload") or "local"
+                self.p(f"  {gpid}: {d['policy']:<7} where={where} "
+                       f"reasons={reasons} "
                        f"l0={d.get('l0_files', 0)}"
                        f"/{d.get('ceiling_files', '?')} "
                        f"debt_bytes={d.get('debt_bytes', 0)} "
                        f"pending={d.get('pending_installs', 0)} "
                        f"expires_in={d.get('expires_in_s', 0)}s")
+
+    def cmd_offload_status(self, args):
+        """One compaction-offload service's live state: free merge
+        budget (what the scheduler's placement fold consumes), running
+        merges, active jobs, staged bytes."""
+        if not args:
+            self.p("usage: offload_status <host:port>")
+            return
+        self.p(self._node_command(args[0], "offload-status", []))
 
     def cmd_remote_command(self, args):
         target, cmd, rest = args[0], args[1], args[2:]

@@ -1,0 +1,507 @@
+"""ISSUE 14: rack-scale compaction offload — one device-owning
+compaction service serving many CPU-only replica nodes.
+
+Pinned here:
+  - the run wire codec round-trips a KVBlock exactly;
+  - a merge through the remote service (real sockets) is byte-identical
+    to the local cpu merge, including with user compaction rules and a
+    table default-TTL (the tenant-side post-filter pattern);
+  - an interrupted ship RESUMES: a retry ships only the runs that never
+    landed (content-addressed staging), and a fail-point-aborted round
+    is retried by the offload lane without a local fallback;
+  - a dead service means the node's byte-identical LOCAL cpu fallback,
+    bounded, never a stall; the admission gate refuses (not queues) over
+    cap and the refused tenant degrades the same way;
+  - engine-level byte identity: a partition compacted through a
+    placement lease produces SSTs byte-identical to local compaction
+    (elective trigger + manual compact), and the lease expires back to
+    local like every other scheduler token;
+  - the scheduler fold emits (when, where) pairs against the services'
+    free budget, localize passes placement through, and the feedback
+    tuner rescales the urgency thresholds from measured stage costs;
+  - chaos: killing the offload service mid-run engages the lane
+    fallback with zero lost acked writes and identical post-run digests.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.engine.block import KVBlock
+from pegasus_tpu.engine.db import EngineOptions, LsmEngine, WriteBatch
+from pegasus_tpu.ops.compact import CompactOptions, compact_blocks
+from pegasus_tpu.ops.packing import pack_run_bytes, unpack_run_bytes
+from pegasus_tpu.replication.compact_offload import (
+    OFFLOAD_LANE_GUARD, CompactOffloadService, offload_compact_blocks)
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.lane_guard import LaneGuard, LaneGuardConfig
+from pegasus_tpu.runtime.perf_counters import counters
+
+
+@pytest.fixture
+def failpoints():
+    fp.setup()
+    yield fp
+    fp.teardown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_lane():
+    OFFLOAD_LANE_GUARD.reset()
+    yield
+    OFFLOAD_LANE_GUARD.reset()
+
+
+@pytest.fixture
+def svc(tmp_path):
+    s = CompactOffloadService(str(tmp_path / "offload_svc"),
+                              backend="cpu").start()
+    yield s
+    s.stop()
+
+
+def _mk_run(seed, n=400, keyspace=200, deleted_every=0):
+    recs = {}
+    for i in range(n):
+        k = generate_key(b"h%03d" % ((seed * 31 + i) % 17),
+                         b"s%05d" % ((seed * 7 + i) % keyspace))
+        recs[k] = (k, b"val%04d.%d" % (i, seed), 0,
+                   bool(deleted_every and i % deleted_every == 0))
+    return KVBlock.from_records(
+        sorted(recs.values(), key=lambda r: r[0]))
+
+
+def _runs(k=3):
+    return [_mk_run(s, deleted_every=(7 if s == 0 else 0)) for s in range(k)]
+
+
+def _blk_equal(a, b):
+    return all(np.array_equal(getattr(a, c), getattr(b, c))
+               for c in ("key_arena", "key_off", "key_len", "val_arena",
+                         "val_off", "val_len", "expire_ts", "hash32",
+                         "deleted"))
+
+
+# --------------------------------------------------------------- run wire
+
+
+def test_run_wire_round_trip():
+    b = _mk_run(1, deleted_every=5)
+    rt = unpack_run_bytes(pack_run_bytes(b))
+    assert _blk_equal(b, rt)
+    # deterministic: same block, same bytes (the content address)
+    assert pack_run_bytes(b) == pack_run_bytes(rt)
+    empty = unpack_run_bytes(pack_run_bytes(KVBlock.empty()))
+    assert empty.n == 0
+
+
+def test_run_wire_rejects_garbage():
+    with pytest.raises(ValueError):
+        unpack_run_bytes(b"not a run at all" * 4)
+
+
+# ------------------------------------------------------ block-level merge
+
+
+def test_offloaded_merge_byte_identical(svc):
+    runs = _runs()
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True,
+                          bottommost=True)
+    local = compact_blocks(runs, opts)
+    remote = offload_compact_blocks(runs, opts, svc.address, tenant="t1")
+    assert _blk_equal(local.block, remote.block)
+    assert remote.stats["offloaded"] is True
+    assert remote.stats["service"] == svc.address
+    assert remote.stats["shipped_runs"] == 3
+    assert OFFLOAD_LANE_GUARD.state()["fallbacks"] == 0
+
+
+def test_offloaded_merge_with_post_filters_byte_identical(svc):
+    """default_ttl (and the user-rule slot) run tenant-side after the
+    fetch — the service never sees them, the bytes still match."""
+    runs = _runs()
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True,
+                          bottommost=True, default_ttl=3600)
+    local = compact_blocks(runs, opts)
+    remote = offload_compact_blocks(runs, opts, svc.address, tenant="t1")
+    assert _blk_equal(local.block, remote.block)
+    assert int(remote.block.expire_ts.max()) == 100 + 3600
+
+
+def test_interrupted_ship_resumes_content_addressed(svc):
+    """A second round over the same runs ships ZERO bytes: the staging
+    is content-addressed, so whatever landed (even under a different
+    job) is reused — the mid-ship-kill resume story."""
+    runs = _runs()
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True)
+    local = compact_blocks(runs, opts)
+    r1 = offload_compact_blocks(runs, opts, svc.address, tenant="t1")
+    assert r1.stats["shipped_runs"] == 3
+    r2 = offload_compact_blocks(runs, opts, svc.address, tenant="t1")
+    assert r2.stats["shipped_runs"] == 0
+    assert r2.stats["skipped_runs"] == 3
+    assert r2.stats["shipped_bytes"] == 0
+    assert _blk_equal(local.block, r1.block)
+    assert _blk_equal(local.block, r2.block)
+
+
+def test_mid_ship_abort_retries_without_fallback(svc, failpoints):
+    """A fail-point abort mid-round is a transient: the offload lane
+    RETRIES (resuming staged runs) and still returns the remote merge —
+    no local fallback, byte-identical output."""
+    runs = _runs()
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True)
+    local = compact_blocks(runs, opts)
+    failpoints.cfg("compact.offload", "2*raise(chaos mid-ship)")
+    remote = offload_compact_blocks(runs, opts, svc.address, tenant="t1")
+    assert _blk_equal(local.block, remote.block)
+    lane = OFFLOAD_LANE_GUARD.state()
+    assert lane["fallbacks"] == 0
+    assert lane["retries"] >= 1
+
+
+def test_dead_service_falls_back_bounded():
+    """No service listening: the guard degrades to the LOCAL cpu merge
+    — byte-identical, and bounded (no stall)."""
+    runs = _runs()
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True)
+    local = compact_blocks(runs, opts)
+    guard = LaneGuard(LaneGuardConfig(deadline_s=30.0, max_retries=0),
+                      metric_prefix="offload.lane")
+    t0 = time.monotonic()
+    remote = offload_compact_blocks(runs, opts, "127.0.0.1:1",
+                                    tenant="t1", guard=guard)
+    assert time.monotonic() - t0 < 20.0
+    assert _blk_equal(local.block, remote.block)
+    assert guard.state()["fallbacks"] == 1
+
+
+def test_admission_gate_refuses_over_cap(tmp_path, monkeypatch):
+    """Merges over the service cap are REFUSED, not queued; the refused
+    tenant's lane falls back to local cpu — same bytes either way."""
+    import importlib
+
+    import pegasus_tpu.parallel as par
+
+    # the package re-exports the sharded_compact FUNCTION under the
+    # submodule's name, so fetch the module itself for patching
+    shc = importlib.import_module("pegasus_tpu.parallel.sharded_compact")
+    svc = CompactOffloadService(str(tmp_path / "svc1"), backend="cpu",
+                                max_concurrent=1).start()
+    release = threading.Event()
+    real = shc.compact_blocks_meshed
+
+    def slow(blocks, opts, mesh=None):
+        release.wait(20.0)
+        return real(blocks, opts, mesh)
+
+    monkeypatch.setattr(shc, "compact_blocks_meshed", slow)
+    monkeypatch.setattr(par, "compact_blocks_meshed", slow)
+    runs_a, runs_b = _runs(), [_mk_run(s + 10) for s in range(2)]
+    opts = CompactOptions(backend="cpu", now=100, runs_sorted=True)
+    local_b = compact_blocks(runs_b, opts)
+    guard = LaneGuard(LaneGuardConfig(deadline_s=60.0, max_retries=0),
+                      metric_prefix="offload.lane")
+    box = {}
+
+    def first():
+        box["a"] = offload_compact_blocks(runs_a, opts, svc.address,
+                                          tenant="slow", guard=guard)
+
+    t = threading.Thread(target=first, daemon=True)
+    t.start()
+    # wait until the slow merge actually occupies the one slot
+    deadline = time.monotonic() + 10.0
+    while svc.status()["running_merges"] < 1:
+        assert time.monotonic() < deadline, "merge never started"
+        time.sleep(0.02)
+    try:
+        r_b = offload_compact_blocks(runs_b, opts, svc.address,
+                                     tenant="refused", guard=guard)
+        assert _blk_equal(local_b.block, r_b.block)
+        assert guard.state()["fallbacks"] == 1  # refused -> local cpu
+        assert counters.rate(
+            "offload.service.reject_count").total() >= 1
+    finally:
+        release.set()
+        t.join(timeout=30.0)
+        svc.stop()
+    assert "a" in box  # the slow tenant's merge still completed
+
+
+# ------------------------------------------------------------ engine level
+
+
+def _engine_load(eng, n=1200, flush_every=300):
+    d = 0
+    for i in range(n):
+        d += 1
+        k = generate_key(b"h%03d" % (i % 40), b"s%05d" % (i % 400))
+        eng.write(WriteBatch().put(k, b"v%06d" % i), d)
+        if i % flush_every == flush_every - 1:
+            eng.flush()
+    eng.flush()
+
+
+def _sst_files(path):
+    out = {}
+    for n in sorted(os.listdir(path)):
+        if n.endswith(".sst"):
+            with open(os.path.join(path, n), "rb") as f:
+                out[n] = f.read()
+    return out
+
+
+def _eopts():
+    return EngineOptions(backend="cpu", l0_compaction_trigger=2,
+                         memtable_bytes=1 << 20)
+
+
+def test_engine_offloaded_ssts_byte_identical(tmp_path, svc):
+    """The acceptance bar: elective (trigger) and manual merges routed
+    through the placement lease produce SST files byte-identical to
+    local compaction — names, headers, columns, blooms."""
+    a = LsmEngine(str(tmp_path / "local"), _eopts())
+    b = LsmEngine(str(tmp_path / "offl"), _eopts())
+    b.set_offload_target(svc.address, ttl_s=600)
+    try:
+        _engine_load(a)
+        _engine_load(b)
+        a.manual_compact(now=100)
+        b.manual_compact(now=100)
+    finally:
+        a.close()
+        b.close()
+    assert _sst_files(a.path) == _sst_files(b.path)
+    assert counters.rate("engine.compact.offload_count").total() > 0
+    assert OFFLOAD_LANE_GUARD.state()["fallbacks"] == 0
+    assert b.stats()["compact_offload"] == svc.address
+
+
+def test_engine_dead_service_byte_identical_fallback(tmp_path):
+    """A placement lease pointing at a DEAD service: every merge rides
+    the lane fallback — same SST bytes as a local engine, no stall."""
+    a = LsmEngine(str(tmp_path / "local"), _eopts())
+    b = LsmEngine(str(tmp_path / "offl"), _eopts())
+    b.set_offload_target("127.0.0.1:1", ttl_s=600)
+    try:
+        _engine_load(b, n=600)
+        _engine_load(a, n=600)
+        a.manual_compact(now=100)
+        b.manual_compact(now=100)
+    finally:
+        a.close()
+        b.close()
+    assert _sst_files(a.path) == _sst_files(b.path)
+    assert OFFLOAD_LANE_GUARD.state()["fallbacks"] > 0
+
+
+def test_placement_lease_expires_to_local(tmp_path):
+    eng = LsmEngine(str(tmp_path / "e"), _eopts())
+    try:
+        eng.set_offload_target("127.0.0.1:9", ttl_s=0.05)
+        assert eng.offload_target() == "127.0.0.1:9"
+        time.sleep(0.1)
+        assert eng.offload_target() is None  # lease lapsed -> local
+        eng.set_offload_target("127.0.0.1:9", ttl_s=30)
+        eng.set_offload_target("", ttl_s=30)  # explicit clear
+        assert eng.offload_target() is None
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------- scheduler placement
+
+
+def _part(node="n1:1", l0=0, debt=0, gap=0, ceiling=12):
+    return {"node": node, "l0_files": l0, "debt_bytes": debt,
+            "apply_gap": gap, "ceiling_files": ceiling,
+            "pending_installs": 0}
+
+
+KNOBS = {"urgent_l0": 4, "backlog_urgent": 64, "max_urgent_per_node": 2,
+         "max_device": 0, "ttl_s": 30.0}
+
+
+def test_fold_emits_when_where_pairs():
+    from pegasus_tpu.collector.compact_scheduler import fold_decisions
+
+    parts = {
+        "1.0": _part(l0=5, debt=500),     # debtiest -> placed
+        "1.1": _part(l0=3, debt=300),     # placed second
+        "1.2": _part(l0=1, debt=100),     # budget exhausted -> local
+        "1.3": _part(l0=0, debt=0),       # nothing to do -> local
+        "1.4": _part(l0=6, debt=900),     # hot -> defer, never placed
+    }
+    out = fold_decisions(parts, hot={"1.4"}, knobs=KNOBS,
+                         places={"svc:1": 2})
+    assert out["1.0"]["where"] == "svc:1"
+    assert "offload_budget" in out["1.0"]["reasons"]
+    assert out["1.1"]["where"] == "svc:1"
+    assert out["1.2"]["where"] == ""
+    assert out["1.3"]["where"] == ""
+    assert out["1.4"]["policy"] == "defer" and out["1.4"]["where"] == ""
+
+
+def test_fold_placement_balances_services():
+    from pegasus_tpu.collector.compact_scheduler import fold_decisions
+
+    parts = {f"1.{i}": _part(l0=2 + i, debt=100 * (i + 1))
+             for i in range(4)}
+    out = fold_decisions(parts, knobs=KNOBS,
+                         places={"svcA:1": 1, "svcB:1": 1})
+    placed = [d["where"] for d in out.values() if d["where"]]
+    assert sorted(placed) == ["svcA:1", "svcB:1"]  # one each, balanced
+
+
+def test_localize_passes_where_through():
+    from pegasus_tpu.collector.compact_scheduler import (fold_decisions,
+                                                         localize_decisions)
+
+    parts = {"1.0": _part(node="n1:1", l0=5, debt=500)}
+    dec = fold_decisions(parts, knobs=KNOBS, places={"svc:1": 4})
+    mine = localize_decisions(dec, {"1.0": ["n1:1", "n2:1"]}, "n2:1")
+    assert mine["1.0"]["where"] == "svc:1"
+
+
+def test_tune_knobs_from_stage_cost():
+    from pegasus_tpu.collector.compact_scheduler import (stage_cost_us,
+                                                         tune_knobs)
+
+    k = dict(KNOBS, tune_slow_us=2e6, tune_fast_us=25e4)
+    slow, rep = tune_knobs(5e6, k)
+    assert slow["urgent_l0"] == 8 and rep["mode"] == "slow_merges"
+    fast, rep = tune_knobs(1e5, k)
+    assert fast["urgent_l0"] == 2 and rep["mode"] == "fast_merges"
+    base, rep = tune_knobs(1e6, k)
+    assert base["urgent_l0"] == 4 and rep["mode"] == "base"
+    window = {"samples": [
+        {"ts": 1, "values": {"compact.stage.pack.duration_us.p99": 100.0,
+                             "compact.stage.device.duration_us.p99": 900.0}},
+        {"ts": 2, "values": {"compact.stage.pack.duration_us.p99": 50.0}},
+    ]}
+    assert stage_cost_us(window) == 1000.0
+    assert stage_cost_us({"samples": []}) == 0.0
+
+
+def test_scheduler_tick_scrapes_service_budget(tmp_path, svc, monkeypatch):
+    """run_scheduler_tick folds the service's offload-status into the
+    report even with no cluster behind it (no meta = early exit, but the
+    service scrape shape is covered by the fold test; here we pin the
+    END-TO-END remote-command surface the scrape uses)."""
+    from pegasus_tpu.collector.cluster_doctor import ClusterCaller
+
+    caller = ClusterCaller([])
+    try:
+        out = json.loads(caller.remote_command(svc.address,
+                                               "offload-status", []))
+    finally:
+        caller.close()
+    assert out["free_slots"] == svc.max_concurrent
+    assert out["address"] == svc.address
+    assert out["backend"] == "cpu"
+
+
+# ------------------------------------------------------------------ chaos
+
+
+class _SvcCtl:
+    def __init__(self, tmp_path):
+        self.root = str(tmp_path / "chaos_svc")
+        self.svc = CompactOffloadService(self.root, backend="cpu").start()
+        self.address = self.svc.address
+
+    def stop(self):
+        self.svc.stop()
+
+    def restart(self):
+        host, _, port = self.address.rpartition(":")
+        self.svc = CompactOffloadService(self.root, host=host,
+                                         port=int(port),
+                                         backend="cpu").start()
+
+
+def test_offload_service_kill_mid_run_chaos(tmp_path):
+    """The ISSUE 14 chaos scenario actor: hard-kill the offload service
+    mid-run under write load. Asserts: the lane fallback engages, ZERO
+    lost acked writes (per-key payload verification on the offloaded
+    engine), post-run digests identical to an un-offloaded control, and
+    the actor reports recovered once the service is back."""
+    from pegasus_tpu.chaos.actors import OffloadServiceKill
+    from pegasus_tpu.chaos.journal import EventJournal
+    from pegasus_tpu.chaos.scenario import FaultAction, Scenario, \
+        ScenarioRunner
+
+    ctl = _SvcCtl(tmp_path)
+    control = LsmEngine(str(tmp_path / "control"),
+                        EngineOptions(backend="cpu", l0_compaction_trigger=1,
+                                      memtable_bytes=1 << 20))
+    victim = LsmEngine(str(tmp_path / "victim"),
+                       EngineOptions(backend="cpu", l0_compaction_trigger=1,
+                                     memtable_bytes=1 << 20))
+    victim.set_offload_target(ctl.address, ttl_s=600)
+    journal = EventJournal()
+    scenario = Scenario("offload-kill", [
+        FaultAction("kill-offload", "offload_kill", at_s=0.3,
+                    duration_s=1.0, recovery_deadline_s=15.0,
+                    settle_s=0.1),
+    ])
+    runner = ScenarioRunner(scenario,
+                            {"offload_kill": OffloadServiceKill(ctl)},
+                            journal)
+    runner.start(run_s=2.0)
+    acked = {}
+    d = 0
+    t_end = time.monotonic() + 2.2
+    i = 0
+    try:
+        while time.monotonic() < t_end:
+            d += 1
+            k = generate_key(b"h%03d" % (i % 20), b"s%05d" % i)
+            v = b"payload%08d" % i
+            for eng in (control, victim):
+                eng.write(WriteBatch().put(k, v), d)
+            acked[k] = v
+            i += 1
+            if i % 40 == 0:
+                control.flush()
+                victim.flush()  # trigger=1: every flush drives a merge
+        runner.join(timeout=30.0)
+        assert not journal.failures, journal.failures
+        assert OFFLOAD_LANE_GUARD.state()["fallbacks"] > 0, \
+            "the kill window never forced a fallback"
+        now = 100
+        dv = victim.state_digest(now=now)
+        dc = control.state_digest(now=now)
+        assert dv == dc  # identical post-run state, record for record
+        # zero lost acked writes, verified key by key
+        keys = sorted(acked)
+        got = victim.get_batch(keys, now=now)
+        assert got == [acked[k] for k in keys]
+    finally:
+        control.close()
+        victim.close()
+        ctl.stop()
+
+
+def test_fold_placement_weighted_by_replica_count():
+    """A placement reaches every replica of the partition (each
+    compacts independently), so it charges min(replicas, remaining)
+    slots — the scraped budget is not oversubscribed by the
+    replication factor."""
+    from pegasus_tpu.collector.compact_scheduler import fold_decisions
+
+    parts = {f"1.{i}": _part(l0=2 + i, debt=100 * (i + 1))
+             for i in range(3)}
+    out = fold_decisions(parts, knobs=KNOBS, places={"svc:1": 4},
+                         weights={g: 3 for g in parts})
+    placed = [g for g, d in out.items() if d["where"]]
+    # debtiest charges 3 of 4 slots, second charges the remaining 1,
+    # third finds no budget left
+    assert placed == ["1.1", "1.2"]
+    assert out["1.0"]["where"] == ""

@@ -737,3 +737,67 @@ def test_collector_scheduler_status_surface(cluster, monkeypatch):
     finally:
         app.stop()
     cli.close()
+
+
+def test_onebox_placement_and_autotune_end_to_end(cluster, monkeypatch,
+                                                  tmp_path):
+    """ISSUE 14 acceptance: the fold's (when, where) pairs ride the live
+    surfaces — service budget scraped over offload-status, placement
+    delivered with the policy tokens, visible (with the
+    `offload_budget` reason) through compact-sched-status, and the
+    autotune report emitted when the feedback tuner is armed."""
+    from pegasus_tpu.replication.compact_offload import \
+        CompactOffloadService
+
+    svc = CompactOffloadService(str(tmp_path / "svc"),
+                                backend="cpu").start()
+    cli = cluster.create("placed", partitions=4)
+    try:
+        for i in range(160):
+            cli.set(b"user%05d" % i, b"f0", b"v" * 64)
+        caller = ClusterCaller([cluster.meta_addr])
+        try:
+            _wait_for_beacon_debt(caller, min_l0=2)
+            monkeypatch.setenv("PEGASUS_OFFLOAD_SERVICES", svc.address)
+            monkeypatch.setenv("PEGASUS_SCHED_AUTOTUNE", "1")
+            tune_state = {}
+            report = run_scheduler_tick(
+                [cluster.meta_addr], caller=caller, tune_state=tune_state,
+                knobs={"urgent_l0": 2, "max_urgent_per_node": 8,
+                       "ttl_s": 30.0, "max_device": 0})
+            assert not report["errors"], report["errors"]
+            assert report["services"][svc.address]["free_slots"] > 0
+            placed = [g for g, d in report["decisions"].items()
+                      if d["where"] == svc.address]
+            assert placed, "free budget but nothing placed"
+            for g in placed:
+                assert "offload_budget" in report["decisions"][g]["reasons"]
+            # budget-bounded: never more placements than free slots
+            assert len(placed) <= svc.max_concurrent
+            assert "autotune" in report  # armed -> report present
+            # the placement landed on the serving engines, lease-held
+            seen = {}
+            for stub in cluster.stubs:
+                out = json.loads(caller.remote_command(
+                    stub.address, "compact-sched-status", []))
+                for gpid, st in out.items():
+                    seen.setdefault(gpid, []).append(st)
+            for g in placed:
+                assert any(st["offload"] == svc.address
+                           for st in seen[g]), seen[g]
+            # lease expiry reverts to local: deliver where with a tiny ttl
+            stub0 = cluster.stubs[0]
+            caller.remote_command(
+                stub0.address, "compact-sched-policy",
+                [json.dumps({"ttl_s": 0.05, "decisions": {
+                    g: {"policy": "normal", "where": svc.address}
+                    for g in report["decisions"]}})])
+            time.sleep(0.1)
+            out = json.loads(caller.remote_command(
+                stub0.address, "compact-sched-status", []))
+            assert all(st["offload"] == "" for st in out.values())
+        finally:
+            caller.close()
+    finally:
+        cli.close()
+        svc.stop()

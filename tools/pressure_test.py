@@ -98,13 +98,16 @@ def _parse_args(argv=None):
     ap.add_argument("--read-pct", type=int, default=50)
     ap.add_argument("--key-space", type=int, default=100_000)
     ap.add_argument("--scenario", default="none",
-                    choices=["none", "smoke", "full"],
+                    choices=["none", "smoke", "full", "offload"],
                     help="scripted chaos schedule to run under the load "
                          "(pegasus_tpu.chaos): smoke = group-worker kill + "
                          "remote fail-point wedge; full = + node "
                          "kill/restart, mid-load split, balancer primary "
                          "move, scheduler token flips, duplication leg "
-                         "with cross-cluster digest compare")
+                         "with cross-cluster digest compare; offload = "
+                         "compaction-offload wire wedge + mid-merge "
+                         "service kill against a harness-wired offload "
+                         "service with every partition placed onto it")
     ap.add_argument("--audit-every", type=float, default=5.0,
                     help="seconds between decree-anchored audit rounds "
                          "under the load (0 disables; a final quiesced "
@@ -179,9 +182,82 @@ def _build_harness(args, journal):
                                        caller=caller),
         sc.A_SCHED: act.SchedFlipActor(caller, box.cluster, args.table),
     }
+    if args.scenario == "offload":
+        # rack-scale offload leg (ISSUE 14): one cpu-backend compaction
+        # service for the whole onebox rack, every partition placed onto
+        # it for the run's duration — the scenario then wedges the wire
+        # and hard-kills the service mid-load, and the nodes must ride
+        # the offload lane's local-cpu fallback without losing a write
+        ctl = _OffloadServiceCtl()
+        box.offload_ctl = ctl
+        _deliver_offload_placements(caller, box, ctl.address,
+                                    ttl_s=args.seconds + 120)
+        actors[sc.A_OFFLOAD] = act.OffloadServiceKill(ctl, caller=caller)
     box.chaos_caller = caller   # closed with the box in the run's finally
     box.alive_nodes = alive_nodes   # --inject-fault victim selection
     return box, dst, actors, sc.SCENARIOS[args.scenario]()
+
+
+class _OffloadServiceCtl:
+    """stop()/restart()-able in-process compaction-offload service (the
+    OffloadServiceKill actor's handle): restart rebinds the SAME address
+    so placement leases delivered before the kill stay valid."""
+
+    def __init__(self):
+        import tempfile
+
+        from pegasus_tpu.replication.compact_offload import \
+            CompactOffloadService
+
+        self.root = tempfile.mkdtemp(prefix="pegasus_offload_chaos_")
+        self.svc = CompactOffloadService(self.root, backend="cpu").start()
+        self.address = self.svc.address
+
+    def stop(self):
+        self.svc.stop()
+
+    def restart(self):
+        from pegasus_tpu.replication.compact_offload import \
+            CompactOffloadService
+
+        host, _, port = self.address.rpartition(":")
+        self.svc = CompactOffloadService(self.root, host=host,
+                                         port=int(port),
+                                         backend="cpu").start()
+
+    def close(self):
+        import shutil
+
+        try:
+            self.svc.stop()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+def _deliver_offload_placements(caller, box, svc_addr: str,
+                                ttl_s: float) -> None:
+    """Hand every alive node a (normal, svc_addr) token for each hosted
+    partition — the compact-sched-policy surface the cluster scheduler
+    itself uses, with a lease long enough to outlive the run."""
+    import json as _json
+
+    from pegasus_tpu.chaos.actors import _cluster_state
+
+    state = _cluster_state(box.cluster, caller) or {}
+    decisions = {}
+    for app in state.get("apps", {}).values():
+        for pc in app.get("partitions", []):
+            decisions[f"{app['app_id']}.{pc['pidx']}"] = {
+                "policy": "normal", "reasons": ["chaos.offload"],
+                "where": svc_addr}
+    body = _json.dumps({"ttl_s": ttl_s, "decisions": decisions})
+    for node in sorted(a for a, n in state.get("nodes", {}).items()
+                       if n.get("alive")):
+        try:
+            caller.remote_command(node, "compact-sched-policy", [body])
+        except Exception:  # noqa: BLE001 - a node that missed the
+            continue       # placement simply compacts locally
 
 
 def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
@@ -497,6 +573,8 @@ def run_pressure(argv=None) -> int:
             runner.stop()
         for b in (box, dst):
             if b is not None:
+                if getattr(b, "offload_ctl", None) is not None:
+                    b.offload_ctl.close()
                 if getattr(b, "chaos_caller", None) is not None:
                     b.chaos_caller.close()
                 b.stop()
