@@ -283,20 +283,24 @@ def full_scenario() -> Scenario:
     ])
 
 
-def offload_scenario() -> Scenario:
+def offload_scenario(kill_every_s: float = None) -> Scenario:
     """Rack-scale offload leg (ISSUE 14), for a harness that wired an
     offload service + placements: a `compact.offload` wire wedge, then
     a hard service kill mid-merge — both windows must close with the
     nodes' offload lane having degraded to byte-identical local cpu
     merges (zero lost acked writes; the driving test compares post-run
-    digests against an un-offloaded control)."""
+    digests against an un-offloaded control). `kill_every_s` (ROADMAP
+    offload follow-on (d): the longer pressure_test soak) repeats the
+    service kill on that period for the whole run instead of once —
+    must exceed the kill's 4 s heal window."""
     return Scenario("offload", [
         FaultAction("offload-wire-wedge", A_FAILPOINT, at_s=1.0,
                     duration_s=3.0, recovery_deadline_s=10.0, settle_s=1.0,
                     args={"point": "compact.offload",
                           "action": "3*sleep(100)"}),
         FaultAction("kill-offload-service", A_OFFLOAD, at_s=5.0,
-                    duration_s=4.0, recovery_deadline_s=20.0, settle_s=2.0),
+                    duration_s=4.0, every_s=kill_every_s,
+                    recovery_deadline_s=20.0, settle_s=2.0),
     ])
 
 

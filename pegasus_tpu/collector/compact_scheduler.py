@@ -285,7 +285,11 @@ def localize_decisions(decisions: dict, hosts: dict, node: str,
         # partition ships to the same service (content-addressed staging
         # dedups the runs they share)
         mine[g] = {"policy": policy, "reasons": reasons,
-                   "where": d.get("where", "")}
+                   "where": d.get("where", ""),
+                   # the job-trace id rides the lease (ISSUE 16): every
+                   # receiver gets the SAME id, so whichever replica's
+                   # trigger fires first continues the decision's timeline
+                   "job": d.get("job", "")}
     return mine
 
 
@@ -412,6 +416,20 @@ def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
             sum(1 for d in decisions.values() if d["policy"] == "urgent"))
         if not deliver:
             return report
+        # causal job tracing (ISSUE 16): one id per (gpid, tick) decision,
+        # minted BEFORE the per-node loop so a partition delivered to
+        # several replicas shares one id. The scheduler only DECIDES —
+        # it never finishes these jobs (the engine whose trigger adopts
+        # the token does); scheduler-local records for decisions that
+        # never fire age out of the tracer's bounded active set.
+        from ..runtime.job_trace import JOB_TRACER
+
+        for gpid, d in decisions.items():
+            d["job"] = JOB_TRACER.begin("sched", gpid=gpid)
+            JOB_TRACER.note("sched.decide", job_id=d["job"], gpid=gpid,
+                            policy=d["policy"],
+                            reasons=",".join(d["reasons"]),
+                            where=d.get("where", ""))
         for node in alive:
             mine = localize_decisions(decisions, hosts, node,
                                       breaker_open=breakers.get(node, False),
@@ -425,6 +443,10 @@ def run_scheduler_tick(meta_addrs, pool=None, hot_gpids=None,
                 out = caller.remote_command(node, "compact-sched-policy",
                                             [json.dumps(body)])
                 report["delivered"][node] = json.loads(out)
+                for g, dec in mine.items():
+                    if dec.get("job"):
+                        JOB_TRACER.note("sched.deliver", job_id=dec["job"],
+                                        gpid=g, node=node)
             except (RpcError, OSError, ValueError) as e:
                 counters.rate("sched.deliver_errors").increment()
                 report["errors"].append(f"{node}: {e}")

@@ -42,6 +42,7 @@ import time
 
 from ..rpc.transport import RpcError
 from ..runtime import events, lockrank
+from ..runtime.job_trace import JOB_TRACER
 from ..runtime.perf_counters import counters
 from .cluster_doctor import ClusterCaller
 
@@ -151,6 +152,9 @@ class FlightRecorder:
             "timeline": timeline,
             "nodes": nodes_detail,
             "local_events": len(local_events),
+            # the capturing process's own in-window job timelines — in an
+            # in-process onebox this is every plane's shared tracer view
+            "jobs": JOB_TRACER.window(window_s),
             "errors": errors,
         }
         incident["path"] = self._retain(incident)
@@ -195,6 +199,13 @@ class FlightRecorder:
                 node, "request-trace-dump", ["10"]))
         except (RpcError, OSError, ValueError) as e:
             errors.append(f"{node}: request-trace-dump: {e}")
+        try:
+            # the background-job timelines (ISSUE 16): a first-cause
+            # event can name the compaction/offload/learn job it wedged
+            detail["jobs"] = json.loads(caller.remote_command(
+                node, "job-trace", ["20"]))
+        except (RpcError, OSError, ValueError) as e:
+            errors.append(f"{node}: job-trace: {e}")
         return detail
 
     # ----------------------------------------------------------- retention

@@ -332,6 +332,11 @@ class LsmEngine:
         self._sched_policy = "normal"  #: guarded_by self._lock
         self._sched_reasons = ()       #: guarded_by self._lock
         self._sched_expire = 0.0       #: guarded_by self._lock
+        # the job-trace id riding the delivered token (ISSUE 16): the
+        # compaction the token triggers adopts it, so scheduler decision
+        # and engine merge share ONE timeline; cleared on adoption and
+        # on lease expiry (a later local trigger mints its own id)
+        self._sched_job = ""           #: guarded_by self._lock
         # compaction-offload placement (ISSUE 14): the WHERE half of the
         # scheduler's (when, where) token — a remote compaction service
         # address this cpu-only engine ships its merges to. Same lease
@@ -989,7 +994,7 @@ class LsmEngine:
     # ------------------------------------------------- compaction scheduling
 
     def set_compact_policy(self, policy: str, reasons=(),
-                           ttl_s: float = None) -> None:
+                           ttl_s: float = None, job: str = "") -> None:
         """Install the cluster scheduler's per-partition policy token
         (ISSUE 10): 'defer' holds the elective L0 trigger (below the hard
         debt ceiling), 'urgent' fires it at half the normal threshold and
@@ -1005,6 +1010,8 @@ class LsmEngine:
             self._sched_reasons = tuple(reasons)
             self._sched_expire = time.monotonic() + (
                 self._sched_ttl_s if ttl_s is None else float(ttl_s))
+            if job:
+                self._sched_job = job
         if changed:
             # transitions only: steady-state re-deliveries every tick
             # would be ring noise, a defer->urgent flip is the story
@@ -1020,6 +1027,7 @@ class LsmEngine:
             if self._sched_policy != "normal" and now >= self._sched_expire:
                 expired = self._sched_policy
                 self._sched_policy, self._sched_reasons = "normal", ()
+                self._sched_job = ""
             out = (self._sched_policy, list(self._sched_reasons),
                    max(0.0, self._sched_expire - now)
                    if self._sched_policy != "normal" else 0.0)
@@ -1093,6 +1101,32 @@ class LsmEngine:
         by one write)."""
         return len(self._l0) / float(self._sched_ceiling)  #: unguarded_ok racy admission gauge: len() of a list the trigger path re-snapshots under its locks
 
+    def _traced_compact(self, trigger: str) -> dict:
+        """Run compact() as ONE traced background job (ISSUE 16): the
+        compaction adopts the id the scheduler's token delivered (so the
+        decision, the token apply and this merge share a timeline) or
+        mints a local id when the trigger is engine-local. compact() is
+        synchronous through its deferred-install drain, so finishing
+        here covers the job through the installed SST."""
+        from ..runtime.job_trace import JOB_TRACER
+
+        with self._lock:
+            token_job, self._sched_job = self._sched_job, ""
+        jid = JOB_TRACER.begin("compact", job_id=token_job or None,
+                               engine=self.path, pidx=self.opts.pidx)
+        JOB_TRACER.note("engine.trigger", job_id=jid, trigger=trigger,
+                        l0_files=len(self._l0))  #: unguarded_ok trace attr snapshot; compact() re-snapshots under its locks
+        try:
+            with JOB_TRACER.adopt(jid):
+                stats = self.compact()
+        except BaseException:
+            JOB_TRACER.finish(jid, status="error")
+            raise
+        JOB_TRACER.finish(jid,
+                          input_records=stats.get("input_records", 0),
+                          output_records=stats.get("output_records", 0))
+        return stats
+
     def _maybe_trigger_l0(self) -> bool:
         """Post-flush/ingest L0 trigger behind the scheduler gate
         (ISSUE 10). With no (or an expired) policy token this is exactly
@@ -1112,7 +1146,7 @@ class LsmEngine:
             # can never stall compaction into a write cliff)
             if policy == "defer":
                 self._c_sched_ceiling.increment()
-            self.compact()
+            self._traced_compact("ceiling")
             return True
         if policy == "defer":
             if l0 >= self.opts.l0_compaction_trigger:
@@ -1121,7 +1155,7 @@ class LsmEngine:
         if policy == "urgent":
             if l0 >= max(1, self.opts.l0_compaction_trigger // 2):
                 self._c_sched_urgent.increment()
-                self.compact()
+                self._traced_compact("urgent")
                 return True
             return False
         if l0 >= self.opts.l0_compaction_trigger:
@@ -1132,7 +1166,7 @@ class LsmEngine:
                 # convoying the TPU lane
                 self._c_sched_gate_deferred.increment()
                 return False
-            self.compact()
+            self._traced_compact("trigger")
             return True
         return False
 
@@ -1284,27 +1318,34 @@ class LsmEngine:
         offload_addr = (self.offload_target()
                         if mesh is None and self.opts.backend == "cpu"
                         else None)
-        if mesh is not None:
-            from ..parallel import sharded_compact_block
+        from ..runtime.job_trace import JOB_TRACER
 
-            result = sharded_compact_block(input_blocks, mesh, opts)
-            counters.rate("engine.sharded_compaction_count").increment()
-        elif offload_addr:
-            from ..replication.compact_offload import offload_compact_blocks
+        where = ("mesh" if mesh is not None
+                 else "offload" if offload_addr else "local")
+        with JOB_TRACER.hop("engine.merge", where=where, level=target_level,
+                            inputs=len(inputs)):
+            if mesh is not None:
+                from ..parallel import sharded_compact_block
 
-            result = offload_compact_blocks(
-                input_blocks, opts, offload_addr,
-                tenant=f"{self.opts.pidx}@{os.path.basename(self.path)}")
-            self._c_offload.increment()
-        else:
-            device_runs = None
-            if self.opts.backend == "tpu":
-                # device-resident run cache: each SST packs+uploads once in
-                # its lifetime; this and every later compaction reads HBM
-                # directly
-                device_runs = [self._device_run_budgeted(s) for s in inputs]
-            result = compact_blocks(input_blocks, opts,
-                                    device_runs=device_runs)
+                result = sharded_compact_block(input_blocks, mesh, opts)
+                counters.rate("engine.sharded_compaction_count").increment()
+            elif offload_addr:
+                from ..replication.compact_offload import offload_compact_blocks
+
+                result = offload_compact_blocks(
+                    input_blocks, opts, offload_addr,
+                    tenant=f"{self.opts.pidx}@{os.path.basename(self.path)}")
+                self._c_offload.increment()
+            else:
+                device_runs = None
+                if self.opts.backend == "tpu":
+                    # device-resident run cache: each SST packs+uploads once
+                    # in its lifetime; this and every later compaction reads
+                    # HBM directly
+                    device_runs = [self._device_run_budgeted(s)
+                                   for s in inputs]
+                result = compact_blocks(input_blocks, opts,
+                                        device_runs=device_runs)
         counters.rate("engine.compaction_completed_count").increment()
         counters.percentile("engine.compaction_s").set(time.perf_counter() - t0)
         self._install_merge_output(newer_files, older_files, result.block,
@@ -1409,25 +1450,32 @@ class LsmEngine:
         (when every live SST is on disk) write the manifest and unlink
         the consumed inputs. Device-residency primes go back through
         _prime_async (fire-and-forget): this job must only ever block on
-        DISK, so a wedged device can never hang the install drain."""
+        DISK, so a wedged device can never hang the install drain.
+        Runs under the compaction job's adopted context (the pipeline
+        pool carries it), so the install hop lands in the SAME timeline
+        as the trigger and merge that produced these files."""
+        from ..runtime.job_trace import JOB_TRACER
+
         try:
-            for sst in new_ssts:
-                with self._lock:
-                    if sst._device_retired:
-                        # already consumed as a LATER merge's input before
-                        # ever landing: its data is superseded and nothing
-                        # references the path — writing it now would only
-                        # recreate a file after its queued unlink ran,
-                        # leaking an orphan SST forever
+            with JOB_TRACER.hop("engine.install", ssts=len(new_ssts)):
+                for sst in new_ssts:
+                    with self._lock:
+                        if sst._device_retired:
+                            # already consumed as a LATER merge's input
+                            # before ever landing: its data is superseded
+                            # and nothing references the path — writing it
+                            # now would only recreate a file after its
+                            # queued unlink ran, leaking an orphan SST
+                            # forever
+                            sst._on_disk = True
+                            continue
+                    write_sst(sst.path, sst.block(), sst.meta,
+                              compression=self.opts.compression,
+                              bloom=(sst.header["bloom"],
+                                     sst.header["bloom_log2m"]))
+                    with self._lock:
                         sst._on_disk = True
-                        continue
-                write_sst(sst.path, sst.block(), sst.meta,
-                          compression=self.opts.compression,
-                          bloom=(sst.header["bloom"],
-                                 sst.header["bloom_log2m"]))
-                with self._lock:
-                    sst._on_disk = True
-                self._prime_async(sst)
+                    self._prime_async(sst)
         finally:
             self._flush_deferred_state()
 
@@ -1513,7 +1561,15 @@ class LsmEngine:
     def manual_compact(self, bottommost: bool = True, now: int = None,
                        target_level: int = None) -> dict:
         """Full compaction: everything merged into one run at target_level
-        (default: the bottommost configured level)."""
+        (default: the bottommost configured level). Its own traced
+        "compact" job (trigger=manual) — nested under an already-active
+        job this degrades to a hop, per JobTracer.job()."""
+        from ..runtime.job_trace import JOB_TRACER
+        with JOB_TRACER.job("compact", engine=self.path,
+                            pidx=self.opts.pidx, trigger="manual"):
+            return self._manual_compact_traced(bottommost, now, target_level)
+
+    def _manual_compact_traced(self, bottommost, now, target_level) -> dict:
         from ..runtime.tracing import COMPACT_TRACER
 
         self.flush()

@@ -136,19 +136,25 @@ class PipelineFuture:
 def submit(fn, *args, pool: ThreadPool = None):
     """Run ``fn(*args)`` on the pipeline pool (or an explicit pool) ->
     PipelineFuture. The worker adopts the submitting thread's trace
-    sessions for the task (then restores its own: pool workers are
-    reused, and a stale adopted session would aggregate later unrelated
-    spans into a closed run)."""
+    sessions AND its active job context for the task (then restores its
+    own: pool workers are reused, and a stale adopted session/job would
+    aggregate later unrelated spans into a closed run — the job adopt is
+    what lets a deferred install's hop land in the compaction job that
+    queued it, ISSUE 16)."""
+    from ..runtime.job_trace import JOB_TRACER
+
     fut = PipelineFuture()
     sessions = _TRACE.propagate_sessions()
+    job_id = JOB_TRACER.current()
 
     def run():
         prev = _TRACE.propagate_sessions()
         _TRACE.adopt_sessions(sessions)
         fut.started = time.perf_counter()
         try:
-            _inject("compact.pipeline")
-            fut.value = fn(*args)
+            with JOB_TRACER.adopt(job_id):
+                _inject("compact.pipeline")
+                fut.value = fn(*args)
         except BaseException as e:  # noqa: BLE001 - crosses the thread boundary
             fut.error = e
         finally:

@@ -62,6 +62,7 @@ from ..runtime import events, lockrank
 from ..runtime.fail_points import inject
 from ..runtime.lane_guard import LaneGuard, LaneGuardConfig
 from ..runtime.perf_counters import counters
+from ..runtime.job_trace import JOB_TRACER
 from ..runtime.remote_command import RemoteCommandService
 from ..runtime.tracing import COMPACT_TRACER as _TRACE
 
@@ -240,6 +241,19 @@ class CompactOffloadService:
     def _block_path(self, digest: str) -> str:
         return os.path.join(self._blocks_dir, digest)
 
+    def _trace(self, job: dict, name: str, **attrs) -> None:
+        """Record one service-side hop for the tenant's traced job
+        (ISSUE 16) — plain records kept in the job dict and returned in
+        the merge response for the tenant to stitch, NOT recorded into
+        this process's JOB_TRACER (in a onebox both sides share the
+        tracer and the hops would double-record)."""
+        if not job.get("trace_job"):
+            return
+        rec = {"name": name, "ts": time.time(), "duration_us": 0}
+        rec.update(attrs)
+        with self._lock:
+            job["spans"].append(rec)
+
     def _job(self, job_id: int) -> dict:
         now = time.monotonic()
         with self._lock:
@@ -312,6 +326,12 @@ class CompactOffloadService:
                    "runs": list(req.runs), "opts_json": req.opts_json,
                    "dir": os.path.join(self._jobs_dir, str(job_id)),
                    "outputs": [], "stats": {},
+                   # causal tracing (ISSUE 16): the tenant's job-trace id
+                   # and the hop records this service makes for it —
+                   # returned in the merge response for the tenant to
+                   # stitch home (NOT via the process tracer: in a onebox
+                   # both sides share it and would double-record)
+                   "trace_job": req.job, "spans": [],
                    "expires": now + self.job_ttl_s}
             self._jobs[job_id] = job
             self._c_jobs.set(len(self._jobs))
@@ -325,6 +345,8 @@ class CompactOffloadService:
                     self._c_resumed.increment()
             except OSError:
                 continue
+        self._trace(job, "offload.svc.begin", runs=len(req.runs),
+                    resumed=len(staged))
         return codec.encode(rpc_msg.OffloadBeginResponse(
             job_id=job_id, staged=staged))
 
@@ -412,7 +434,8 @@ class CompactOffloadService:
                     # idempotent: a retried merge call returns the done job
                     return codec.encode(rpc_msg.OffloadMergeResponse(
                         outputs=list(job["outputs"]),
-                        stats_json=json.dumps(job["stats"])))
+                        stats_json=json.dumps(job["stats"]),
+                        spans_json=json.dumps(job["spans"])))
                 if self._running >= self.max_concurrent:
                     # admission gate: refuse, never queue — the tenant's
                     # lane policy decides between retry and local cpu
@@ -434,8 +457,11 @@ class CompactOffloadService:
         except (OffloadError, OSError, ValueError) as e:
             return codec.encode(rpc_msg.OffloadMergeResponse(
                 error=1, error_text=repr(e)))
+        with self._lock:
+            spans = list(job["spans"])
         return codec.encode(rpc_msg.OffloadMergeResponse(
-            outputs=outputs, stats_json=json.dumps(stats)))
+            outputs=outputs, stats_json=json.dumps(stats),
+            spans_json=json.dumps(spans)))
 
     def _merge_job(self, job: dict) -> tuple:
         """Load the job's staged runs (manifest order = merge priority),
@@ -443,6 +469,7 @@ class CompactOffloadService:
         under the job dir. -> (outputs manifest, stats)."""
         t0 = time.perf_counter()
         blocks = []
+        nbytes = 0
         for e in job["runs"]:
             try:
                 with open(self._block_path(e.digest), "rb") as f:
@@ -451,11 +478,20 @@ class CompactOffloadService:
                 raise OffloadError(f"run {e.name} not staged (re-begin)")
             if _md5(data) != e.digest:
                 raise OffloadError(f"staged run {e.name} corrupt on disk")
+            nbytes += len(data)
             blocks.append(unpack_run_bytes(data))
+        self._trace(job, "offload.svc.load", runs=len(blocks),
+                    nbytes=nbytes,
+                    duration_us=int((time.perf_counter() - t0) * 1e6))
         from ..parallel import compact_blocks_meshed
 
         opts = opts_from_wire(job["opts_json"], self.backend)
+        t_merge = time.perf_counter()
         result = compact_blocks_meshed(blocks, opts, self.mesh)
+        self._trace(job, "offload.svc.merge",
+                    records_in=sum(b.n for b in blocks),
+                    records_out=result.block.n,
+                    duration_us=int((time.perf_counter() - t_merge) * 1e6))
         out_bytes = pack_run_bytes(result.block)
         os.makedirs(job["dir"], exist_ok=True)
         with open(os.path.join(job["dir"], "out.0"), "wb") as f:
@@ -602,24 +638,42 @@ def _offload_once(blocks, opts: CompactOptions, addr: str,
     payloads = [pack_run_bytes(b) for b in runs]
     entries = [rpc_msg.LearnBlockEntry(f"run.{i}", len(p), _md5(p))
                for i, p in enumerate(payloads)]
+    # the causal job id crosses the wire (ISSUE 16): the service records
+    # its own hops against it and returns them on merge for stitching
+    trace_job = JOB_TRACER.current() or ""
     with _TRACE.span("offload.ship", records=sum(b.n for b in runs),
-                     nbytes=sum(len(p) for p in payloads)):
+                     nbytes=sum(len(p) for p in payloads)), \
+            JOB_TRACER.hop("offload.ship", service=addr,
+                           nbytes=sum(len(p) for p in payloads)) as jh:
         begin = _call(addr, RPC_COMPACT_OFFLOAD_BEGIN,
                       rpc_msg.OffloadBeginRequest(
                           tenant=tenant, gpid=f"{opts.pidx}",
-                          runs=entries, opts_json=wire_opts(opts)),
+                          runs=entries, opts_json=wire_opts(opts),
+                          job=trace_job),
                       rpc_msg.OffloadBeginResponse)
         ship = _ship_runs(addr, begin.job_id, entries, payloads,
                           set(begin.staged))
+        jh.update(ship)
     try:
-        with _TRACE.span("offload.merge", records=sum(b.n for b in runs)):
+        with _TRACE.span("offload.merge", records=sum(b.n for b in runs)), \
+                JOB_TRACER.hop("offload.merge", service=addr):
             inject("compact.offload")  # chaos seam: merge stage, client side
             m = _call(addr, RPC_COMPACT_OFFLOAD_MERGE,
                       rpc_msg.OffloadMergeRequest(job_id=begin.job_id),
                       rpc_msg.OffloadMergeResponse,
                       timeout=merge_timeout_s())
+        if trace_job and m.spans_json:
+            # one timeline, two hosts: the service's view comes home in
+            # the response and lands origin-tagged next to our own hops
+            try:
+                JOB_TRACER.stitch(trace_job, json.loads(m.spans_json),
+                                  origin=addr)
+            except ValueError:
+                pass  # a torn spans payload is diagnostic-only
         with _TRACE.span("offload.fetch",
-                         nbytes=sum(e.size for e in m.outputs)) as sp:
+                         nbytes=sum(e.size for e in m.outputs)) as sp, \
+                JOB_TRACER.hop("offload.fetch", service=addr,
+                               nbytes=sum(e.size for e in m.outputs)):
             out_parts = [_fetch_output(addr, begin.job_id, e)
                          for e in m.outputs]
             out = unpack_run_bytes(out_parts[0]) if out_parts else None

@@ -59,6 +59,13 @@ class MutationDuplicator:
         self.last_shipped_decree = max(self._load_progress(), confirmed_floor)
         self._saved_decree = self.last_shipped_decree
         self._saved_at = 0.0
+        # one long-lived traced job per duplicator (ISSUE 16): each
+        # shipped window notes a hop, stop() closes it — the timeline is
+        # the ship cadence between this cluster and the remote
+        from ..runtime.job_trace import JOB_TRACER
+
+        self._trace_job = JOB_TRACER.begin("duplicate", dupid=dupid,
+                                           cluster=cluster_id)
         self._thread = spawn_thread(self._ship_loop, daemon=True)
 
     # ------------------------------------------------------------- progress
@@ -207,6 +214,11 @@ class MutationDuplicator:
         self.skipped += n_skipped
         self.last_shipped_decree = max(self.last_shipped_decree,
                                        ms[-1].decree)
+        from ..runtime.job_trace import JOB_TRACER
+
+        JOB_TRACER.note("dup.ship_window", job_id=self._trace_job,
+                        requests=n, skipped=n_skipped,
+                        decree=self.last_shipped_decree)
         return True
 
     def _ship_one(self, m: LogMutation) -> bool:
@@ -288,6 +300,11 @@ class MutationDuplicator:
         except OSError:
             pass
         self.pool.close()
+        from ..runtime.job_trace import JOB_TRACER
+
+        JOB_TRACER.finish(self._trace_job, shipped=self.shipped,
+                          skipped=self.skipped,
+                          decree=self.last_shipped_decree)
 
 
 def _routing_key(code: str, body: bytes) -> bytes:

@@ -379,12 +379,16 @@ class RemoteLearnSource:
 
     def prepare_learn_state(self, have=None, delta=None) -> dict:
         from .replica_stub import RPC_LEARN_PREPARE
+        from ..runtime.job_trace import JOB_TRACER
 
         req = rpc_msg.LearnPrepareRequest(
             app_id=self.app_id, pidx=self.pidx,
             delta=delta_enabled() if delta is None else bool(delta),
             have=[rpc_msg.LearnBlockEntry(e["name"], e["size"], e["digest"])
-                  for e in (have or [])])
+                  for e in (have or [])],
+            # the learn job's trace id (ISSUE 16): the serving primary
+            # attributes its checkpoint pin to this learn's timeline
+            job=JOB_TRACER.current() or "")
         resp = self._call(RPC_LEARN_PREPARE, req,
                           rpc_msg.LearnPrepareResponse)
         return {

@@ -587,10 +587,24 @@ class Replica:
 
         from . import learn as learn_mod
         from ..runtime import events
+        from ..runtime.job_trace import JOB_TRACER
 
         t0 = time.perf_counter()
         ckpt_dir = os.path.join(self.path, "learn_ckpt")
         data_dir = os.path.join(self.path, "data")
+        # each learn is ONE traced background job (ISSUE 16): prepare /
+        # fetch waves / digest proof / swap are its hops, and the job id
+        # rides the prepare RPC so the serving primary can attribute its
+        # checkpoint pin to this learn's timeline
+        with JOB_TRACER.job("learn", gpid=f"{self.app_id}.{self.pidx}",
+                            learner=self.name):
+            self._learn_streamed_traced(primary, learn_mod, events, shutil,
+                                        ckpt_dir, data_dir, t0)
+
+    def _learn_streamed_traced(self, primary, learn_mod, events, shutil,
+                               ckpt_dir, data_dir, t0):
+        from ..runtime.job_trace import JOB_TRACER
+
         # the delta handshake: what this replica already holds — staged
         # blocks from an interrupted ship (resume) plus the live engine's
         # current files (a re-learn that still has 99% of the SSTs). The
@@ -599,13 +613,20 @@ class Replica:
         delta_on = learn_mod.delta_enabled()
         live = learn_mod.dir_manifest(data_dir) if delta_on else []
         have = (learn_mod.dir_manifest(ckpt_dir) + live) if delta_on else []
-        st = primary.prepare_learn_state(have=have, delta=delta_on)
+        with JOB_TRACER.hop("learn.prepare", have=len(have)) as jh:
+            st = primary.prepare_learn_state(have=have, delta=delta_on)
+            jh["blocks"] = len(st["blocks"])
+            jh["missing"] = len(st["missing"])
         try:
-            stats = learn_mod.stage_blocks(
-                primary, st, ckpt_dir, delta=delta_on,
-                reuse={e["digest"]: os.path.join(data_dir, e["name"])
-                       for e in live})
-            tail_state = primary.fetch_learn_tail(st["learn_id"])
+            with JOB_TRACER.hop("learn.fetch") as jh:
+                stats = learn_mod.stage_blocks(
+                    primary, st, ckpt_dir, delta=delta_on,
+                    reuse={e["digest"]: os.path.join(data_dir, e["name"])
+                           for e in live})
+                jh.update({k: stats[k] for k in
+                           ("fetched", "bytes", "skipped", "resumed")})
+            with JOB_TRACER.hop("learn.tail"):
+                tail_state = primary.fetch_learn_tail(st["learn_id"])
         finally:
             primary.finish_learn(st["learn_id"])
         verify = ""
@@ -625,31 +646,37 @@ class Replica:
             # =0) falls back to the rescan; the mismatch behavior is
             # unchanged — fail the learn loudly, never a silent
             # divergent serve.
-            if learn_mod.incremental_digest_enabled() \
-                    and stats["skipped"] + stats["resumed"] > 0 \
-                    and stats.get("fold") \
-                    and stats["fold"] == learn_mod.manifest_fold(st["blocks"]):
-                verify = "incremental"
-                counters.rate("learn.verify.incremental_count").increment()
-            else:
-                verify = "rescan"
-                counters.rate("learn.verify.rescan_count").increment()
-                from ..engine import EngineOptions
-                from ..engine.db import LsmEngine
+            with JOB_TRACER.hop("learn.digest_proof") as jh:
+                if learn_mod.incremental_digest_enabled() \
+                        and stats["skipped"] + stats["resumed"] > 0 \
+                        and stats.get("fold") \
+                        and stats["fold"] == learn_mod.manifest_fold(
+                            st["blocks"]):
+                    verify = "incremental"
+                    counters.rate(
+                        "learn.verify.incremental_count").increment()
+                else:
+                    verify = "rescan"
+                    counters.rate("learn.verify.rescan_count").increment()
+                    from ..engine import EngineOptions
+                    from ..engine.db import LsmEngine
 
-                ver = LsmEngine(ckpt_dir, EngineOptions(
-                    backend="cpu", pidx=self.pidx))
-                try:
-                    d = ver.state_digest(now=st["digest_now"],
-                                         pmask=st["digest_pmask"])
-                finally:
-                    ver.close()
-                if d["digest"] != st["digest"]:
-                    raise ReplicaError(
-                        f"{self.name}: shipped state digest mismatch at "
-                        f"checkpoint decree {st['ckpt_decree']}: "
-                        f"{d['digest']} != primary {st['digest']}")
-        replayed = self._swap_learned_state(ckpt_dir, tail_state)
+                    ver = LsmEngine(ckpt_dir, EngineOptions(
+                        backend="cpu", pidx=self.pidx))
+                    try:
+                        d = ver.state_digest(now=st["digest_now"],
+                                             pmask=st["digest_pmask"])
+                    finally:
+                        ver.close()
+                    if d["digest"] != st["digest"]:
+                        raise ReplicaError(
+                            f"{self.name}: shipped state digest mismatch at "
+                            f"checkpoint decree {st['ckpt_decree']}: "
+                            f"{d['digest']} != primary {st['digest']}")
+                jh["mode"] = verify
+        with JOB_TRACER.hop("learn.swap") as jh:
+            replayed = self._swap_learned_state(ckpt_dir, tail_state)
+            jh["replayed"] = replayed
         shutil.rmtree(ckpt_dir, ignore_errors=True)  # staged blocks are
         # hardlinked into data/ now; keeping them would feed stale names
         # into the NEXT learn's have-set

@@ -852,6 +852,16 @@ class ReplicaStub:
         except Exception as e:  # noqa: BLE001 - the learner retries
             return codec.encode(rpc_msg.LearnPrepareResponse(
                 error=1, error_text=repr(e)))
+        if req.job:
+            # attribute this primary's checkpoint pin to the learner's
+            # traced job (ISSUE 16) — opens a remote-view record here;
+            # in a onebox the note lands straight in the learn timeline
+            from ..runtime.job_trace import JOB_TRACER
+
+            JOB_TRACER.note("learn.serve_prepare", job_id=req.job,
+                            gpid=f"{req.app_id}.{req.pidx}",
+                            blocks=len(st["blocks"]),
+                            missing=len(st["missing"]))
         return codec.encode(rpc_msg.LearnPrepareResponse(
             learn_id=st["learn_id"], ckpt_decree=st["ckpt_decree"],
             ballot=st["ballot"], last_committed=st["last_committed"],
@@ -1123,7 +1133,8 @@ class ReplicaStub:
             policy = dec.get("policy", "normal")
             try:
                 rep.server.engine.set_compact_policy(
-                    policy, reasons=dec.get("reasons", ()), ttl_s=ttl)
+                    policy, reasons=dec.get("reasons", ()), ttl_s=ttl,
+                    job=dec.get("job", ""))
             except ValueError as e:
                 applied[gpid] = f"error: {e}"
                 continue

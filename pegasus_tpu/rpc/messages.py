@@ -355,6 +355,9 @@ class LearnPrepareRequest:
     pidx: int = 0
     delta: bool = True
     have: List[LearnBlockEntry] = field(default_factory=list)
+    # trailing, ISSUE 16: the learner's job-trace id — the serving
+    # primary attributes its checkpoint pin to the learn's timeline
+    job: str = ""
 
 
 @dataclass
@@ -442,6 +445,9 @@ class OffloadBeginRequest:
     gpid: str = ""
     runs: List[LearnBlockEntry] = field(default_factory=list)
     opts_json: str = ""
+    # trailing, ISSUE 16: the tenant's job-trace id; the service records
+    # its ship/merge hops against it and returns them on merge
+    job: str = ""
 
 
 @dataclass
@@ -484,6 +490,10 @@ class OffloadMergeResponse:
     error_text: str = ""
     outputs: List[LearnBlockEntry] = field(default_factory=list)
     stats_json: str = ""
+    # trailing, ISSUE 16: the service-side hop records for the job (JSON
+    # list) — the tenant stitches them into its own timeline, so one
+    # timeline spans both hosts
+    spans_json: str = ""
 
 
 @dataclass

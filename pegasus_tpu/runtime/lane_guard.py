@@ -84,19 +84,22 @@ class _LaneWorker(threading.Thread):  #: untracked_ok abandoned-by-design deadli
         self._ready = threading.Event()
         self._job = None
 
-    def submit(self, fn, box, done, sessions) -> None:
-        self._job = (fn, box, done, sessions)
+    def submit(self, fn, box, done, sessions, job_id=None) -> None:
+        self._job = (fn, box, done, sessions, job_id)
         self._ready.set()
 
     def run(self):
+        from .job_trace import JOB_TRACER
+
         while True:
             self._ready.wait()
             self._ready.clear()
-            fn, box, done, sessions = self._job
+            fn, box, done, sessions, job_id = self._job
             self._job = None
             self._guard.tracer.adopt_sessions(sessions)
             try:
-                box["result"] = fn()
+                with JOB_TRACER.adopt(job_id):
+                    box["result"] = fn()
             except BaseException as e:  # noqa: BLE001 - crosses the thread boundary
                 box["error"] = e
             done.set()
@@ -275,6 +278,12 @@ class LaneGuard:
             events.emit("lane.breaker_trip", severity="error",
                         lane=self.metric_prefix, op=op,
                         error=str(error)[:200], stage=stage)
+            # the trip lands in the active job's timeline too (ISSUE 16):
+            # the job that pushed the breaker over names the transition
+            from .job_trace import JOB_TRACER
+
+            JOB_TRACER.note("lane.breaker_trip", lane=self.metric_prefix,
+                            op=op)
 
     def record_device_ok(self) -> None:
         with self._lock:
@@ -314,6 +323,11 @@ class LaneGuard:
                     with self._lock:
                         self.retry_count += 1
                     counters.rate(self.metric_prefix + ".retry_count").increment()
+                    from .job_trace import JOB_TRACER
+
+                    JOB_TRACER.note("lane.retry", lane=self.metric_prefix,
+                                    op=op, attempt=attempt + 1,
+                                    error=repr(e)[:200])
                     time.sleep(min(delay, self.config.backoff_max_s))
                     delay *= 2
                     continue
@@ -335,6 +349,8 @@ class LaneGuard:
     def _attempt(self, fn, deadline_s: float, op: str):
         if not deadline_s or deadline_s <= 0:
             return fn()
+        from .job_trace import JOB_TRACER
+
         box = {}
         done = threading.Event()
         sessions = self.tracer.propagate_sessions()
@@ -343,7 +359,7 @@ class LaneGuard:
         if t is None:
             t = _LaneWorker(self)
             t.start()
-        t.submit(fn, box, done, sessions)
+        t.submit(fn, box, done, sessions, job_id=JOB_TRACER.current())
         if not done.wait(deadline_s):
             # abandoned in its thread, never killed; its span stays open so
             # the watchdog keeps attributing the wedge after we move on
@@ -372,6 +388,10 @@ class LaneGuard:
         counters.rate(self.metric_prefix + ".fallback_count").increment()
         events.emit("lane.fallback", severity="warn",
                     lane=self.metric_prefix, op=op, reason=reason[:200])
+        from .job_trace import JOB_TRACER
+
+        JOB_TRACER.note("lane.fallback", lane=self.metric_prefix, op=op,
+                        reason=reason[:200])
         print(f"[lane-guard:{self.metric_prefix}] {op}: falling back to the "
               f"host path ({reason})", flush=True)
         return fallback_fn()

@@ -51,6 +51,9 @@ _DEFAULT_PREFIXES = (
     # the compaction stage spans' duration p99s: the series the
     # scheduler's feedback tuner folds (ISSUE 14 satellite)
     "compact.stage.",
+    # the learn plane's ship/verify series (ISSUE 13 — was invisible in
+    # flight-recorder history windows) and the job tracer's gauges
+    "learn.", "job.",
 )
 
 

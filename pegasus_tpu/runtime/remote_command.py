@@ -56,6 +56,7 @@ class RemoteCommandService:
         self.register("device-health", self._cmd_device_health)
         self.register("request-trace-dump", self._cmd_request_trace_dump)
         self.register("slow-requests", self._cmd_slow_requests)
+        self.register("job-trace", self._cmd_job_trace)
         if describe is not None:
             self.register("describe", lambda a: json.dumps(describe(), indent=1))
 
@@ -150,6 +151,24 @@ class RemoteCommandService:
         return json.dumps(
             REQUEST_TRACER.slow_requests(int(args[0]) if args else 50),
             indent=1)
+
+    @staticmethod
+    def _cmd_job_trace(args) -> str:
+        """job-trace [last | <job-id>] — this process's background-job
+        timelines (runtime/job_trace.py): completed jobs plus the still-
+        open ones, or ONE timeline when a j…-id is given. Pid-keyed like
+        events-dump, so a partition-group router's structural fan-out
+        merge keeps every worker process's view side by side."""
+        import os
+
+        from .job_trace import JOB_TRACER
+
+        if args and args[0].startswith("j"):
+            found = JOB_TRACER.find(args[0])
+            return json.dumps({f"pid:{os.getpid()}":
+                               [found] if found else []})
+        last = int(args[0]) if args else 50
+        return json.dumps({f"pid:{os.getpid()}": JOB_TRACER.jobs(last=last)})
 
     def _cmd_server_stat(self, args) -> str:
         """One-line digest of selected counters (brief_stat.cpp role)."""

@@ -142,6 +142,28 @@ def _request_trace_route(path: str) -> dict:
             "slow_requests": REQUEST_TRACER.slow_requests(last)}
 
 
+def _jobs_route(path: str) -> dict:
+    """GET /jobs[?last=N][&id=<j…>][&active=0]: the background-job
+    tracer (runtime/job_trace.py JobTracer) — completed job timelines
+    plus the still-open ones, the HTTP twin of the `job-trace` remote
+    command and the shell's `job_trace`. ?id= looks one timeline up by
+    its job id; ?active=0 returns completed jobs only."""
+    from urllib.parse import parse_qs, urlparse
+
+    from .job_trace import JOB_TRACER
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        last = int((q.get("last") or ["50"])[0])
+    except ValueError:
+        last = 50
+    job_id = (q.get("id") or [""])[0]
+    if job_id:
+        return {"job": JOB_TRACER.find(job_id)}
+    active = (q.get("active") or ["1"])[0] not in ("0", "")
+    return {"jobs": JOB_TRACER.jobs(last=last, active=active)}
+
+
 def _events_route(path: str) -> dict:
     """GET /events[?last=N][&prefix=p][&since=ts]: the process-wide
     structured event ring (runtime/events.py) — the HTTP twin of the
@@ -260,6 +282,7 @@ def _meta_http_routes(meta) -> dict:
             "/meta/app": app,
             "/compact/trace": _compact_trace_route,
             "/requests/trace": _request_trace_route,
+            "/jobs": _jobs_route,
             "/events": _events_route,
             "/metrics/history": _metrics_history_route,
             "/incidents": _incidents_route}
@@ -282,6 +305,7 @@ def _replica_http_routes(stub) -> dict:
             "/replica/info": info,
             "/compact/trace": _compact_trace_route,
             "/requests/trace": _request_trace_route,
+            "/jobs": _jobs_route,
             "/events": _events_route,
             "/metrics/history": _metrics_history_route}
 
@@ -640,6 +664,7 @@ class CollectorApp:
                 port=http_port,
                 routes={"/compact/trace": _compact_trace_route,
                         "/requests/trace": _request_trace_route,
+                        "/jobs": _jobs_route,
                         "/events": _events_route,
                         "/metrics/history": _metrics_history_route,
                         "/incidents": _incidents_route,

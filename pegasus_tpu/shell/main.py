@@ -106,6 +106,10 @@ class Shell:
                               "slow_requests [node|--cluster] [last] — the "
                               "slow-request ledger; --cluster merges every "
                               "node's ledger into one worst-first top-N"),
+            "job_trace": (self.cmd_job_trace,
+                          "job_trace [node] [last|<job-id>] — background-"
+                          "job timelines (compaction/offload/learn/dup "
+                          "hops, one causal id across nodes)"),
             "events": (self.cmd_events,
                        "events [node] [last] [prefix] — the structured "
                        "event ring (flight recorder): breaker trips, "
@@ -620,6 +624,12 @@ class Shell:
             self.p(self._node_command(args[0], "request-trace-dump", args[1:]))
         else:
             self.cmd_remote_command(["all", "request-trace-dump"])
+
+    def cmd_job_trace(self, args):
+        if args:
+            self.p(self._node_command(args[0], "job-trace", args[1:]))
+        else:
+            self.cmd_remote_command(["all", "job-trace"])
 
     def cmd_slow_requests(self, args):
         if args and args[0] == "--cluster":

@@ -282,6 +282,70 @@ def test_events_pass_flags_nonliteral_names(tmp_path):
     assert all("plain string literal" in f.message for f in nonlit)
 
 
+# ------------------------------------------------------------ span_names
+
+SPANS_OK = """
+    from pegasus_tpu.runtime.job_trace import JOB_TRACER
+    from pegasus_tpu.runtime.tracing import COMPACT_TRACER
+
+    def work(job):
+        with COMPACT_TRACER.span("pack", records=1):
+            pass
+        with JOB_TRACER.hop("engine.merge", where="local"):
+            JOB_TRACER.note("sched.decide", gpid="1.0")
+        self._trace(job, "offload.svc.merge", ms=3)
+"""
+
+SPAN_README = """
+    ### Span-name table
+
+    | span / hop | tracer | what it times |
+    |---|---|---|
+    | `pack` | stage | columnarization |
+    | `engine.merge` / `sched.decide` | job | merge hop; the minting decision |
+    | `offload.svc.merge` | job (service-side) | the remote merge |
+"""
+
+
+def test_span_names_pass_clean_twin(tmp_path):
+    repo = make_repo(tmp_path, {"m.py": SPANS_OK}, readme=SPAN_README)
+    assert run_pass("span_names", repo) == []
+
+
+def test_span_names_pass_both_directions(tmp_path):
+    repo = make_repo(tmp_path, {"m.py": SPANS_OK + """
+    def ghost():
+        with JOB_TRACER.hop("ghost.hop"):
+            pass
+    """}, readme=SPAN_README + """
+    | `stale.span` | stage | deleted call site, row kept |
+    """)
+    keys = {f.key for f in run_pass("span_names", repo)}
+    assert "undoc:ghost.hop" in keys
+    assert "stale-row:stale.span" in keys
+    assert not any(k.endswith((":pack", ":engine.merge", ":sched.decide",
+                               ":offload.svc.merge")) for k in keys)
+
+
+def test_span_names_pass_requires_table(tmp_path):
+    repo = make_repo(tmp_path, {"m.py": SPANS_OK}, readme="# nothing")
+    assert [f.key for f in run_pass("span_names", repo)] == ["no-table"]
+
+
+def test_span_names_pass_exempts_dynamic_names(tmp_path):
+    """Unlike event names, span names are legitimately parameterized
+    (client.<op>, rpc.<code>, the <kind>.nested degradation hop) —
+    dynamic call sites are exempt, never flagged."""
+    repo = make_repo(tmp_path, {"m.py": SPANS_OK + """
+    def dynamic(op, kind):
+        with COMPACT_TRACER.span(f"client.{op}"):
+            pass
+        with JOB_TRACER.hop(f"{kind}.nested"):
+            pass
+    """}, readme=SPAN_README)
+    assert run_pass("span_names", repo) == []
+
+
 # -------------------------------------------------------------- lockrank
 
 def _graph():
@@ -554,4 +618,5 @@ def test_repo_clean():
     assert report.clean, "\n".join(lines)
     assert set(report.ran) == {"env_knobs", "events", "fail_points",
                                "lock_discipline", "metric_names",
-                               "remote_commands", "thread_lifecycle"}
+                               "remote_commands", "span_names",
+                               "thread_lifecycle"}

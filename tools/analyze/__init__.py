@@ -11,6 +11,8 @@ passes the review rounds kept doing by hand:
   remote_commands   command registrations <-> README command table
   events            events.emit() names <-> README event table (and the
                     names must be plain string literals)
+  span_names        tracer span/hop names <-> README span-name table
+                    (literal call sites only; dynamic names are exempt)
   lock_discipline   `#: guarded_by` fields only touched under their lock
   thread_lifecycle  raw Thread/ThreadPoolExecutor spawns must route
                     through runtime/tasking's tracked helpers
@@ -181,7 +183,7 @@ def pass_names() -> list:
 def _load_passes() -> None:
     from . import (env_knobs, events, fail_points,  # noqa: F401
                    lock_discipline, metric_names, remote_commands,
-                   thread_lifecycle)
+                   span_names, thread_lifecycle)
 
 
 def run_pass(name: str, repo: Repo = None) -> list:

@@ -108,6 +108,12 @@ def _parse_args(argv=None):
                          "compaction-offload wire wedge + mid-merge "
                          "service kill against a harness-wired offload "
                          "service with every partition placed onto it")
+    ap.add_argument("--offload-kill-every", type=float, default=15.0,
+                    help="--scenario offload: repeat the mid-merge service "
+                         "kill on this period for the whole run (ROADMAP "
+                         "offload follow-on (d), the longer soak) instead "
+                         "of once; must exceed the kill's 4 s heal window; "
+                         "0 = single kill")
     ap.add_argument("--audit-every", type=float, default=5.0,
                     help="seconds between decree-anchored audit rounds "
                          "under the load (0 disables; a final quiesced "
@@ -195,7 +201,16 @@ def _build_harness(args, journal):
         actors[sc.A_OFFLOAD] = act.OffloadServiceKill(ctl, caller=caller)
     box.chaos_caller = caller   # closed with the box in the run's finally
     box.alive_nodes = alive_nodes   # --inject-fault victim selection
-    return box, dst, actors, sc.SCENARIOS[args.scenario]()
+    if args.scenario == "offload":
+        # the soak shape (ISSUE 16 satellite): the service kill repeats
+        # on --offload-kill-every for the run's whole duration, so a
+        # longer --seconds means MORE kill/heal/re-adopt cycles — not
+        # one kill followed by minutes of quiet
+        scenario = sc.offload_scenario(
+            kill_every_s=args.offload_kill_every or None)
+    else:
+        scenario = sc.SCENARIOS[args.scenario]()
+    return box, dst, actors, scenario
 
 
 class _OffloadServiceCtl:
@@ -439,6 +454,16 @@ def run_pressure(argv=None) -> int:
             audits = AuditRounds([meta_addr], apps=[args.table],
                                  every_s=args.audit_every,
                                  wait_s=min(5.0, args.audit_every),
+                                 journal=journal).start()
+        elif args.scenario == "offload":
+            # the offload soak ALWAYS concludes with one quiesced audit
+            # round, even under --audit-every 0 (ISSUE 16 satellite): a
+            # run that survived N service kills but never proved the
+            # digests match proved nothing. The huge cadence parks the
+            # loop on its stop event; stop(final_round=True) below runs
+            # the single post-quiesce round.
+            audits = AuditRounds([meta_addr], apps=[args.table],
+                                 every_s=3600.0, wait_s=5.0,
                                  journal=journal).start()
         if args.inject_fault:
             # UNDECLARED corruption on the first node — no fault window,
