@@ -453,3 +453,92 @@ class SchedFlipActor(FaultActor):
     def recovered(self) -> bool:
         # recovered = the urgent lease expired (tokens revert to normal)
         return time.monotonic() - self._flip_at >= 3.0
+
+
+class DiskCorruptActor(FaultActor):
+    """Silent bit-rot (ISSUE 17): byte-flip a live SST of one hosted
+    replica on a victim node's disk, then drive the self-healing loop —
+    detection (a forced scrub, unless the read path trips first),
+    quarantine (the stub pulls the copy into the forensics dir and
+    beacons QUARANTINED), and heal (the meta's `repair_quarantined`
+    drops the lost member and re-seeds it via the block-shipped learn).
+    recovered() only reports True once the quarantine was OBSERVED and
+    membership is fully replicated again — a corruption that silently
+    disappears is a failed leg, not a recovery."""
+
+    def __init__(self, cluster, node_index: int = 0, caller=None):
+        self.cluster = cluster
+        self.node_index = node_index
+        self.caller = caller
+        self._victim = None      # (stub, "app_id.pidx", sst path)
+        self._detected = False
+        self._last_repair = 0.0
+
+    def arm(self, node_index: int = None):
+        import glob
+        import os
+
+        self._detected = False
+        idx = self.node_index if node_index is None else node_index
+        stub = self.cluster.stubs[idx]
+        with stub._lock:
+            keys = sorted(stub._replicas)
+            reps = dict(stub._replicas)
+        for (a, p) in keys:
+            data = os.path.join(stub.root, f"{a}.{p}", "data")
+            ssts = sorted(glob.glob(os.path.join(data, "*.sst")))
+            if not ssts:
+                # nothing durable yet: force a synchronous memtable flush
+                # so the victim partition has an on-disk file to rot
+                try:
+                    reps[(a, p)].server.engine.flush()
+                except Exception:  # noqa: BLE001 - try the next replica
+                    continue
+                ssts = sorted(glob.glob(os.path.join(data, "*.sst")))
+            if not ssts:
+                continue
+            path = ssts[-1]
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                chunk = f.read(8)
+                f.seek(size // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+            self._victim = (stub, f"{a}.{p}", path)
+            return
+        raise RuntimeError(f"node {idx} hosts no SST to corrupt")
+
+    def _quarantined(self, stub, gpid: str) -> bool:
+        with stub._lock:
+            return gpid in stub._quarantined
+
+    def recovered(self) -> bool:
+        stub, gpid, _ = self._victim
+        if not self._detected:
+            if not self._quarantined(stub, gpid):
+                # deterministic detection: force the background scrub's
+                # verify pass now (idempotent; a no-op if the read path
+                # already quarantined the replica between the checks)
+                try:
+                    if self.caller is not None:
+                        self.caller.remote_command(stub.address,
+                                                  "scrub-replica", [gpid])
+                    else:
+                        stub._cmd_scrub_replica([gpid])
+                except (RpcError, OSError):
+                    return False
+            if not self._quarantined(stub, gpid):
+                return False
+            self._detected = True
+        # heal: the meta treats the beaconed QUARANTINED copy as lost
+        # (membership drop + learner re-seed). Same 1 s pacing as the
+        # node-kill actor — each pass scans partitions under the meta
+        # lock and a failing seed should not ballot-bump every 0.2 s
+        now = time.monotonic()
+        if now - self._last_repair >= 1.0:
+            self._last_repair = now
+            self.cluster.meta.repair_quarantined()
+            self.cluster.meta.repair_under_replication()
+        if self._quarantined(stub, gpid):
+            return False  # re-seed has not re-opened the partition here
+        return _fully_replicated(self.cluster, self.caller)

@@ -230,6 +230,7 @@ A_SPLIT = "split"
 A_BALANCE = "balance"
 A_SCHED = "sched_flip"
 A_OFFLOAD = "offload_kill"
+A_DISK_CORRUPT = "disk_corrupt"
 
 
 def smoke_scenario() -> Scenario:
@@ -304,5 +305,26 @@ def offload_scenario(kill_every_s: float = None) -> Scenario:
     ])
 
 
+def corruption_scenario() -> Scenario:
+    """Data-integrity leg (ISSUE 17): silent bit-rot under write load.
+    First a `scrub.verify` fail-point window proves the background scrub
+    itself survives injected verify faults without quarantining healthy
+    replicas (lane-guard breakers must stay untouched throughout — the
+    driving harness asserts that), then the disk-corrupt actor byte-flips
+    a live SST and the window only closes after the full loop: typed
+    detection, quarantine with the forensics dir retained, meta re-seed
+    via the block-shipped learn, and full re-replication. The harness
+    finishes with a conclusive mismatch-free audit round + fsck."""
+    return Scenario("corruption", [
+        FaultAction("scrub-verify-chaos", A_FAILPOINT, at_s=1.0,
+                    duration_s=3.0, recovery_deadline_s=10.0, settle_s=0.5,
+                    args={"point": "scrub.verify",
+                          "action": "2*raise(chaos)"}),
+        FaultAction("disk-corrupt", A_DISK_CORRUPT, at_s=5.0,
+                    duration_s=1.0, recovery_deadline_s=40.0, settle_s=2.0),
+    ])
+
+
 SCENARIOS = {"smoke": smoke_scenario, "full": full_scenario,
-             "offload": offload_scenario}
+             "offload": offload_scenario,
+             "corruption": corruption_scenario}

@@ -505,6 +505,7 @@ def run_cluster_doctor(meta_addrs, pool: ConnectionPool = None,
         _check_partitions(state, causes, evidence)
         _check_lag(state, causes, evidence)
         _check_audit(state, causes, evidence)
+        _check_quarantine(state, causes, evidence)
         if scrape:
             _scrape_nodes(caller, state, causes, evidence, slow_last)
         verdict = CRITICAL if any(c["severity"] == CRITICAL
@@ -527,6 +528,17 @@ def run_cluster_doctor(meta_addrs, pool: ConnectionPool = None,
         except Exception as e:  # noqa: BLE001 - capture is best-effort;
             # the verdict must never fail because evidence gathering did
             print(f"[doctor] incident capture failed: {e!r}", flush=True)
+        # audit-driven auto-heal (ISSUE 17): gated off unless
+        # PEGASUS_AUTOHEAL=1, interlocked + rate-limited inside — the
+        # verdict must never fail because the heal attempt did
+        try:
+            from .auto_heal import AUTO_HEALER
+
+            healed = AUTO_HEALER.observe_verdict(out, caller=caller)
+            if healed:
+                out["autoheal"] = healed
+        except Exception as e:  # noqa: BLE001 - heal is best-effort
+            print(f"[doctor] auto-heal failed: {e!r}", flush=True)
         return out
     finally:
         if own:
@@ -697,6 +709,31 @@ def _check_audit(state, causes, evidence) -> None:
                                 f"{m['gpid']} on node {m['node']} "
                                 f"(decree {m['decree']})",
                        "evidence": "audit.mismatches"})
+
+
+def _check_quarantine(state, causes, evidence) -> None:
+    """Beacon-reported QUARANTINED partitions (ISSUE 17): a node pulled a
+    corrupt copy off the serving path and is waiting for the meta's
+    repair_quarantined re-seed. Degraded, not critical — the healthy
+    members keep serving; the cause names node, partition and reason so
+    an operator (or the incident artifact) sees WHY the copy vanished."""
+    quarantined = []
+    for node, states in state.get("replica_states", {}).items():
+        for gpid, st in states.items():
+            if st.get("status") != "QUARANTINED":
+                continue
+            q = st.get("quarantine", {})
+            quarantined.append({"gpid": gpid, "node": node,
+                                "reason": q.get("reason", ""),
+                                "source": q.get("source", ""),
+                                "dir": q.get("dir", "")})
+    evidence["quarantine"] = quarantined
+    for q in sorted(quarantined, key=lambda x: (x["gpid"], x["node"])):
+        causes.append({"severity": DEGRADED,
+                       "cause": f"replica {q['gpid']} on node {q['node']} "
+                                f"quarantined ({q['source']}: "
+                                f"{q['reason'] or 'corruption'})",
+                       "evidence": "quarantine"})
 
 
 def _scrape_nodes(caller, state, causes, evidence, slow_last) -> None:

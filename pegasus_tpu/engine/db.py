@@ -52,7 +52,7 @@ from ..runtime import events, lockrank
 from ..ops.compact import CompactOptions, compact_blocks, sort_block
 from .block import KVBlock
 from .memtable import Memtable
-from .sstable import SSTable, write_sst
+from .sstable import CorruptionError, SSTable, verify_sst, write_sst
 
 MANIFEST = "MANIFEST"
 CHECKPOINT_PREFIX = "checkpoint."
@@ -375,6 +375,11 @@ class LsmEngine:
         self._device_read_min = max(1, int(
             os.environ.get("PEGASUS_DEVICE_READ_MIN_BATCH", "2"))
             if mb is None else mb)
+        # corruption callout (ISSUE 17): the hosting replica stub installs
+        # a callable(exc) here right after open, before the engine serves —
+        # a read path or compaction hitting a CorruptionError notifies it
+        # (quarantine driver) and re-raises the typed error to the caller
+        self.corruption_hook = None  #: unguarded_ok set once at open, before the engine is published to serving threads
         os.makedirs(path, exist_ok=True)
         self._load_manifest()
         if self.opts.backend == "tpu":
@@ -647,6 +652,25 @@ class LsmEngine:
             pend = [i for i in pend if i not in res]
         return res
 
+    def _notify_corruption(self, exc) -> None:
+        """Best-effort callout on a typed CorruptionError: counted,
+        evented, and forwarded to the hosting stub's corruption_hook
+        (which pulls this replica off the serving path). Callers always
+        re-raise — the client gets the typed error, never garbage."""
+        from ..runtime import events
+        from ..runtime.perf_counters import counters
+
+        counters.rate("engine.corruption_count").increment()
+        events.emit("engine.corruption", "error",
+                    path=str(getattr(exc, "path", "")),
+                    detail=str(getattr(exc, "detail", exc)))
+        hook = self.corruption_hook
+        if hook is not None:
+            try:
+                hook(exc)
+            except Exception as e:  # the hook must never mask the error
+                print(f"[engine] corruption hook failed: {e!r}", flush=True)
+
     def _probe_sst(self, sst, cand, keys, nows, res, use_device) -> None:
         """Resolve one SST's candidates into `res` (hits only — a found
         tombstone/expired record resolves to None exactly like db.get).
@@ -655,6 +679,13 @@ class LsmEngine:
         — identical row indexes either way."""
         if not cand:
             return
+        try:
+            self._probe_sst_impl(sst, cand, keys, nows, res, use_device)
+        except CorruptionError as e:
+            self._notify_corruption(e)
+            raise
+
+    def _probe_sst_impl(self, sst, cand, keys, nows, res, use_device) -> None:
         dr = sst.device_index if use_device else None
         if dr is not None and len(cand) >= self._device_read_min:
             from ..ops.device_lookup import lookup_batch
@@ -721,7 +752,11 @@ class LsmEngine:
                 return
             if hash32 is not None and not sst.maybe_contains_hash(hash32):
                 return
-            b = sst.block()
+            try:
+                b = sst.block()
+            except CorruptionError as e:
+                self._notify_corruption(e)
+                raise
             lo = sst.lower_bound(start_key) if start_key else 0
             hi = sst.lower_bound(stop_key) if stop_key is not None else b.n
             rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
@@ -802,6 +837,93 @@ class LsmEngine:
             add = (add + c) & 0xFFFFFFFFFFFFFFFF
             n += 1
         return {"digest": f"{xor:016x}{add:016x}", "records": n, "now": now}
+
+    # ------------------------------------------------------------------ scrub
+
+    def scrub(self, rate_bytes_per_s: float = None) -> dict:
+        """Background integrity pass (ISSUE 17): re-verify every landed
+        SST's section checksums OFF the serving path (raw file reads, no
+        block materialization, no device work — lane guards untouched by
+        construction) and recompute the manifest-referenced file set
+        against the directory. Rate-limited to `rate_bytes_per_s` when
+        set. Returns {"files", "bytes", "findings": [{"path","detail"}]}.
+        Findings are returned, not acted on — the hosting stub owns the
+        quarantine decision. Files that vanish mid-scan (compacted away)
+        or are still landing (deferred installs) are skipped, and a
+        manifest reference is only a finding while the live version still
+        claims it."""
+        from ..runtime.fail_points import FailPointError, inject
+        from ..runtime.job_trace import JOB_TRACER
+        from ..runtime.perf_counters import counters
+
+        with self._lock:
+            paths = [s.path for s in self._all_ssts_locked() if s._on_disk]
+        findings = []
+        errors = []
+        scanned_files = scanned_bytes = 0
+        t0 = time.monotonic()
+        with JOB_TRACER.job("engine.scrub", path=self.path):
+            with JOB_TRACER.hop("scrub.files") as attrs:
+                for p in paths:
+                    try:
+                        inject("scrub.verify")
+                        scanned_bytes += verify_sst(p)
+                        scanned_files += 1
+                    except FileNotFoundError:
+                        continue  # compacted away mid-scan
+                    except FailPointError as e:
+                        # injected scrub fault (chaos): the file was NOT
+                        # verified — an error to retry next cadence, never
+                        # a corruption finding (a finding quarantines the
+                        # replica; chaos must not nuke healthy copies)
+                        errors.append({"path": p, "detail": str(e)})
+                    except CorruptionError as e:
+                        findings.append({"path": p, "detail": e.detail})
+                    if rate_bytes_per_s and rate_bytes_per_s > 0:
+                        budget_s = scanned_bytes / rate_bytes_per_s
+                        lag = budget_s - (time.monotonic() - t0)
+                        if lag > 0:
+                            time.sleep(min(lag, 1.0))
+                attrs.update(files=scanned_files, bytes=scanned_bytes,
+                             findings=len(findings))
+            with JOB_TRACER.hop("scrub.manifest") as attrs:
+                missing = self._scrub_manifest()
+                attrs.update(missing=len(missing))
+                findings.extend(missing)
+        counters.rate("scrub.files_count").increment(scanned_files)
+        counters.rate("scrub.bytes").increment(scanned_bytes)
+        if findings:
+            counters.rate("scrub.corruption_count").increment(len(findings))
+        return {"files": scanned_files, "bytes": scanned_bytes,
+                "findings": findings, "errors": errors}
+
+    def _scrub_manifest(self) -> list:
+        """Every file the on-disk MANIFEST references must exist — unless
+        the live version no longer claims it (a compaction landed between
+        the disk read and the existence check)."""
+        mpath = os.path.join(self.path, MANIFEST)
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+            referenced = list(m.get("l0", []))
+            for fs in m.get("levels", {}).values():
+                referenced.extend(fs)
+        except FileNotFoundError:
+            return []  # fresh dir: nothing referenced yet
+        except (ValueError, KeyError, TypeError) as e:
+            return [{"path": mpath, "detail": f"unparseable manifest: {e}"}]
+        gone = [n for n in referenced
+                if not os.path.exists(os.path.join(self.path, n))]
+        if not gone:
+            return []
+        with self._lock:
+            live = self._manifest_dict_locked()
+            still = set(live["l0"])
+            for fs in live["levels"].values():
+                still.update(fs)
+        return [{"path": os.path.join(self.path, n),
+                 "detail": "manifest references missing file"}
+                for n in gone if n in still]
 
     # ----------------------------------------------------------- flush/compact
 

@@ -21,6 +21,22 @@ from .block import KVBlock
 
 MAGIC = b"PGTS1\n"
 
+
+class CorruptionError(ValueError):
+    """Typed on-disk corruption: bad magic, truncated file, unparseable
+    header, or a section whose crc32 no longer matches what write_sst
+    recorded. Subclasses ValueError so pre-existing broad handlers (e.g.
+    manifest orphan adoption) keep treating a rotten file as unusable
+    rather than crashing, while new code can catch corruption by type.
+    Raised by read_header/read_sst/verify_sst — never a raw struct.error
+    or JSONDecodeError."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"{path}: {detail}")
+        self.path = path
+        self.detail = detail
+
+
 _COLUMNS = [
     ("key_arena", np.uint8),
     ("key_off", np.int64),
@@ -99,7 +115,8 @@ def _write_sst_impl(path: str, block: KVBlock, meta: dict,
                           "raw_nbytes": len(raw),
                           "dtype": np.dtype(dtype).str,
                           "shape": list(arr.shape),
-                          "compression": compression}
+                          "compression": compression,
+                          "crc32": zlib.crc32(stored) & 0xFFFFFFFF}
         payload.append(stored)
         offset += len(stored)
     if bloom is not None:
@@ -133,35 +150,96 @@ def _write_sst_impl(path: str, block: KVBlock, meta: dict,
     return header
 
 
+def _read_header_open(f, path: str) -> dict:
+    """Header parse over an open file; every failure mode is typed."""
+    magic = f.read(len(MAGIC))
+    if magic != MAGIC:
+        raise CorruptionError(path, f"bad SST magic {magic!r}")
+    raw_len = f.read(4)
+    if len(raw_len) < 4:
+        raise CorruptionError(path, "truncated before header length")
+    (hlen,) = struct.unpack("<I", raw_len)
+    raw_hdr = f.read(hlen)
+    if len(raw_hdr) < hlen:
+        raise CorruptionError(
+            path, f"truncated header ({len(raw_hdr)}/{hlen} bytes)")
+    try:
+        return json.loads(raw_hdr)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptionError(path, f"unparseable header: {e}") from e
+
+
 def read_header(path: str) -> dict:
     with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"{path}: bad SST magic {magic!r}")
-        (hlen,) = struct.unpack("<I", f.read(4))
-        return json.loads(f.read(hlen))
+        return _read_header_open(f, path)
+
+
+def _read_section(f, path: str, base: int, name: str, sec: dict) -> bytes:
+    """One stored section, crc-checked when the header carries a crc32
+    (legacy pre-checksum headers don't — they stay readable unchecked)."""
+    import zlib
+
+    f.seek(base + sec["offset"])
+    stored = f.read(sec["nbytes"])
+    if len(stored) < sec["nbytes"]:
+        raise CorruptionError(
+            path, f"section {name} truncated "
+                  f"({len(stored)}/{sec['nbytes']} bytes)")
+    want = sec.get("crc32")
+    if want is not None and (zlib.crc32(stored) & 0xFFFFFFFF) != want:
+        raise CorruptionError(
+            path, f"section {name} crc32 mismatch "
+                  f"(stored {want:#010x}, "
+                  f"computed {zlib.crc32(stored) & 0xFFFFFFFF:#010x})")
+    if sec.get("compression", "none") == "zlib":
+        try:
+            stored = zlib.decompress(stored)
+        except zlib.error as e:
+            raise CorruptionError(
+                path, f"section {name} undecompressable: {e}") from e
+    return stored
 
 
 def read_sst(path: str) -> tuple:
     """-> (KVBlock, header dict)."""
     with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"{path}: bad SST magic {magic!r}")
-        (hlen,) = struct.unpack("<I", f.read(4))
-        header = json.loads(f.read(hlen))
-        base = len(MAGIC) + 4 + hlen
+        header = _read_header_open(f, path)
+        base = f.tell()
         cols = {}
         for name, _ in _COLUMNS:
-            sec = header["sections"][name]
-            f.seek(base + sec["offset"])
-            raw = f.read(sec["nbytes"])
-            if sec.get("compression", "none") == "zlib":
-                import zlib
-
-                raw = zlib.decompress(raw)
-            cols[name] = np.frombuffer(raw, dtype=np.dtype(sec["dtype"])).reshape(sec["shape"]).copy()
+            try:
+                sec = header["sections"][name]
+            except (KeyError, TypeError) as e:
+                raise CorruptionError(
+                    path, f"header missing section {name}") from e
+            raw = _read_section(f, path, base, name, sec)
+            try:
+                cols[name] = np.frombuffer(
+                    raw, dtype=np.dtype(sec["dtype"])
+                ).reshape(sec["shape"]).copy()
+            except (ValueError, TypeError) as e:
+                raise CorruptionError(
+                    path, f"section {name} unmaterializable: {e}") from e
     return KVBlock(**cols), header
+
+
+def verify_sst(path: str) -> int:
+    """Full-file integrity pass (scrub + fsck): magic, header parse, and
+    every section's length + crc32 — without materializing a KVBlock.
+    Returns the byte count read; raises CorruptionError on any finding."""
+    with open(path, "rb") as f:
+        header = _read_header_open(f, path)
+        base = f.tell()
+        scanned = base
+        sections = header.get("sections")
+        if not isinstance(sections, dict):
+            raise CorruptionError(path, "header missing sections")
+        for name, _ in _COLUMNS:
+            sec = sections.get(name)
+            if not isinstance(sec, dict):
+                raise CorruptionError(path, f"header missing section {name}")
+            scanned += len(_read_section(f, path, base, name, sec))
+    return scanned
 
 
 class SSTable:

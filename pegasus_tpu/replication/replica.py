@@ -129,6 +129,11 @@ class Replica:
         self.server = PegasusServer(os.path.join(path, "data"), app_id=app_id,
                                     pidx=pidx, options=options, server=name,
                                     cluster_id=cluster_id)
+        # on-disk corruption callout (ISSUE 17): the stub points this at
+        # its quarantine machinery; kept on the Replica (not just the
+        # engine) because a learn replaces the engine wholesale and the
+        # fresh one must keep reporting
+        self.corruption_hook = None
         self.plog = MutationLog(os.path.join(path, "plog"), fsync=fsync)
         # decree -> LogMutation (prepared, not applied)
         self._uncommitted = {}   #: guarded_by self._lock
@@ -167,6 +172,13 @@ class Replica:
             self._prep_pool = tracked_executor(
                 4, thread_name_prefix=f"prep-{self.name}")
         return self._prep_pool
+
+    def set_corruption_hook(self, fn) -> None:
+        """Install the stub's read-path corruption callout on this replica
+        AND its current engine (future engines — learn swaps — inherit it
+        from self.corruption_hook in _swap_learned_state)."""
+        self.corruption_hook = fn
+        self.server.engine.corruption_hook = fn
 
     # ----------------------------------------------------------- recovery
 
@@ -725,6 +737,9 @@ class Replica:
                                  app_id=self.app_id, pidx=self.pidx,
                                  options=engine.opts, server=self.name,
                                  cluster_id=self.cluster_id)
+            # the swap built a brand-new engine: re-arm the corruption
+            # callout or post-learn bit-rot would go unreported
+            self.server.engine.corruption_hook = self.corruption_hook
             self.plog.reset()
             self.last_committed = self.server.engine.last_committed_decree()
             self.last_prepared = self.last_committed

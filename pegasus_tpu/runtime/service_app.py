@@ -392,6 +392,10 @@ class MetaApp:
             try:
                 if self._is_leader():  # followers watch, never act
                     self.meta.check_leases()
+                    # heal quarantined replicas (ISSUE 17): a beacon
+                    # reporting QUARANTINED is a lost copy — reconfigure
+                    # + re-seed on the same cadence as lease expiry
+                    self.meta.repair_quarantined()
             except Exception as e:  # a fenced persist (or any failure)
                 # must not kill the FD timer for the process lifetime
                 print(f"[meta] fd tick failed: {e!r}", flush=True)

@@ -98,6 +98,15 @@ class Shell:
                               "device-health watchdog + lane-guard state on "
                               "every node (last_ok / wedged_at_stage / "
                               "breaker / cpu-fallback totals)"),
+            "quarantine_status": (self.cmd_quarantine_status,
+                                  "quarantine_status [node] — replicas "
+                                  "fenced for on-disk corruption (reason, "
+                                  "source, forensics dir) per node"),
+            "scrub_replica": (self.cmd_scrub_replica,
+                              "scrub_replica <node|all> [gpid] — force one "
+                              "integrity scrub pass now (checksum-verify "
+                              "live SSTs off the serving path; corrupt "
+                              "replicas quarantine themselves)"),
             "request_trace": (self.cmd_request_trace,
                               "request_trace [node] [last] — recent sampled "
                               "request traces (client/rpc/replication/engine "
@@ -618,6 +627,18 @@ class Shell:
 
     def cmd_device_health(self, args):
         self.cmd_remote_command(["all", "device-health"])
+
+    def cmd_quarantine_status(self, args):
+        if args:
+            self.p(self._node_command(args[0], "quarantine-status", args[1:]))
+        else:
+            self.cmd_remote_command(["all", "quarantine-status"])
+
+    def cmd_scrub_replica(self, args):
+        if not args:
+            self.p("usage: scrub_replica <node|all> [gpid]")
+            return
+        self.cmd_remote_command([args[0], "scrub-replica"] + args[1:])
 
     def cmd_request_trace(self, args):
         if args:

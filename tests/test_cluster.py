@@ -41,15 +41,26 @@ class Cluster:
             self.nodes[stub.address] = stub
 
     def ddl(self, code, req, resp_cls):
-        from pegasus_tpu.rpc.transport import RpcConnection
+        from pegasus_tpu.rpc.transport import RpcConnection, RpcError
 
         host, _, port = self.meta_addr.rpartition(":")
-        conn = RpcConnection((host, int(port)))
-        try:
-            _, body = conn.call(code, codec.encode(req), timeout=10.0)
-            return codec.decode(resp_cls, body)
-        finally:
-            conn.close()
+        # Bounded retry: under parallel-suite load the meta's accept
+        # loop can lag past a single call's timeout, which used to flake
+        # these tests with spurious meta-unreachable errors. Each
+        # attempt uses a FRESH connection (a timed-out socket may have
+        # a stale half-response buffered).
+        last = None
+        for attempt in range(4):
+            conn = RpcConnection((host, int(port)))
+            try:
+                _, body = conn.call(code, codec.encode(req), timeout=10.0)
+                return codec.decode(resp_cls, body)
+            except (RpcError, OSError, TimeoutError) as e:
+                last = e
+                time.sleep(0.25 * (attempt + 1))
+            finally:
+                conn.close()
+        raise last
 
     def kill_node(self, addr):
         stub = self.nodes.pop(addr)

@@ -1,0 +1,453 @@
+"""Self-healing data integrity (ISSUE 17): typed corruption errors,
+background scrub, quarantine, and audit-driven re-seed.
+
+Pinned here:
+  - every on-disk failure mode (zero-length, bad magic, truncated,
+    bit-flipped) surfaces as a typed CorruptionError — never a raw
+    struct.error or JSONDecodeError — at open AND mid-read;
+  - legacy pre-checksum headers stay readable (upgrade compatibility);
+  - engine scrub reports findings without acting, keeps chaos-injected
+    scrub faults (`scrub.verify`) out of the findings list, and never
+    touches the lane guards' breakers;
+  - the full onebox drill: corrupt one replica's SST on disk ->
+    scrub detects -> replica quarantined (forensics dir + QUARANTINED
+    beacon) -> meta re-seeds -> zero wrong reads throughout;
+  - the collector auto-healer's interlocks: off by default, acts only
+    on a critical verdict whose audit evidence isolates EXACTLY ONE odd
+    replica, rate-limited — plus the end-to-end audit-driven heal.
+"""
+
+import glob
+import json
+import os
+import struct
+import time
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.base.value_schema import SCHEMAS
+from pegasus_tpu.collector.auto_heal import AUTO_HEALER, AutoHealer
+from pegasus_tpu.collector.cluster_doctor import (run_cluster_audit,
+                                                  run_cluster_doctor)
+from pegasus_tpu.engine import EngineOptions, LsmEngine
+from pegasus_tpu.engine.sstable import (MAGIC, CorruptionError, read_sst,
+                                        verify_sst)
+from pegasus_tpu.meta import messages as mm
+from pegasus_tpu.meta.meta_server import RPC_CM_QUERY_CONFIG
+from pegasus_tpu.runtime import fail_points as fp
+from pegasus_tpu.runtime.lane_guard import LANE_GUARD, READ_LANE_GUARD
+from pegasus_tpu.runtime.perf_counters import counters
+
+from tests.test_satellites import MiniCluster
+
+
+def enc(payload: bytes, expire: int = 0) -> bytes:
+    return SCHEMAS[2].generate_value(expire, 0, payload)
+
+
+def make_filled_engine(path, n=60):
+    eng = LsmEngine(str(path), EngineOptions(backend="cpu"))
+    keys = []
+    for i in range(n):
+        k = generate_key(b"hk%d" % (i % 5), b"sk%04d" % i)
+        eng.put(k, enc(b"val%d" % i))
+        keys.append((k, enc(b"val%d" % i)))
+    eng.flush()
+    return eng, keys
+
+
+def ssts_in(path) -> list:
+    return sorted(glob.glob(os.path.join(str(path), "*.sst")),
+                  key=os.path.getmtime)
+
+
+def flip_tail(path: str, nbytes: int = 8) -> None:
+    """Corrupt the end of the payload (the last section's bytes) so the
+    header still parses and the finding is a crc mismatch, like real rot."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - nbytes)
+        tail = f.read(nbytes)
+        f.seek(size - nbytes)
+        f.write(bytes(b ^ 0xFF for b in tail))
+
+
+def strip_crcs(path: str) -> None:
+    """Rewrite the header WITHOUT crc32 keys — the on-disk shape every
+    pre-checksum SST in an upgraded cluster still has."""
+    with open(path, "rb") as f:
+        assert f.read(len(MAGIC)) == MAGIC
+        (hlen,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(hlen))
+        payload = f.read()
+    for sec in header["sections"].values():
+        sec.pop("crc32", None)
+    raw = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(raw)))
+        f.write(raw)
+        f.write(payload)
+
+
+# ------------------------------------------------- typed corruption matrix
+
+
+def test_corruption_matrix_at_open(tmp_path):
+    """Every open-time failure mode is a typed CorruptionError carrying
+    path + detail — never a raw struct.error / JSONDecodeError."""
+    eng, _ = make_filled_engine(tmp_path / "db")
+    eng.close()
+    good = ssts_in(tmp_path / "db")[-1]
+
+    cases = {}
+    z = tmp_path / "zero.sst"
+    z.write_bytes(b"")
+    cases["zero-length"] = str(z)
+    m = tmp_path / "magic.sst"
+    m.write_bytes(b"NOTANSST" + b"\x00" * 64)
+    cases["bad-magic"] = str(m)
+    th = tmp_path / "trunc_hdr.sst"
+    th.write_bytes(MAGIC + struct.pack("<I", 4096) + b"{\"n\": 1")
+    cases["truncated-header"] = str(th)
+    uh = tmp_path / "unparseable.sst"
+    uh.write_bytes(MAGIC + struct.pack("<I", 8) + b"not json")
+    cases["unparseable-header"] = str(uh)
+    ts = tmp_path / "trunc_sec.sst"
+    raw = open(good, "rb").read()
+    ts.write_bytes(raw[: len(raw) - len(raw) // 4])
+    cases["truncated-section"] = str(ts)
+    fl = tmp_path / "flipped.sst"
+    fl.write_bytes(raw)
+    flip_tail(str(fl))
+    cases["bit-flip"] = str(fl)
+
+    for name, path in cases.items():
+        with pytest.raises(CorruptionError) as ei:
+            verify_sst(path)
+        assert ei.value.path == path, name
+        assert ei.value.detail, name
+        with pytest.raises(CorruptionError):
+            read_sst(path)
+    with pytest.raises(CorruptionError) as ei:
+        verify_sst(cases["bit-flip"])
+    assert "crc32 mismatch" in ei.value.detail
+
+
+def test_corruption_mid_read_is_typed_and_hooked(tmp_path):
+    """Corruption that lands AFTER open (header cached, block not yet
+    materialized): the serving read raises the typed error and fires the
+    engine's corruption hook exactly as the stub's quarantine path needs."""
+    eng, keys = make_filled_engine(tmp_path / "db")
+    eng.close()
+    flip_tail(ssts_in(tmp_path / "db")[-1])
+
+    eng2 = LsmEngine(str(tmp_path / "db"), EngineOptions(backend="cpu"))
+    seen = []
+    eng2.corruption_hook = seen.append
+    before = counters.rate("engine.corruption_count").total()
+    with pytest.raises(CorruptionError):
+        eng2.get(keys[0][0], now=10)
+    assert seen and isinstance(seen[0], CorruptionError)
+    assert counters.rate("engine.corruption_count").total() > before
+    eng2.close()
+
+
+def test_legacy_header_without_crc_stays_readable(tmp_path):
+    """Upgrade pin: SSTs written before per-section checksums carry no
+    crc32 keys — they read and verify structurally, unchecked."""
+    eng, keys = make_filled_engine(tmp_path / "db", n=20)
+    eng.close()
+    sst = ssts_in(tmp_path / "db")[0]
+    block0, _ = read_sst(sst)
+    strip_crcs(sst)
+    block1, header = read_sst(sst)
+    assert all("crc32" not in s for s in header["sections"].values())
+    assert block1.n == block0.n
+    assert verify_sst(sst) > 0
+    # and the engine itself reopens + serves the legacy file
+    eng2 = LsmEngine(str(tmp_path / "db"), EngineOptions(backend="cpu"))
+    assert eng2.get(keys[0][0], now=10) == keys[0][1]
+    eng2.close()
+
+
+# ----------------------------------------------------------- engine scrub
+
+
+def test_scrub_clean_then_finds_corruption(tmp_path):
+    eng, _ = make_filled_engine(tmp_path / "db")
+    try:
+        res = eng.scrub()
+        assert res["files"] >= 1 and res["bytes"] > 0
+        assert res["findings"] == [] and res["errors"] == []
+        victim = ssts_in(tmp_path / "db")[-1]
+        flip_tail(victim)
+        res = eng.scrub()
+        assert any(f["path"] == victim and "crc32 mismatch" in f["detail"]
+                   for f in res["findings"]), res
+    finally:
+        eng.close()
+
+
+def test_scrub_failpoint_is_an_error_not_a_finding(tmp_path):
+    """Chaos interlock: an injected `scrub.verify` fault means the file
+    was NOT verified — it must land in `errors` (retry next cadence),
+    never in `findings` (a finding quarantines the healthy replica)."""
+    eng, _ = make_filled_engine(tmp_path / "db")
+    fp.setup()
+    try:
+        fp.cfg("scrub.verify", "raise(chaos)")
+        res = eng.scrub()
+        assert res["findings"] == []
+        assert res["errors"] and all("chaos" in e["detail"]
+                                     for e in res["errors"])
+        fp.cfg("scrub.verify", "off()")
+        res = eng.scrub()
+        assert res["errors"] == [] and res["findings"] == []
+    finally:
+        fp.teardown()
+        eng.close()
+
+
+# ----------------------------------------------------- onebox heal drills
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniCluster(tmp_path)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def failpoints():
+    fp.setup()
+    yield fp
+    fp.teardown()
+
+
+def _members(cluster, app_name, pidx):
+    cfg = cluster.ddl(RPC_CM_QUERY_CONFIG, mm.QueryConfigRequest(app_name),
+                      mm.QueryConfigResponse)
+    pc = cfg.partitions[pidx]
+    return cfg.app.app_id, pc.primary, list(pc.secondaries)
+
+
+def _drive_heal(cluster, stub, gpid, app_name, pidx, deadline_s=60.0):
+    """Meta repair loop (what the MetaApp FD tick does in production):
+    reconfigure around the quarantined copy, re-seed, wait until the
+    partition is back to 3 members and the forensics record is acked."""
+    app_id = int(gpid.partition(".")[0])
+    stubs = {s.address: s for s in cluster.stubs}
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        cluster.meta.repair_quarantined()
+        cluster.meta.repair_under_replication()
+        with stub._lock:
+            acked = gpid not in stub._quarantined
+        _, primary, secondaries = _members(cluster, app_name, pidx)
+        members = [primary] + secondaries if primary else []
+        hosting = all(
+            (app_id, pidx) in stubs[n]._replicas
+            for n in members if n in stubs)
+        if acked and primary and len(secondaries) == 2 and hosting:
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def test_onebox_corruption_drill(cluster):
+    """The tier-1 acceptance drill: flip bytes in one replica's live SST
+    -> scrub detects -> quarantine (typed refusal + forensics dir +
+    QUARANTINED beacon) -> meta re-seeds -> every row reads back right.
+    Lane-guard breakers stay untouched end to end."""
+    trips0 = (LANE_GUARD.breaker_trip_count,
+              READ_LANE_GUARD.breaker_trip_count)
+    cli = cluster.create("drill", partitions=2)
+    rows = {}
+    for i in range(48):
+        hk, sk, v = b"dk%02d" % i, b"s", b"dv%d" % i
+        cli.set(hk, sk, v)
+        rows[(hk, sk)] = v
+
+    stub = reps = None
+    for s in cluster.stubs:
+        with s._lock:
+            reps = dict(s._replicas)
+        if reps:
+            stub = s
+            break
+    assert stub is not None
+    (app_id, pidx), rep = sorted(reps.items())[0]
+    gpid = f"{app_id}.{pidx}"
+    rep.server.engine.flush()
+    ssts = sorted(glob.glob(os.path.join(rep.path, "data", "*.sst")),
+                  key=os.path.getmtime)
+    assert ssts, "flush landed no SST to corrupt"
+    flip_tail(ssts[-1])
+
+    out = json.loads(stub._cmd_scrub_replica([gpid]))
+    assert out[gpid]["quarantined"] is True
+    assert any("crc32 mismatch" in f["detail"]
+               for f in out[gpid]["findings"]), out
+    with stub._lock:
+        assert gpid in stub._quarantined
+        assert stub._quarantined[gpid]["source"] == "scrub"
+    qroot = os.path.join(stub.root, "quarantine")
+    assert any(d.startswith(gpid + ".") for d in os.listdir(qroot)), \
+        "quarantined data dir not retained for forensics"
+
+    # mid-window reads must be right or a typed error — never garbage
+    for (hk, sk), v in list(rows.items())[:8]:
+        try:
+            got = cli.get(hk, sk)
+        except Exception:
+            continue
+        assert got == v
+
+    assert _drive_heal(cluster, stub, gpid, "drill", pidx), \
+        "quarantined replica was not re-seeded in time"
+    for (hk, sk), v in rows.items():
+        assert cli.get(hk, sk) == v, "wrong read after heal"
+    assert (LANE_GUARD.breaker_trip_count,
+            READ_LANE_GUARD.breaker_trip_count) == trips0, \
+        "integrity plane must never touch the lane breakers"
+    cli.close()
+
+
+# --------------------------------------------------- auto-heal interlocks
+
+
+class _FakeCaller:
+    def __init__(self):
+        self.calls = []
+
+    def remote_command(self, node, cmd, args):
+        self.calls.append((node, cmd, list(args)))
+        return "{}"
+
+
+def _verdict(mismatches, verdict="critical"):
+    return {"verdict": verdict,
+            "evidence": {"audit": {"mismatches": mismatches}}}
+
+
+def test_autoheal_interlocks(monkeypatch):
+    m = {"gpid": "2.1", "node": "n1:1", "decree": 7,
+         "digest": "a" * 32, "expected": "b" * 32}
+
+    # gated off by default: no env, no action
+    monkeypatch.delenv("PEGASUS_AUTOHEAL", raising=False)
+    h, c = AutoHealer(), _FakeCaller()
+    assert h.observe_verdict(_verdict([m]), c) == [] and not c.calls
+
+    monkeypatch.setenv("PEGASUS_AUTOHEAL", "1")
+    # exactly one odd replica -> targeted quarantine
+    h, c = AutoHealer(), _FakeCaller()
+    assert h.observe_verdict(_verdict([m]), c) == \
+        [{"gpid": "2.1", "node": "n1:1"}]
+    assert c.calls == [("n1:1", "quarantine-replica",
+                        ["2.1", c.calls[0][2][1]])]
+    assert "decree 7" in c.calls[0][2][1]
+
+    # two replicas disagreeing -> the reference is suspect: veto
+    h, c = AutoHealer(), _FakeCaller()
+    assert h.observe_verdict(
+        _verdict([m, dict(m, node="n2:1")]), c) == []
+    assert not c.calls
+
+    # non-critical verdicts never act, whatever the evidence says
+    h, c = AutoHealer(), _FakeCaller()
+    assert h.observe_verdict(_verdict([m], "inconclusive"), c) == []
+    assert h.observe_verdict(_verdict([m], "degraded"), c) == []
+    assert not c.calls
+
+    # process-wide rate limit: one quarantine per window
+    monkeypatch.setenv("PEGASUS_AUTOHEAL_MIN_INTERVAL_S", "3600")
+    h, c = AutoHealer(), _FakeCaller()
+    assert len(h.observe_verdict(_verdict([m]), c)) == 1
+    assert h.observe_verdict(_verdict([dict(m, gpid="2.0")]), c) == []
+    assert len(c.calls) == 1
+
+
+def test_autoheal_end_to_end(cluster, failpoints, monkeypatch):
+    """Audit-driven heal: the `audit.digest` fail point rots exactly one
+    secondary's digest -> doctor critical -> auto-healer quarantines THAT
+    replica -> meta re-seeds -> re-audit conclusive and mismatch-free."""
+    monkeypatch.setenv("PEGASUS_AUTOHEAL", "1")
+    cli = cluster.create("ahl", partitions=2)
+    rows = {}
+    for i in range(40):
+        hk, v = b"ak%02d" % i, b"av%d" % i
+        cli.set(hk, b"s", v)
+        rows[hk] = v
+    app_id, _, secondaries = _members(cluster, "ahl", 0)
+    victim = secondaries[0]
+    gpid = f"{app_id}.0"
+
+    failpoints.cfg("audit.digest", f"return({victim}@{gpid})")
+    report = run_cluster_audit([cluster.meta_addr], wait_s=20.0)
+    assert len(report["mismatches"]) == 1
+    time.sleep(0.6)  # corrupted digest rides the next beacons
+    counters.number("compact.lane.breaker_open").set(0)
+    counters.number("read.lane.breaker_open").set(0)
+    counters.number("rpc.server.dispatch_queue_depth").set(0)
+    with AUTO_HEALER._lock:
+        AUTO_HEALER._last_action = None  # earlier tests must not rate-limit
+    verdict = run_cluster_doctor([cluster.meta_addr])
+    assert verdict["verdict"] == "critical"
+    assert verdict.get("autoheal") == [{"gpid": gpid, "node": victim}], \
+        verdict.get("autoheal")
+    stub = next(s for s in cluster.stubs if s.address == victim)
+    with stub._lock:
+        assert gpid in stub._quarantined
+        assert stub._quarantined[gpid]["source"] == "command"
+
+    failpoints.cfg("audit.digest", "off()")
+    assert _drive_heal(cluster, stub, gpid, "ahl", 0), \
+        "auto-quarantined replica was not re-seeded in time"
+    # the re-seeded secondary may still be applying its backlog for a
+    # beat — the equal-decree rule keeps it pending (inconclusive), never
+    # a false mismatch; retry until the audit is conclusive
+    for _ in range(6):
+        report = run_cluster_audit([cluster.meta_addr], wait_s=20.0)
+        assert report["mismatches"] == []
+        if gpid in report["ok"]:
+            break
+        time.sleep(1.0)
+    assert gpid in report["ok"], report
+    for hk, v in rows.items():
+        assert cli.get(hk, b"s") == v
+    cli.close()
+
+
+def test_scrub_tick_rotates_under_short_cadence():
+    """A scrub cadence SHORTER than the maintenance interval leaves every
+    replica past due at every tick; selection must still rotate through
+    all of them (oldest-first), not re-scrub dict-order-first forever."""
+    import threading
+    import types
+
+    from pegasus_tpu.replication.replica_stub import ReplicaStub
+
+    class _Rep:
+        def __init__(self, app_id, pidx):
+            self.app_id, self.pidx = app_id, pidx
+
+    reps = [_Rep(1, i) for i in range(4)]
+    fake = types.SimpleNamespace(
+        _lock=threading.Lock(),
+        _replicas={(r.app_id, r.pidx): r for r in reps},
+        _last_scrub={},
+        _scrub_interval=0.001,  # << the tick spacing: always past due
+        scrubbed=[],
+    )
+    fake._scrub_replica = lambda rep: fake.scrubbed.append(
+        (rep.app_id, rep.pidx))
+    tick = types.MethodType(ReplicaStub._scrub_tick, fake)
+    for _ in range(8):
+        time.sleep(0.002)
+        tick(reps)
+    # two full rotations: every replica scrubbed exactly twice, in order
+    assert fake.scrubbed == [(1, 0), (1, 1), (1, 2), (1, 3)] * 2

@@ -628,3 +628,59 @@ def test_metric_lint_reverse_pass_flags_stale_rows(monkeypatch):
                         lambda: rows + ["ghost.deleted_counter_qps"])
     errs = cm.run_lint()
     assert any("ghost.deleted_counter_qps" in e for e in errs)
+
+
+def test_fsck_clean_corrupt_and_orphan(tmp_path, capsys):
+    """tools/fsck.py (ISSUE 17): the offline half of the integrity plane.
+    Clean dir -> exit 0; a bit-flipped SST -> exit 1 with a typed
+    `corrupt` finding; an orphan SST alone stays exit 0 (info, not rot);
+    a MANIFEST reference to a missing file -> exit 1."""
+    import glob
+    import os
+    import shutil
+
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import SCHEMAS
+    from pegasus_tpu.engine import EngineOptions, LsmEngine
+    from tools.fsck import main as fsck_main
+
+    d = str(tmp_path / "db")
+    eng = LsmEngine(d, EngineOptions(backend="cpu"))
+    for i in range(30):
+        eng.put(generate_key(b"hk", b"sk%03d" % i),
+                SCHEMAS[2].generate_value(0, 0, b"v%d" % i))
+    eng.flush()
+    eng.close()
+
+    assert fsck_main([d]) == 0
+    capsys.readouterr()
+
+    ssts = sorted(glob.glob(os.path.join(d, "*.sst")))
+    assert ssts
+    # orphan: an unreferenced copy is waste, not rot -> still exit 0
+    shutil.copy(ssts[0], os.path.join(d, "999999.sst"))
+    assert fsck_main([d, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert any(f["kind"] == "orphan" and f["severity"] == "info"
+               for f in out["findings"])
+
+    # bit-flip -> error finding, exit 1, machine-readable shape
+    size = os.path.getsize(ssts[0])
+    with open(ssts[0], "r+b") as f:
+        f.seek(size - 8)
+        tail = f.read(8)
+        f.seek(size - 8)
+        f.write(bytes(b ^ 0xFF for b in tail))
+    assert fsck_main([d, "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["errors"] >= 1
+    assert any(f["kind"] == "corrupt" and f["path"] == ssts[0]
+               for f in out["findings"])
+
+    # walk mode: the node root finds the data dir below it; a missing
+    # manifest reference is an error too
+    os.remove(ssts[0])
+    assert fsck_main([str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "manifest_missing" in err
+    assert fsck_main(["/nonexistent/fsck/root"]) == 1

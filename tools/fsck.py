@@ -1,0 +1,129 @@
+"""`python -m tools.fsck <dir>...` — offline data-dir integrity check.
+
+The cold half of the self-healing plane (ISSUE 17): the background scrub
+re-verifies LIVE replicas; this walks data dirs on disk with the engine
+stopped — post-incident forensics, pre-restart sanity, and the
+pressure_test harness's final quiesced sweep over every surviving
+replica.
+
+Each argument is either one engine data dir (contains a ``MANIFEST``) or
+a replica/node root to walk recursively for data dirs. For every data
+dir it verifies:
+
+* every ``*.sst``'s magic, header parse, and per-section crc32
+  (truncated / zero-length / bit-flipped files are typed findings, via
+  the same ``verify_sst`` the scrub uses — legacy headers without
+  checksums pass structurally, exactly like the read path);
+* every MANIFEST-referenced file exists (``manifest_missing``);
+* every on-disk SST is MANIFEST-referenced (``orphan`` — INFO only: the
+  engine adopts or ignores orphans at open, they are waste, not rot).
+
+Exit 0 when no error-level findings (orphans alone stay exit 0);
+``--json`` prints the machine-readable findings list on stdout.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _is_data_dir(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "MANIFEST")) \
+        or bool(glob.glob(os.path.join(path, "*.sst")))
+
+
+def find_data_dirs(root: str) -> list:
+    """`root` itself if it is a data dir, else every data dir below it."""
+    if _is_data_dir(root):
+        return [root]
+    out = []
+    for dirpath, dirnames, _ in os.walk(root):
+        # a quarantined tree is forensics: already known-bad, skip it
+        dirnames[:] = [d for d in dirnames if d != "quarantine"]
+        if _is_data_dir(dirpath):
+            out.append(dirpath)
+            dirnames[:] = []
+    return sorted(out)
+
+
+def fsck_data_dir(path: str) -> list:
+    """-> findings: [{"dir", "kind", "path", "detail", "severity"}].
+
+    kinds: ``corrupt`` (bad magic / truncated / crc mismatch, error),
+    ``manifest`` (unreadable MANIFEST, error), ``manifest_missing``
+    (referenced file absent, error), ``orphan`` (unreferenced SST,
+    info)."""
+    from pegasus_tpu.engine.sstable import CorruptionError, verify_sst
+
+    findings = []
+
+    def add(kind, p, detail, severity="error"):
+        findings.append({"dir": path, "kind": kind, "path": p,
+                         "detail": detail, "severity": severity})
+
+    referenced = set()
+    mpath = os.path.join(path, "MANIFEST")
+    if os.path.isfile(mpath):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            names = list(manifest.get("l0", []))
+            for files in manifest.get("levels", {}).values():
+                names.extend(files)
+            for name in names:
+                referenced.add(name)
+                if not os.path.isfile(os.path.join(path, name)):
+                    add("manifest_missing", os.path.join(path, name),
+                        "MANIFEST references a file that does not exist")
+        except (ValueError, KeyError, TypeError, OSError, AttributeError) as e:
+            add("manifest", mpath, f"unreadable MANIFEST: {e!r}")
+    for sst in sorted(glob.glob(os.path.join(path, "*.sst"))):
+        try:
+            verify_sst(sst)
+        except CorruptionError as e:
+            add("corrupt", sst, e.detail)
+        except OSError as e:
+            add("corrupt", sst, f"unreadable: {e!r}")
+        if os.path.basename(sst) not in referenced:
+            add("orphan", sst, "SST not referenced by MANIFEST "
+                "(engine-open adopts or ignores it — waste, not rot)",
+                severity="info")
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.fsck",
+        description="offline SST/manifest integrity check")
+    ap.add_argument("roots", nargs="+",
+                    help="engine data dir(s) or replica/node root(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+    findings, dirs = [], []
+    for root in args.roots:
+        if not os.path.exists(root):
+            findings.append({"dir": root, "kind": "missing", "path": root,
+                             "detail": "no such directory",
+                             "severity": "error"})
+            continue
+        for d in find_data_dirs(root):
+            dirs.append(d)
+            findings.extend(fsck_data_dir(d))
+    errors = [f for f in findings if f["severity"] == "error"]
+    if args.json:
+        print(json.dumps({"dirs": dirs, "findings": findings,
+                          "errors": len(errors)}, indent=2))
+    else:
+        for f in findings:
+            print(f"fsck: [{f['severity']}] {f['kind']} {f['path']}: "
+                  f"{f['detail']}", file=sys.stderr)
+        print(f"fsck: {'FAIL' if errors else 'OK'} — {len(dirs)} data "
+              f"dir(s), {len(findings)} finding(s), {len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

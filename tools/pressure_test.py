@@ -98,7 +98,8 @@ def _parse_args(argv=None):
     ap.add_argument("--read-pct", type=int, default=50)
     ap.add_argument("--key-space", type=int, default=100_000)
     ap.add_argument("--scenario", default="none",
-                    choices=["none", "smoke", "full", "offload"],
+                    choices=["none", "smoke", "full", "offload",
+                             "corruption"],
                     help="scripted chaos schedule to run under the load "
                          "(pegasus_tpu.chaos): smoke = group-worker kill + "
                          "remote fail-point wedge; full = + node "
@@ -107,7 +108,10 @@ def _parse_args(argv=None):
                          "with cross-cluster digest compare; offload = "
                          "compaction-offload wire wedge + mid-merge "
                          "service kill against a harness-wired offload "
-                         "service with every partition placed onto it")
+                         "service with every partition placed onto it; "
+                         "corruption = scrub.verify fail-point chaos + a "
+                         "byte-flipped live SST that must detect → "
+                         "quarantine → re-seed with zero wrong reads")
     ap.add_argument("--offload-kill-every", type=float, default=15.0,
                     help="--scenario offload: repeat the mid-merge service "
                          "kill on this period for the whole run (ROADMAP "
@@ -152,7 +156,12 @@ def _build_harness(args, journal):
     try:
         if args.scenario == "full":
             dst = Onebox(args.table, partitions=8, n_nodes=3, cluster_id=2)
-        box = Onebox(args.table, partitions=8, n_nodes=3, serve_groups=2,
+        # corruption leg (ISSUE 17) serves through PLAIN stubs: the
+        # disk-corrupt actor byte-flips a live SST through the node's
+        # in-process handle, and group workers are separate processes
+        groups = 0 if args.scenario == "corruption" else 2
+        box = Onebox(args.table, partitions=8, n_nodes=3,
+                     serve_groups=groups,
                      remote_clusters={"chaos-dst": [dst.meta_addr]} if dst
                      else None, cluster_id=1)
         if dst is not None:
@@ -188,6 +197,9 @@ def _build_harness(args, journal):
                                        caller=caller),
         sc.A_SCHED: act.SchedFlipActor(caller, box.cluster, args.table),
     }
+    if args.scenario == "corruption":
+        actors[sc.A_DISK_CORRUPT] = act.DiskCorruptActor(
+            box.cluster, node_index=0, caller=caller)
     if args.scenario == "offload":
         # rack-scale offload leg (ISSUE 14): one cpu-backend compaction
         # service for the whole onebox rack, every partition placed onto
@@ -455,11 +467,12 @@ def run_pressure(argv=None) -> int:
                                  every_s=args.audit_every,
                                  wait_s=min(5.0, args.audit_every),
                                  journal=journal).start()
-        elif args.scenario == "offload":
-            # the offload soak ALWAYS concludes with one quiesced audit
-            # round, even under --audit-every 0 (ISSUE 16 satellite): a
-            # run that survived N service kills but never proved the
-            # digests match proved nothing. The huge cadence parks the
+        elif args.scenario in ("offload", "corruption"):
+            # these legs ALWAYS conclude with one quiesced audit round,
+            # even under --audit-every 0: a run that survived the faults
+            # but never proved the digests match proved nothing — for
+            # the corruption leg the conclusive mismatch-free round IS
+            # the zero-wrong-reads claim. The huge cadence parks the
             # loop on its stop event; stop(final_round=True) below runs
             # the single post-quiesce round.
             audits = AuditRounds([meta_addr], apps=[args.table],
@@ -552,6 +565,35 @@ def run_pressure(argv=None) -> int:
             if doctor["verdict"] != "healthy":
                 journal.fail("doctor.unhealthy", verdict=doctor["verdict"],
                              causes=[c["cause"] for c in doctor["causes"]])
+
+        # final quiesced fsck sweep (ISSUE 17): every surviving replica's
+        # on-disk state must verify clean — a corruption the run's audits
+        # missed (or one planted and never healed) fails the run here.
+        # Engines are still live (background compaction can land files
+        # between the walk and the verify), so transient error sets get
+        # one re-check before they count.
+        if box is not None:
+            from tools.fsck import find_data_dirs, fsck_data_dir
+
+            fsck_errors, ndirs = [], 0
+            for attempt in range(2):
+                fsck_errors, ndirs = [], 0
+                for stub in list(box.cluster.stubs):
+                    for d in find_data_dirs(stub.root):
+                        ndirs += 1
+                        fsck_errors.extend(
+                            f for f in fsck_data_dir(d)
+                            if f["severity"] == "error"
+                            and os.path.exists(f["path"]))
+                if not fsck_errors:
+                    break
+                time.sleep(2.0)
+            journal.record("fsck.final", dirs=ndirs,
+                           errors=len(fsck_errors))
+            if fsck_errors:
+                journal.fail("fsck.corruption", count=len(fsck_errors),
+                             first=f"{fsck_errors[0]['path']}: "
+                                   f"{fsck_errors[0]['detail']}")
 
         if stats["verify_failures"]:
             journal.fail("verify.lost_acked_writes",
