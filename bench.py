@@ -633,34 +633,43 @@ def _max_quantiles(dicts):
 
 
 def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
-                       read_frac: float = 0.5, during=None):
+                       read_frac: float = 0.5, during=None,
+                       tables=("ycsb",)):
     """Shared YCSB workload driver: load `records`, run the read/update
     mix (`read_frac` reads) from `n_threads` clients. -> stats dict (the
     sweep mode reruns this once per group count). `during`, when given,
     runs on its own thread WHILE the workers hammer the cluster (the
     consistency audit rides here: digests must match under concurrent
-    load, not just at rest); its return value lands in stats["during"]."""
+    load, not just at rest); its return value lands in stats["during"].
+    With multiple `tables` the record budget splits evenly and each
+    worker thread pins one table (tid % len(tables)) — the multi-tenant
+    shape the per-table ledger breakdown attributes."""
     from pegasus_tpu.client import MetaResolver, PegasusClient
     from pegasus_tpu.runtime.perf_counters import counters
     from pegasus_tpu.runtime.tasking import spawn_thread
 
-    load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+    tables = tuple(tables) or ("ycsb",)
+    per_records = records if len(tables) == 1 else max(1,
+                                                      records // len(tables))
     t0 = time.perf_counter()
-    for i in range(records):
-        load_cli.set(b"user%012d" % i, b"f0", value)
+    for table in tables:
+        load_cli = PegasusClient(MetaResolver([box.meta_addr], table))
+        for i in range(per_records):
+            load_cli.set(b"user%012d" % i, b"f0", value)
+        load_cli.close()
     load_s = time.perf_counter() - t0
-    load_cli.close()
 
     errors = [0]
     read_lat = counters.percentile("bench.ycsb.read_latency_us")
     update_lat = counters.percentile("bench.ycsb.update_latency_us")
-    zipf = ZipfKeys(records)
+    zipf = ZipfKeys(per_records)
 
     def worker(tid):
         import random
 
         rng = random.Random(tid)
-        cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+        cli = PegasusClient(MetaResolver([box.meta_addr],
+                                         tables[tid % len(tables)]))
         for _ in range(n_ops // n_threads):
             k = b"user%012d" % zipf.pick(rng)
             s = time.perf_counter()
@@ -700,13 +709,42 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
         "ops_s": round(done_ops / run_s, 1),
         "run_s": round(run_s, 2),
         "load_s": round(load_s, 2),
-        "load_ops_s": round(records / max(load_s, 1e-9), 1),
+        "load_ops_s": round(per_records * len(tables) / max(load_s, 1e-9), 1),
         "errors": errors[0],
         "client_latency_us": {
             "read": read_lat.percentiles(),
             "update": update_lat.percentiles(),
         },
     }
+
+
+def _ycsb_table_breakdown(meta_addr):
+    """Per-table capacity attribution for the run (ISSUE 18): fold every
+    node's `table-stats` ledger fragments into cluster-wide per-table
+    series + the top-k ranking — the same merge the collector performs,
+    driven here through the public remote-command surface so the bench
+    exercises the wire path, not process-local state."""
+    from pegasus_tpu.collector.cluster_doctor import ClusterCaller
+    from pegasus_tpu.runtime.table_stats import fold_snapshots, top_k
+
+    caller = ClusterCaller([meta_addr])
+    try:
+        state = caller.meta_state() or {}
+        frags = []
+        for addr, node in sorted((state.get("nodes") or {}).items()):
+            if not node.get("alive", False):
+                continue
+            try:
+                reply = json.loads(caller.remote_command(
+                    addr, "table-stats", []))
+            except Exception:  # noqa: BLE001 - attribution is best-effort
+                continue
+            if isinstance(reply, dict):
+                frags.extend(v for v in reply.values() if isinstance(v, dict))
+        folded = fold_snapshots(frags)
+        return {"tables": folded, "top": top_k(folded)}
+    finally:
+        caller.close()
 
 
 def _ycsb_group_sweep(groups_list):
@@ -802,8 +840,12 @@ def ycsb_main():
     host_start = _host_info()
     proc_t0 = time.process_time()
     mix, read_frac = _ycsb_mix()
+    n_tables = max(1, int(os.environ.get("PEGASUS_BENCH_YCSB_TABLES", "1")))
+    ycsb_tables = ["ycsb"] + [f"ycsb{i}" for i in range(2, n_tables + 1)]
     box = Onebox("ycsb", partitions=partitions)
     try:
+        for extra in ycsb_tables[1:]:
+            box.cluster.create(extra, partitions=partitions).close()
         value = os.urandom(value_size)
 
         def audit_under_load():
@@ -815,12 +857,13 @@ def ycsb_main():
             from pegasus_tpu.collector.cluster_doctor import \
                 run_cluster_audit
 
-            return run_cluster_audit([box.meta_addr], apps=["ycsb"],
+            return run_cluster_audit([box.meta_addr], apps=ycsb_tables,
                                      wait_s=20.0)
 
         stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
                                    read_frac=read_frac,
-                                   during=audit_under_load)
+                                   during=audit_under_load,
+                                   tables=ycsb_tables)
         audit = stats.pop("during") or {}
         audit.pop("digests", None)  # per-node digests: bulky, summarized
         # zero mismatches is only a PASS when the audit actually compared
@@ -937,6 +980,10 @@ def ycsb_main():
                 "host": {"start": host_start, "end": _host_info()},
             },
         }
+        if n_tables > 1:
+            # multi-tenant breakdown (ISSUE 18): which table consumed the
+            # run's capacity, folded from the nodes' per-table ledgers
+            result["detail"]["tables"] = _ycsb_table_breakdown(box.meta_addr)
     finally:
         box.stop()
     if audit.get("mismatches"):

@@ -506,6 +506,7 @@ def run_cluster_doctor(meta_addrs, pool: ConnectionPool = None,
         _check_lag(state, causes, evidence)
         _check_audit(state, causes, evidence)
         _check_quarantine(state, causes, evidence)
+        _check_slo(causes, evidence)
         if scrape:
             _scrape_nodes(caller, state, causes, evidence, slow_last)
         verdict = CRITICAL if any(c["severity"] == CRITICAL
@@ -734,6 +735,33 @@ def _check_quarantine(state, causes, evidence) -> None:
                                 f"quarantined ({q['source']}: "
                                 f"{q['reason'] or 'corruption'})",
                        "evidence": "quarantine"})
+
+
+def _check_slo(causes, evidence) -> None:
+    """Tenant SLO verdicts (ISSUE 18): a table whose multi-window burn
+    rate says `burning` is a degraded cause NAMING the table — the
+    first doctor signal keyed on what users see (a tenant), not on a
+    node or partition. The verdicts are the ones the in-process
+    evaluator (collector.evaluate_slos) computed last round; a process
+    that never evaluates SLOs contributes nothing here."""
+    from .info_collector import latest_slo
+
+    verdicts = latest_slo()
+    if not verdicts:
+        return
+    evidence["slo"] = verdicts
+    for table in sorted(verdicts):
+        v = verdicts[table]
+        if v.get("verdict") != "burning":
+            continue
+        causes.append({
+            "severity": DEGRADED,
+            "cause": f"table {table} SLO burning "
+                     f"(fast_burn={v.get('fast_burn')} "
+                     f"slow_burn={v.get('slow_burn')} "
+                     f"latency_burn={v.get('latency_burn')} "
+                     f"errors_fast={v.get('errors_fast')})",
+            "evidence": "slo"})
 
 
 def _scrape_nodes(caller, state, causes, evidence, slow_last) -> None:

@@ -157,6 +157,30 @@ class FlightRecorder:
             "jobs": JOB_TRACER.window(window_s),
             "errors": errors,
         }
+        # tenant plane (ISSUE 18): when a table is burning its SLO, the
+        # artifact embeds that table's in-window series (the ledger's
+        # table.<name>.* charges plus the evaluator's slo.<name>.* burn
+        # gauges from the local history ring) — the incident names the
+        # tenant AND carries the numbers that convicted it
+        try:
+            from ..runtime.metric_history import HISTORY
+            from .info_collector import latest_slo
+
+            slo_tables = {}
+            for table, v in latest_slo().items():
+                if v.get("verdict") != "burning":
+                    continue
+                slo_tables[table] = {
+                    "verdict": v,
+                    "series": HISTORY.window(seconds=window_s,
+                                             prefix=f"table.{table}."),
+                    "slo_series": HISTORY.window(seconds=window_s,
+                                                 prefix=f"slo.{table}."),
+                }
+            if slo_tables:
+                incident["slo_tables"] = slo_tables
+        except Exception as e:  # noqa: BLE001 - embed is best-effort
+            errors.append(f"slo_tables: {e!r}")
         incident["path"] = self._retain(incident)
         self._c_capture.increment()
         events.emit("incident.captured", severity="warn", id=incident_id,

@@ -14,7 +14,9 @@ verdict republished as `collector.app.<name>.hotkey.*` counters (the
 closed hotspot loop).
 """
 
+import configparser
 import json
+import os
 import threading
 import time
 
@@ -26,6 +28,55 @@ from ..runtime import events, lockrank
 from ..runtime.perf_counters import counters
 from ..runtime.remote_command import RemoteCommandRequest, RemoteCommandResponse
 from ..runtime.tasking import spawn_thread
+
+
+# most recent per-table SLO verdicts computed IN THIS PROCESS (the
+# collector is the evaluator; every other node's slo-status answers {}).
+# Rebound wholesale by evaluate_slos — lock-free readers (the slo-status
+# remote command, the doctor's _check_slo) always see a stable dict.
+_SLO_LATEST = {}
+
+
+def latest_slo() -> dict:
+    """Per-table SLO verdicts from the last evaluate_slos() round in
+    this process: {table: {"verdict": ok|warn|burning, ...evidence}}."""
+    return _SLO_LATEST
+
+
+def reset_slo() -> None:
+    """Test hook: forget the verdicts (they otherwise outlive the
+    cluster that produced them within one pytest process)."""
+    global _SLO_LATEST
+    _SLO_LATEST = {}
+
+
+def _slo_config(tables) -> dict:
+    """Resolve each table's SLO targets: the optional PEGASUS_SLO_CONFIG
+    ini file's [slo] section (keys ``table.<name>.availability`` /
+    ``table.<name>.p99_us``) over the PEGASUS_SLO_AVAIL /
+    PEGASUS_SLO_P99_US env defaults (p99 0 = latency SLO disabled)."""
+    avail = float(os.environ.get("PEGASUS_SLO_AVAIL", "0.999"))
+    p99 = float(os.environ.get("PEGASUS_SLO_P99_US", "0"))
+    per = {t: {"availability": avail, "p99_us": p99} for t in tables}
+    path = os.environ.get("PEGASUS_SLO_CONFIG", "")
+    if path:
+        cp = configparser.ConfigParser()
+        try:
+            cp.read(path)
+        except configparser.Error:
+            return per
+        if cp.has_section("slo"):
+            for key, val in cp.items("slo"):
+                parts = key.split(".")
+                if len(parts) < 3 or parts[0] != "table":
+                    continue
+                name, field = ".".join(parts[1:-1]), parts[-1]
+                if name in per and field in ("availability", "p99_us"):
+                    try:
+                        per[name][field] = float(val)
+                    except ValueError:
+                        pass
+    return per
 
 
 def rollup_slow_requests(fetch, nodes, last: int = 20) -> list:
@@ -95,6 +146,15 @@ class InfoCollector:
         # worst-offender summary the doctor reads
         self.cluster_slow_requests = []
         self.lag_stats = {}
+        # tenant plane (ISSUE 18): cluster-folded per-table ledgers, the
+        # top-k capacity attribution, and the burn-rate bookkeeping.
+        # table_stats/table_top are rebound wholesale (copy-on-write like
+        # hotkey_results) so the /tables route and shell read lock-free.
+        self.table_stats = {}
+        self.table_top = {}
+        self._table_published = set()   # collector.table.* gauges set
+        self._slo_samples = {}   # table -> [(ts, requests, errors), ...]
+        self._slo_burning = set()  # tables burning last round (edge det.)
         # scrape robustness (ISSUE 12 satellite): a node dying
         # mid-collect_once must COUNT, not silently vanish from the
         # round's aggregates — the counter + event make a blind round
@@ -264,6 +324,156 @@ class InfoCollector:
             len(self.cluster_slow_requests))
         return self.cluster_slow_requests
 
+    def collect_table_stats(self, nodes) -> dict:
+        """Tenant fold (ISSUE 18): pull every node's `table-stats`
+        fragments (pid-keyed per process — a grouped node's router merge
+        already concatenated its workers'), fold them cluster-wide
+        (totals sum, latency percentiles MAX) and republish as
+        `collector.table.<name>.*` gauges so the series land in metric
+        history. Also computes the top-k capacity attribution
+        (PEGASUS_TABLE_TOPK, default 5) by ops / bytes / device-seconds
+        / HBM."""
+        from ..runtime.table_stats import fold_snapshots, top_k
+
+        frags = []
+        for node in sorted(nodes):
+            try:
+                reply = json.loads(
+                    self.remote_command(node, "table-stats", []))
+            except (RpcError, OSError, ValueError) as e:
+                self._scrape_failed(node, "table-stats", e)
+                continue
+            if isinstance(reply, dict):
+                frags.extend(v for v in reply.values() if isinstance(v, dict))
+        folded = fold_snapshots(frags)
+        published = set()
+        for table, m in folded.items():
+            ops = (m.get("read_qps", 0) + m.get("write_qps", 0)
+                   + m.get("scan_qps", 0))
+            # explicit cumulative series for the slow burn window: the
+            # fold ships ledger TOTALS, so first/last deltas over a
+            # metric-history window are true request/error counts
+            m = dict(m, ops_total=ops,
+                     errors_total=m.get("errors", 0))
+            for k, v in m.items():
+                if isinstance(v, dict):
+                    for q, qv in v.items():
+                        counters.number(
+                            f"collector.table.{table}.{k}.{q}").set(
+                                float(qv))
+                        published.add(f"collector.table.{table}.{k}.{q}")
+                else:
+                    counters.number(
+                        f"collector.table.{table}.{k}").set(float(v))
+                    published.add(f"collector.table.{table}.{k}")
+            folded[table] = m
+        # stale-clear (same rule as collect_compact_stats): a dropped
+        # table's gauges must not freeze at their last totals
+        for name in self._table_published - published:
+            counters.number(name).set(0.0)
+        self._table_published = published
+        self.table_top = top_k(
+            folded, int(os.environ.get("PEGASUS_TABLE_TOPK", "5")))
+        self.table_stats = folded
+        return folded
+
+    def evaluate_slos(self) -> dict:
+        """Declarative per-table SLOs with multi-window burn rate
+        (ISSUE 18). For each table the error-budget burn is computed on
+        a FAST window (~PEGASUS_SLO_FAST_S, from the live fold samples
+        this collector keeps round to round) and a SLOW window
+        (~PEGASUS_SLO_SLOW_S, first/last deltas of the republished
+        cumulative series in metric history; falls back to the fast
+        burn until the window holds two samples — cold start). Verdict:
+        burning when BOTH windows burn >= PEGASUS_SLO_BURN_CRIT (or the
+        p99 latency bound burns past it), warn at >= PEGASUS_SLO_BURN_WARN,
+        ok otherwise. Each verdict carries named evidence; entering
+        `burning` emits an `slo.burning` event (the flight recorder's
+        trigger chain) and the slo.<table>.* gauges track the numbers."""
+        global _SLO_LATEST
+
+        now = time.time()
+        fast_s = float(os.environ.get("PEGASUS_SLO_FAST_S", "300"))
+        slow_s = float(os.environ.get("PEGASUS_SLO_SLOW_S", "3600"))
+        warn = float(os.environ.get("PEGASUS_SLO_BURN_WARN", "1.0"))
+        crit = float(os.environ.get("PEGASUS_SLO_BURN_CRIT", "2.0"))
+        folded = self.table_stats
+        targets = _slo_config(folded)
+        verdicts = {}
+        for table, m in folded.items():
+            requests = m.get("ops_total", 0) + m.get("errors_total", 0)
+            errors = m.get("errors_total", 0)
+            hist = self._slo_samples.setdefault(table, [])
+            hist.append((now, requests, errors))
+            while len(hist) > 2 and hist[1][0] <= now - fast_s:
+                hist.pop(0)
+            budget = max(1e-9, 1.0 - targets[table]["availability"])
+            # baseline = the oldest retained sample (the trim above keeps
+            # at most one sample older than the window start, so this is
+            # "the window's entry point", never the sample just appended)
+            r0 = hist[0]
+            dreq = max(0, requests - r0[1])
+            derr = max(0, errors - r0[2])
+            fast_burn = (derr / max(1, dreq)) / budget
+            slow_burn = self._slow_burn(table, slow_s, budget, fast_burn)
+            p99_bound = targets[table]["p99_us"]
+            p99 = max(m.get("read_latency_us", {}).get("p99", 0),
+                      m.get("write_latency_us", {}).get("p99", 0))
+            lat_burn = (p99 / p99_bound) if p99_bound > 0 else 0.0
+            if (fast_burn >= crit and slow_burn >= crit) or lat_burn >= crit:
+                verdict = "burning"
+            elif (fast_burn >= warn and slow_burn >= warn) \
+                    or lat_burn >= warn:
+                verdict = "warn"
+            else:
+                verdict = "ok"
+            verdicts[table] = {
+                "verdict": verdict,
+                "fast_burn": round(fast_burn, 3),
+                "slow_burn": round(slow_burn, 3),
+                "latency_burn": round(lat_burn, 3),
+                "requests_fast": dreq, "errors_fast": derr,
+                "availability_target": targets[table]["availability"],
+                "p99_us": p99, "p99_bound_us": p99_bound,
+            }
+            counters.number(f"slo.{table}.fast_burn").set(fast_burn)
+            counters.number(f"slo.{table}.slow_burn").set(slow_burn)
+            counters.number(f"slo.{table}.verdict").set(
+                {"ok": 0, "warn": 1, "burning": 2}[verdict])
+            if verdict == "burning" and table not in self._slo_burning:
+                events.emit("slo.burning", severity="warn", table=table,
+                            fast_burn=round(fast_burn, 3),
+                            slow_burn=round(slow_burn, 3),
+                            latency_burn=round(lat_burn, 3))
+        self._slo_burning = {t for t, v in verdicts.items()
+                             if v["verdict"] == "burning"}
+        for table in set(self._slo_samples) - set(folded):
+            del self._slo_samples[table]
+        _SLO_LATEST = verdicts
+        return verdicts
+
+    def _slow_burn(self, table: str, slow_s: float, budget: float,
+                   fallback: float) -> float:
+        """Slow-window burn from metric history first/last deltas of the
+        republished cumulative series; `fallback` (the fast burn) until
+        the window holds two samples of the table's series."""
+        from ..runtime.metric_history import HISTORY
+
+        pfx = f"collector.table.{table}."
+        win = HISTORY.window(seconds=slow_s, prefix=pfx)
+        samples = [s for s in win.get("samples", [])
+                   if pfx + "ops_total" in s.get("values", {})]
+        if len(samples) < 2:
+            return fallback
+        first, last = samples[0]["values"], samples[-1]["values"]
+        dreq = max(0, (last.get(pfx + "ops_total", 0)
+                       + last.get(pfx + "errors_total", 0))
+                   - (first.get(pfx + "ops_total", 0)
+                      + first.get(pfx + "errors_total", 0)))
+        derr = max(0, last.get(pfx + "errors_total", 0)
+                   - first.get(pfx + "errors_total", 0))
+        return (derr / max(1, dreq)) / budget
+
     def collect_once(self) -> dict:
         apps = self._meta_call(RPC_CM_LIST_APPS, mm.ListAppsRequest(),
                                mm.ListAppsResponse).apps
@@ -317,6 +527,8 @@ class InfoCollector:
         self.collect_compact_stats(all_nodes)
         self.collect_lag_stats(all_nodes)
         self.collect_slow_requests(all_nodes)
+        self.collect_table_stats(all_nodes)
+        self.evaluate_slos()
         self.app_stats = summary
         return summary
 

@@ -305,6 +305,10 @@ class LsmEngine:
         # would write the same records into two output sets and double-
         # unlink inputs (ADVICE r2 medium). RLock: compact -> cascade nests.
         self._compaction_lock = lockrank.named_rlock("engine.compaction")
+        # tenant accounting (ISSUE 18): set by the host's set_table_name;
+        # device-read probes and HBM residency charge here when wired.
+        # Plain attribute write — readers tolerate None (lock-free).
+        self.table_ledger = None
         # bytes of HBM pinned by resident runs
         self._device_cache_used = 0  #: guarded_by self._lock
         # files currently holding a run
@@ -692,6 +696,8 @@ class LsmEngine:
             from ..runtime.tracing import COMPACT_TRACER
 
             rows = lookup_batch(dr, [keys[i] for i in cand])
+            if self.table_ledger is not None:
+                self.table_ledger.charge_device_read(len(cand))
             hits = [(i, int(r)) for i, r in zip(cand, rows) if r >= 0]
             with COMPACT_TRACER.span("read.gather", records=len(hits)):
                 block = sst.block()
@@ -2053,6 +2059,12 @@ class LsmEngine:
         HBM_GAUGES.drop(self)
 
     # ------------------------------------------------------------- statistics
+
+    def device_resident_bytes(self) -> int:
+        """HBM bytes pinned by this engine's resident runs — a lock-free
+        racy read for attribution paths (beacon refresh, ISSUE 18) that
+        must never take the engine lock."""
+        return self._device_cache_used  #: unguarded_ok racy gauge read
 
     def stats(self) -> dict:
         with self._lock:

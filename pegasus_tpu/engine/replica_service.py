@@ -113,6 +113,8 @@ class ReplicaService:
         try:
             srv.read_qps_throttler.consume(1)
         except ThrottleReject as e:
+            if srv.table_ledger is not None:
+                srv.table_ledger.charge_error()
             raise RpcError(ERR_BUSY, str(e))
         return srv
 
@@ -128,6 +130,8 @@ class ReplicaService:
         try:
             return getattr(srv, method)(*args)
         except CorruptionError as e:
+            if srv.table_ledger is not None:
+                srv.table_ledger.charge_error()
             raise RpcError(ERR_INVALID_DATA,
                            f"on-disk corruption: {e.detail} — replica "
                            f"{srv.app_id}.{srv.pidx} is being quarantined; "
@@ -181,8 +185,15 @@ class ReplicaService:
             # compaction-debt admission control (ISSUE 10): graduated
             # delay as L0 debt approaches the stall cliff, reject past
             # the configured ratio — counted on its own
-            # engine.throttle.debt_* series by the throttle itself
-            srv.debt_throttler.consume()
+            # engine.throttle.debt_* series by the throttle itself (and,
+            # when the replica is table-wired, on the tenant ledger)
+            delay_ms = srv.debt_throttler.consume()
+            if delay_ms > 0:
+                # per-partition delay attribution (ISSUE 18): which
+                # partition paid the debt stall, in ms not just counts
+                counters.rate(
+                    f"app.{srv.app_id}.{srv.pidx}."
+                    "recent_write_throttling_delay_ms").increment(delay_ms)
             if (srv.write_qps_throttler.delayed_count
                     + srv.write_size_throttler.delayed_count) > d0:
                 counters.rate(
@@ -192,7 +203,11 @@ class ReplicaService:
             counters.rate(
                 f"app.{srv.app_id}.{srv.pidx}."
                 "recent_write_throttling_reject_count").increment()
+            if srv.table_ledger is not None:
+                srv.table_ledger.charge_error()
             raise RpcError(ERR_BUSY, str(e))
+        if srv.table_ledger is not None:
+            srv.table_ledger.charge_bytes_in(len(body))
         router = self._write_router
         if router is not None:
             resp = router(srv, header.code, req)

@@ -224,10 +224,31 @@ class PegasusServer:
             app_id, pidx, read_hotkey=self.read_hotkey,
             write_hotkey=self.write_hotkey)
         self.write_service.cu_calculator = self.cu_calculator
+        # tenant accounting (ISSUE 18): wired by set_table_name once the
+        # host learns which table this partition serves; None until then
+        # (standalone engines without a table name stay unattributed)
+        self.table_name = ""
+        self.table_ledger = None
         if app_envs:
             self.update_app_envs(app_envs)
 
     # -------------------------------------------------------------- app envs
+
+    def set_table_name(self, name: str) -> None:
+        """Wire this partition to its tenant ledger (ISSUE 18): resolves
+        the per-table ledger ONCE, registers the gpid -> table mapping
+        (job/transport attribution), and hands the ledger to the debt
+        throttle and the engine so delay-ms and device-read probes are
+        charged at the source."""
+        if not name or name == self.table_name:
+            return
+        from ..runtime.table_stats import TABLE_STATS
+
+        self.table_name = name
+        led = TABLE_STATS.register_gpid(self.app_id, self.pidx, name)
+        self.table_ledger = led
+        self.debt_throttler.ledger = led
+        self.engine.table_ledger = led
 
     def update_app_envs(self, envs: dict) -> None:
         """Hot-apply per-table dynamic config (src/server/pegasus_server_impl.cpp:2406)."""
@@ -393,12 +414,16 @@ class PegasusServer:
         resps = self.write_service.apply_batched_window(entries)
         elapsed_us = int((time.perf_counter() - t0) * 1e6)
         ops = set()
+        n_ops = 0
         for _, _, reqs in entries:
             for code, _ in reqs:
                 ops.add(_OP_NAMES[code])
                 counters.rate(self._pfx + f"{_OP_NAMES[code]}_qps").increment()
+                n_ops += 1
         for op in ops:
             counters.percentile(self._pfx + f"{op}_latency_us").set(elapsed_us)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_write(elapsed_us, n_ops=n_ops)
         return resps
 
     def on_batched_write_requests(self, decree: int, timestamp_us: int, requests,
@@ -442,6 +467,8 @@ class PegasusServer:
         elapsed_us = int((time.perf_counter() - t0) * 1e6)
         for op in {_OP_NAMES[code] for code, _ in requests}:
             counters.percentile(self._pfx + f"{op}_latency_us").set(elapsed_us)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_write(elapsed_us, n_ops=len(requests))
         return responses
 
     def _dispatch_single(self, decree, timestamp_us, code, req, now=None):
@@ -472,8 +499,10 @@ class PegasusServer:
                 resp = ws.trigger_audit(decree, req)
             else:
                 resp = ws.ingestion_files(decree, req)
-        counters.percentile(self._pfx + f"{op}_latency_us").set(
-            int((time.perf_counter() - t0) * 1e6))
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        counters.percentile(self._pfx + f"{op}_latency_us").set(elapsed_us)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_write(elapsed_us)
         return resp
 
     # ------------------------------------------------------------- read path
@@ -494,11 +523,13 @@ class PegasusServer:
         except ValueError:
             hk = key  # malformed client key: still account, never raise
         self.cu_calculator.add_get_cu(hk, key, resp.value)
-        self._check_abnormal_size("get", hk, len(key) + len(resp.value),
-                                  self._abnormal_get_size)
+        size = len(key) + len(resp.value)
+        self._check_abnormal_size("get", hk, size, self._abnormal_get_size)
         self._c_get_qps.increment()
         elapsed_us = int((time.perf_counter() - t0) * 1e6)
         self._c_get_latency.set(elapsed_us)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_read(elapsed_us, size)
         self._check_slow_query("get", hk, elapsed_us)
         return resp
 
@@ -557,8 +588,10 @@ class PegasusServer:
                 "multi_get", req.hash_key, size, self._abnormal_multi_get_size,
                 rows=len(req.sort_keys),
                 rows_thr=self._abnormal_multi_get_iterate_count)
-            self._check_slow_query("multi_get", req.hash_key,
-                                   int((time.perf_counter() - t0) * 1e6))
+            elapsed_us = int((time.perf_counter() - t0) * 1e6)
+            if self.table_ledger is not None:
+                self.table_ledger.charge_read(elapsed_us, size)
+            self._check_slow_query("multi_get", req.hash_key, elapsed_us)
             return resp
 
         start = key_schema.generate_key(req.hash_key, req.start_sortkey)
@@ -615,8 +648,10 @@ class PegasusServer:
         self._check_abnormal_size(
             "multi_get", req.hash_key, size, self._abnormal_multi_get_size,
             rows=iterated, rows_thr=self._abnormal_multi_get_iterate_count)
-        self._check_slow_query("multi_get", req.hash_key,
-                               int((time.perf_counter() - t0) * 1e6))
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_read(elapsed_us, size)
+        self._check_slow_query("multi_get", req.hash_key, elapsed_us)
         resp.kvs = out
         resp.error = Status.OK if complete else Status.INCOMPLETE
         return resp
@@ -748,9 +783,11 @@ class PegasusServer:
         charges the per-RPC limiter, so sparse-filter scans cannot pin a
         read thread unboundedly (reference scan loop under
         range_read_limiter, pegasus_server_impl.cpp:1000-1150)."""
+        t0 = time.perf_counter()
         batch = max(1, req.batch_size)
         limiter = self._make_limiter()
         n = 0
+        nbytes = 0
         exhausted = True
         filter_free = self._scan_filter_free(req)
         for k, raw, expire in iterator:
@@ -765,12 +802,16 @@ class PegasusServer:
             if req.return_expire_ts:
                 kv.expire_ts_seconds = expire
             limiter.add_size(len(k) + len(data))
+            nbytes += len(k) + len(data)
             resp.kvs.append(kv)
             n += 1
             if n >= batch:
                 exhausted = False
                 break
         self.cu_calculator.add_scan_cu(resp.kvs)
+        if self.table_ledger is not None:
+            self.table_ledger.charge_scan(
+                int((time.perf_counter() - t0) * 1e6), nbytes)
         if exhausted:
             resp.context_id = consts.SCAN_CONTEXT_ID_COMPLETED
         else:

@@ -123,7 +123,10 @@ class DebtThrottle:
     PEGASUS_SCHED_THROTTLE_MAX_MS (delay at the ceiling edge),
     PEGASUS_SCHED_THROTTLE_REJECT (ratio that rejects; 0 = never).
     Counters: engine.throttle.debt_delay_count / debt_reject_count
-    rates + the engine.throttle.debt_delay_ms percentile."""
+    rates + the engine.throttle.debt_delay_ms percentile, plus the
+    monotone engine.throttle.debt_delay_ms_total rate whose .total() is
+    the process-global delay-ms sum (ISSUE 18: must equal the sum of
+    per-table ledger attributions — see tests/test_table_stats.py)."""
 
     def __init__(self, engine):
         from ..runtime.perf_counters import counters
@@ -144,6 +147,14 @@ class DebtThrottle:
         self._c_reject = counters.rate("engine.throttle.debt_reject_count")
         self._c_delay_ms = counters.percentile(
             "engine.throttle.debt_delay_ms")
+        self._c_delay_ms_total = counters.rate(
+            "engine.throttle.debt_delay_ms_total")
+        # per-partition attribution (ISSUE 18): the monotone ms sum this
+        # one throttle has charged, and an optional per-table ledger the
+        # host wires up (set_table_name) so every delayed ms lands on a
+        # tenant key at the moment it is charged
+        self.delay_ms_total = 0.0
+        self.ledger = None
         # flight-recorder edge detection: ONE event per engage/disengage
         # transition, not one per delayed write. Deliberately lock-free
         # (this sits on the per-write admission path); a race can at
@@ -160,12 +171,14 @@ class DebtThrottle:
     # slowdown, far enough that the defer window itself is free.
     DEFER_SOFT = 0.875
 
-    def consume(self) -> None:
+    def consume(self) -> float:
         """Charge one write; sleeps for the graduated delay, raises
         ThrottleReject past the reject ratio. Called OUTSIDE any engine
-        lock (the sleep must never convoy other writers)."""
+        lock (the sleep must never convoy other writers). Returns the
+        delay in ms (0.0 on the free paths) so callers can attribute the
+        stall to the partition that paid it."""
         if not self.enabled:
-            return
+            return 0.0
         ratio = self.engine.compact_debt_ratio()
         soft = self.soft
         if ratio >= soft \
@@ -177,7 +190,7 @@ class DebtThrottle:
                 from ..runtime import events
 
                 events.emit("throttle.disengage", ratio=round(ratio, 3))
-            return
+            return 0.0
         if not self._engaged:
             self._engaged = True
             from ..runtime import events
@@ -193,8 +206,15 @@ class DebtThrottle:
         frac = min(1.0, (ratio - self.soft) / max(1e-9, 1.0 - self.soft))
         delay_ms = self.max_ms * frac
         if delay_ms <= 0:
-            return
+            return 0.0
         self.delayed_count += 1
+        self.delay_ms_total += delay_ms
         self._c_delay.increment()
         self._c_delay_ms.set(delay_ms)
+        self._c_delay_ms_total.increment(delay_ms)
+        if self.ledger is not None:
+            # charged HERE, not by the caller: global total == sum of
+            # per-table attributions holds structurally
+            self.ledger.charge_throttle_delay(delay_ms)
         time.sleep(delay_ms / 1000.0)
+        return delay_ms

@@ -89,6 +89,7 @@ class MetaServer:
         self._nodes = {}         # addr -> last_beacon_monotonic
         self._node_replicas = {} # addr -> ["app_id.pidx"] from the last beacon
         self._node_states = {}   # addr -> {gpid: lag/audit state} (beacon)
+        self._node_tables = {}   # addr -> {tables@pid:N: tenant-ledger frag}
         self._dups = {}          # app_id -> list[dict] duplication entries
         self._policies = {}      # name -> dict (BackupPolicyInfo fields)
         self._dropped = {}       # app_id -> {"app","parts","expire_ts"}
@@ -1243,13 +1244,23 @@ class MetaServer:
             # per-replica lag/audit states (the cluster doctor's input);
             # in-memory only, like the liveness map — re-beacons rebuild it
             states = {}
+            tables = {}
             for item in req.replica_states:
                 try:
                     st = json.loads(item)
-                    states[st["gpid"]] = st
+                    if st.get("status") == "TABLE_STATS":
+                        # tenant-ledger fragments (ISSUE 18) ride the
+                        # beacon but are NOT replica states — divert them
+                        # so every per-gpid consumer (doctor lag fold,
+                        # quarantine repair, scheduler debt) keeps its
+                        # replicas-only invariant
+                        tables[st["gpid"]] = st
+                    else:
+                        states[st["gpid"]] = st
                 except (ValueError, KeyError, TypeError):
                     continue
             self._node_states[req.node] = states
+            self._node_tables[req.node] = tables
             # fold primary-reported dup confirmed decrees into the entries
             # (reference duplication progress sync); not persisted per
             # beacon — losing it on meta restart only means extra plog
@@ -1315,6 +1326,7 @@ class MetaServer:
             self._nodes.pop(addr, None)
             self._node_replicas.pop(addr, None)
             self._node_states.pop(addr, None)
+            self._node_tables.pop(addr, None)
 
     # ---------------------------------------------------------- failover
 
@@ -1325,6 +1337,7 @@ class MetaServer:
             # (a rejoining node re-beacons them). _node_replicas is KEPT —
             # ddd_diagnose hunts candidates on dead nodes through it.
             self._node_states.pop(node, None)
+            self._node_tables.pop(node, None)
             moves = []
             for app in self._apps.values():
                 for pc in self._parts[app.app_id]:

@@ -309,7 +309,40 @@ class ReplicaStub:
         for gpid, q in self._quarantined.items():
             states.append(json.dumps({"gpid": gpid, "status": "QUARANTINED",
                                       "quarantine": q}))
+        # tenant ledger fragment (ISSUE 18): one synthetic entry carrying
+        # this PROCESS's per-table totals, keyed by pid so group workers'
+        # fragments survive the meta fold (which keys by gpid) next to
+        # the parent's. Refresh the device-plane gauges first — per-table
+        # HBM from the hosted engines, device seconds/offload bytes from
+        # the causal-job window — so the shipped snapshot is current.
+        frag = self._table_stats_fragment()
+        if frag is not None:
+            states.append(frag)
         return alive, progress, states
+
+    def _table_stats_fragment(self):
+        """json.dumps'd synthetic beacon entry with TABLE_STATS.snapshot(),
+        or None when no table is wired in this process. The meta diverts
+        status TABLE_STATS into its tables-only side map (_node_tables)
+        at ingestion, so replica-state consumers (doctor lag fold,
+        quarantine repair, scheduler debt) never iterate over it."""
+        from ..runtime.job_trace import JOB_TRACER
+        from ..runtime.table_stats import TABLE_STATS
+
+        if not TABLE_STATS.tables():
+            return None
+        hbm = {}
+        for (a, p), rep in self._replicas.items():
+            name = TABLE_STATS.table_for_gpid(f"{a}.{p}")
+            if name:
+                hbm[name] = (hbm.get(name, 0)
+                             + rep.server.engine.device_resident_bytes())
+        for name, nbytes in hbm.items():
+            TABLE_STATS.ledger(name).set_hbm_resident(nbytes)
+        TABLE_STATS.attribute_jobs(JOB_TRACER.window(None))
+        return json.dumps({"gpid": f"tables@pid:{os.getpid()}",
+                           "status": "TABLE_STATS",
+                           "tables": TABLE_STATS.snapshot()})
 
     def _on_group_state(self, header, body) -> bytes:
         """The parent's beacon-aggregation scrape: this worker's share of
@@ -563,6 +596,10 @@ class ReplicaStub:
                                f"seed: parent {req.app_id}.{learn_pidx} not "
                                f"found at {req.learn_from}")
         rep.app_name = req.app_name or rep.app_name
+        if rep.app_name:
+            # tenant accounting (ISSUE 18): the open request is where a
+            # replica host learns which TABLE a partition serves
+            rep.server.set_table_name(rep.app_name)
         rep.partition_count = req.partition_count or rep.partition_count
         rep.assume_view(GroupView(req.ballot, req.primary, req.secondaries))
         envs = json.loads(req.envs_json or "{}")

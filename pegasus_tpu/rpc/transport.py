@@ -323,6 +323,13 @@ class RpcServer:
                              is_response=True, error=ERR_BUSY,
                              error_text=str(e))
             counters.rate("rpc.server.error_count").increment()
+            if header.app_id:
+                # tenant attribution (ISSUE 18): a rejected dispatch is
+                # an error the TABLE saw, even though no replica handler
+                # ran; no-op when the app_id is unmapped in this process
+                from ..runtime.table_stats import TABLE_STATS
+
+                TABLE_STATS.charge_app_error(header.app_id)
             try:
                 _send_frame(sock, resp, b"", lock=wlock)
             except (ConnectionError, OSError):

@@ -54,6 +54,9 @@ _DEFAULT_PREFIXES = (
     # the learn plane's ship/verify series (ISSUE 13 — was invisible in
     # flight-recorder history windows) and the job tracer's gauges
     "learn.", "job.",
+    # tenant plane (ISSUE 18): per-table ledgers + SLO burn gauges, so
+    # incident windows carry the offending table's series unprompted
+    "table.", "slo.",
 )
 
 

@@ -57,6 +57,8 @@ class RemoteCommandService:
         self.register("request-trace-dump", self._cmd_request_trace_dump)
         self.register("slow-requests", self._cmd_slow_requests)
         self.register("job-trace", self._cmd_job_trace)
+        self.register("table-stats", self._cmd_table_stats)
+        self.register("slo-status", self._cmd_slo_status)
         if describe is not None:
             self.register("describe", lambda a: json.dumps(describe(), indent=1))
 
@@ -169,6 +171,31 @@ class RemoteCommandService:
                                [found] if found else []})
         last = int(args[0]) if args else 50
         return json.dumps({f"pid:{os.getpid()}": JOB_TRACER.jobs(last=last)})
+
+    @staticmethod
+    def _cmd_table_stats(args) -> str:
+        """table-stats — this process's per-table tenant ledger totals
+        (runtime/table_stats.py). Pid-keyed like events-dump, so a
+        partition-group router's structural fan-out merge keeps every
+        worker process's fragment; callers fold them with
+        table_stats.fold_snapshots (totals sum, percentiles MAX)."""
+        import os
+
+        from .table_stats import TABLE_STATS
+
+        return json.dumps({f"pid:{os.getpid()}": TABLE_STATS.snapshot()})
+
+    @staticmethod
+    def _cmd_slo_status(args) -> str:
+        """slo-status — the most recent per-table SLO burn-rate verdicts
+        this process has computed ({} on nodes that never evaluate SLOs
+        — the collector is the evaluator). Pid-keyed for the router
+        merge like every other structural command."""
+        import os
+
+        from ..collector.info_collector import latest_slo
+
+        return json.dumps({f"pid:{os.getpid()}": latest_slo()})
 
     def _cmd_server_stat(self, args) -> str:
         """One-line digest of selected counters (brief_stat.cpp role)."""

@@ -242,6 +242,38 @@ def _health_cluster_route(meta_addrs):
     return route
 
 
+def _slo_route(path: str) -> dict:
+    """GET /slo: the per-table SLO burn-rate verdicts this process
+    computed last round ({} on processes that never evaluate — the
+    collector is the evaluator; the meta serves its own view when a
+    collector runs in-process, e.g. a onebox)."""
+    from ..collector.info_collector import latest_slo
+
+    return {"slo": latest_slo()}
+
+
+def _tables_meta_route(meta):
+    """GET /tables on the meta: fold the TABLE_STATS beacon fragments
+    (ISSUE 18 — every serving process ships its per-table ledger totals
+    keyed tables@pid:<pid>; the meta diverts them into _node_tables so
+    replica-state consumers never see them) into one cluster-wide
+    per-table view + the top-k capacity attribution."""
+    def route(path):
+        from .table_stats import fold_snapshots, top_k
+
+        frags = []
+        with meta._lock:
+            for tables in meta._node_tables.values():
+                for st in tables.values():
+                    frags.append(st.get("tables", {}))
+        folded = fold_snapshots(frags)
+        return {"tables": folded,
+                "top": top_k(folded,
+                             int(os.environ.get("PEGASUS_TABLE_TOPK", "5")))}
+
+    return route
+
+
 def _meta_http_routes(meta) -> dict:
     """The meta's rDSN-http_service analogues: /version, /meta/cluster_info,
     /meta/apps, /meta/app?name=<app>."""
@@ -285,7 +317,9 @@ def _meta_http_routes(meta) -> dict:
             "/jobs": _jobs_route,
             "/events": _events_route,
             "/metrics/history": _metrics_history_route,
-            "/incidents": _incidents_route}
+            "/incidents": _incidents_route,
+            "/tables": _tables_meta_route(meta),
+            "/slo": _slo_route}
 
 
 def _replica_http_routes(stub) -> dict:
@@ -664,6 +698,12 @@ class CollectorApp:
         if http_port >= 0:
             from ..collector.reporter import CounterReporter
 
+            def tables_route(path):
+                # the collector's own cluster fold (collect_table_stats):
+                # copy-on-write published, so this read is lock-free
+                return {"tables": self.collector.table_stats,
+                        "top": self.collector.table_top}
+
             self.reporter = CounterReporter(
                 port=http_port,
                 routes={"/compact/trace": _compact_trace_route,
@@ -672,6 +712,8 @@ class CollectorApp:
                         "/events": _events_route,
                         "/metrics/history": _metrics_history_route,
                         "/incidents": _incidents_route,
+                        "/tables": tables_route,
+                        "/slo": _slo_route,
                         "/health/cluster":
                             _health_cluster_route(self.metas)}).start()
 

@@ -138,6 +138,15 @@ class Shell:
                                "cluster_doctor [last] — ONE cluster health "
                                "verdict (healthy|degraded|critical) with "
                                "named causes + evidence"),
+            "tables": (self.cmd_tables,
+                       "tables [k] — cluster-folded per-table tenant "
+                       "ledgers (ops/latency/bytes/throttle/device/HBM) "
+                       "+ top-k capacity attribution, from every alive "
+                       "node's table-stats"),
+            "slo": (self.cmd_slo,
+                    "slo [node] — per-table SLO burn-rate verdicts "
+                    "(ok|warn|burning + named evidence) from every "
+                    "node's slo-status (the collector evaluates)"),
             "detect_hotkey": (self.cmd_detect_hotkey,
                               "detect_hotkey <node> <app_id.pidx> <read|write> <start|stop|query>"),
             "set_fail_point": (self.cmd_set_fail_point,
@@ -725,6 +734,45 @@ class Shell:
         self.p(f"cluster verdict: {verdict['verdict'].upper()}"
                + (f" ({len(verdict['causes'])} cause(s))"
                   if verdict["causes"] else ""))
+
+    def cmd_tables(self, args):
+        from ..runtime.table_stats import fold_snapshots, top_k
+
+        k = int(args[0]) if args else 5
+        frags = []
+        for node in [n.address for n in self._nodes() if n.alive]:
+            try:
+                reply = json.loads(
+                    self._node_command(node, "table-stats", []))
+            except ValueError:
+                continue
+            if isinstance(reply, dict):
+                frags.extend(v for v in reply.values()
+                             if isinstance(v, dict))
+        folded = fold_snapshots(frags)
+        self.p(json.dumps({"tables": folded, "top": top_k(folded, k)},
+                          indent=1))
+
+    def cmd_slo(self, args):
+        if args:
+            self.p(self._node_command(args[0], "slo-status", args[1:]))
+            return
+        merged = {}
+        for node in [n.address for n in self._nodes() if n.alive]:
+            try:
+                reply = json.loads(self._node_command(node, "slo-status", []))
+            except ValueError:
+                continue
+            if isinstance(reply, dict):
+                for verdicts in reply.values():
+                    if isinstance(verdicts, dict):
+                        merged.update(verdicts)
+        self.p(json.dumps(merged, indent=1))
+        burning = sorted(t for t, v in merged.items()
+                         if isinstance(v, dict)
+                         and v.get("verdict") == "burning")
+        if burning:
+            self.p("BURNING: " + ", ".join(burning))
 
     def cmd_detect_hotkey(self, args):
         node, rest = args[0], args[1:]

@@ -1,0 +1,356 @@
+"""Tenant plane (ISSUE 18): per-table ledgers, SLO burn verdicts, top-k.
+
+  - ledger units: every charge_* lands on the right `table.<name>.*`
+    counter, snapshot() exports monotone totals, fold_snapshots sums
+    across process fragments with per-quantile MAX on latencies, top_k
+    ranks the capacity axes;
+  - gpid/app registration: partition- and transport-scoped signals
+    (charge_app_error, attribute_jobs) resolve to the tenant key, and
+    an ambiguous bare-pidx job is skipped rather than mis-charged;
+  - DebtThrottle regression: the global engine.throttle.debt_delay_ms_total
+    rate equals the SUM of the per-table throttle_delay_ms attributions
+    (the throttle charges the ledger itself, so the identity is
+    structural, not sampled);
+  - SLO config: the [slo] ini section overrides the env defaults per
+    table;
+  - grouped-onebox e2e (the acceptance shape): two tables served by a
+    2-group node, per-table series flowing through the parent router's
+    pid-keyed structural merge and the meta's beacon fold, a planted
+    count-bounded serve.dispatch raise driving exactly ONE table to a
+    burning verdict that the doctor names and the flight recorder
+    embeds, while the other table stays ok.
+"""
+
+import json
+import time
+
+import pytest
+
+from pegasus_tpu.engine.throttling import DebtThrottle
+from pegasus_tpu.runtime.perf_counters import counters
+from pegasus_tpu.runtime.table_stats import (TABLE_STATS, fold_snapshots,
+                                             top_k)
+
+# ------------------------------------------------------------ ledger units
+
+
+def test_ledger_charges_snapshot_and_registry_counters():
+    TABLE_STATS.reset()
+    try:
+        led = TABLE_STATS.ledger("unit_a")
+        led.charge_read(100, 10)
+        led.charge_write(200, 20)
+        led.charge_scan(50, 5)
+        led.charge_error()
+        led.charge_throttle_delay(1.5)
+        led.charge_device_read(3)
+        led.set_hbm_resident(1024)
+        led.set_device_attribution(2.5, 77)
+        snap = TABLE_STATS.snapshot()["unit_a"]
+        assert snap["read_qps"] == 1 and snap["write_qps"] == 1
+        assert snap["scan_qps"] == 1
+        assert snap["bytes_in"] == 20 and snap["bytes_out"] == 15
+        assert snap["errors"] == 1
+        assert snap["throttle_delay_ms"] == pytest.approx(1.5)
+        assert snap["device_read_count"] == 3
+        assert snap["hbm_resident_bytes"] == 1024
+        assert snap["device_seconds"] == pytest.approx(2.5)
+        assert snap["offload_bytes"] == 77
+        assert snap["read_latency_us"]["p99"] == 100
+        assert snap["write_latency_us"]["p99"] == 200
+        # the ledger writes through to the shared registry (the beacon
+        # fragment and metric history read the same names)
+        assert counters.rate("table.unit_a.read_qps").total() == 1
+        assert counters.rate("table.unit_a.error_count").total() == 1
+        # snapshots are JSON-able (they ride beacons + remote commands)
+        json.dumps(TABLE_STATS.snapshot())
+    finally:
+        TABLE_STATS.reset()
+    assert TABLE_STATS.tables() == [], "reset drops the ledgers"
+
+
+def test_fold_sums_totals_and_maxes_percentiles():
+    a = {"t1": {"read_qps": 10, "bytes_out": 100, "errors": 1,
+                "read_latency_us": {"p50": 10, "p99": 50}}}
+    b = {"t1": {"read_qps": 5, "bytes_out": 30, "errors": 0,
+                "read_latency_us": {"p50": 20, "p99": 40}},
+         "t2": {"write_qps": 99, "bytes_in": 7}}
+    folded = fold_snapshots([a, b, "not-a-dict", {"t1": 3}])
+    assert folded["t1"]["read_qps"] == 15
+    assert folded["t1"]["bytes_out"] == 130
+    assert folded["t1"]["errors"] == 1
+    assert folded["t1"]["read_latency_us"] == {"p50": 20, "p99": 50}, \
+        "latency folds by per-quantile MAX (worst host), never sums"
+    assert folded["t2"]["write_qps"] == 99
+
+    top = top_k(folded, k=5)
+    assert [e["table"] for e in top["ops"]] == ["t2", "t1"]
+    assert top["ops"][0]["value"] == 99
+    assert [e["table"] for e in top["bytes"]] == ["t1", "t2"]
+    assert top["device_seconds"] == [], "zero-valued axes rank nobody"
+    assert [e["table"] for e in top_k(folded, k=1)["ops"]] == ["t2"]
+
+
+def test_gpid_registration_routes_app_errors_and_jobs():
+    TABLE_STATS.reset()
+    try:
+        TABLE_STATS.register_gpid(7, 0, "unit_g")
+        assert TABLE_STATS.table_for_app(7) == "unit_g"
+        assert TABLE_STATS.table_for_gpid("7.0") == "unit_g"
+        TABLE_STATS.charge_app_error(7)
+        TABLE_STATS.charge_app_error(999)  # unmapped: must no-op
+        assert TABLE_STATS.snapshot()["unit_g"]["errors"] == 1
+
+        jobs = [
+            # gpid-tagged compact job: 2 s of device time, one offload hop
+            {"kind": "compact", "status": "ok", "duration_us": 2_000_000,
+             "attrs": {"gpid": "7.0"},
+             "hops": [{"name": "offload.ship", "nbytes": 10},
+                      {"name": "learn.fetch", "nbytes": 99}]},
+            # bare-pidx job resolved via the unique gpid suffix match
+            {"kind": "compact", "status": "ok", "duration_us": 500_000,
+             "attrs": {"pidx": 0}, "hops": []},
+            # still-active job (no status): not attributable yet
+            {"kind": "compact", "duration_us": 9_999_999,
+             "attrs": {"gpid": "7.0"}, "hops": []},
+        ]
+        TABLE_STATS.attribute_jobs(jobs)
+        snap = TABLE_STATS.snapshot()["unit_g"]
+        assert snap["device_seconds"] == pytest.approx(2.5)
+        assert snap["offload_bytes"] == 10, "only offload.* hop bytes count"
+
+        # a second table sharing pidx 0 makes the bare-pidx job ambiguous:
+        # it must be SKIPPED, not split or mis-charged
+        TABLE_STATS.register_gpid(8, 0, "unit_h")
+        TABLE_STATS.attribute_jobs(jobs)
+        snap = TABLE_STATS.snapshot()
+        assert snap["unit_g"]["device_seconds"] == pytest.approx(2.0)
+        assert snap["unit_h"]["device_seconds"] == 0
+    finally:
+        TABLE_STATS.reset()
+
+
+# ------------------------------------------- throttle attribution == global
+
+
+class _RatioEngine:
+    def __init__(self, ratio, policy="normal"):
+        self.ratio = ratio
+        self.policy = policy
+
+    def compact_debt_ratio(self):
+        return self.ratio
+
+    def compact_policy_fast(self):
+        return self.policy
+
+
+def test_debt_throttle_global_equals_per_table_sum(monkeypatch):
+    """Regression (ISSUE 18 satellite): the throttle charges its OWN
+    ledger at the moment it accumulates the global total, so the global
+    engine.throttle.debt_delay_ms_total rate must equal the sum of the
+    per-table throttle_delay_ms attributions — exactly, not modulo
+    sampling."""
+    monkeypatch.setenv("PEGASUS_SCHED_THROTTLE", "1")
+    monkeypatch.setenv("PEGASUS_SCHED_THROTTLE_SOFT", "0.25")
+    monkeypatch.setenv("PEGASUS_SCHED_THROTTLE_MAX_MS", "1")
+    TABLE_STATS.reset()
+    g = counters.rate("engine.throttle.debt_delay_ms_total")
+    g0 = g.total()
+    try:
+        th_a = DebtThrottle(_RatioEngine(0.75))
+        th_a.ledger = TABLE_STATS.ledger("thr_a")
+        th_b = DebtThrottle(_RatioEngine(0.95))
+        th_b.ledger = TABLE_STATS.ledger("thr_b")
+        for _ in range(5):
+            assert th_a.consume() > 0, "past soft: every write delays"
+            th_b.consume()
+        th_b.consume()  # asymmetric op counts: the sum is not a 50/50 split
+        delta_global = g.total() - g0
+        per_table = TABLE_STATS.total_throttle_delay_ms()
+        assert delta_global > 0
+        assert per_table == pytest.approx(delta_global), \
+            "global delay-ms total must equal the sum of table attributions"
+        assert th_a.ledger.throttle_delay_ms_total() == pytest.approx(
+            th_a.delay_ms_total)
+        # below the soft ratio: free, and nothing charged anywhere
+        th_a.engine.ratio = 0.1
+        assert th_a.consume() == 0.0
+        assert TABLE_STATS.total_throttle_delay_ms() == pytest.approx(
+            delta_global)
+    finally:
+        TABLE_STATS.reset()
+
+
+# ----------------------------------------------------------- slo config
+
+
+def test_slo_config_ini_overrides_env_defaults(tmp_path, monkeypatch):
+    from pegasus_tpu.collector.info_collector import _slo_config
+
+    monkeypatch.setenv("PEGASUS_SLO_AVAIL", "0.99")
+    monkeypatch.setenv("PEGASUS_SLO_P99_US", "0")
+    cfg = tmp_path / "slo.ini"
+    cfg.write_text("[slo]\n"
+                   "table.gold.availability = 0.9999\n"
+                   "table.gold.p99_us = 5000\n"
+                   "table.my.dotted.name.availability = 0.5\n"
+                   "table.gold.bogus_field = 1\n"
+                   "notatable.x.availability = 0.1\n")
+    monkeypatch.setenv("PEGASUS_SLO_CONFIG", str(cfg))
+    per = _slo_config(["gold", "brass", "my.dotted.name"])
+    assert per["gold"] == {"availability": 0.9999, "p99_us": 5000.0}
+    assert per["brass"] == {"availability": 0.99, "p99_us": 0.0}, \
+        "tables without ini rows keep the env defaults"
+    assert per["my.dotted.name"]["availability"] == 0.5, \
+        "dotted table names resolve (field = last segment)"
+
+
+# ------------------------------------------------- grouped onebox e2e
+
+
+def _node_cmd(conn, name, args):
+    from pegasus_tpu.rpc import codec
+    from pegasus_tpu.runtime.remote_command import (RemoteCommandRequest,
+                                                    RemoteCommandResponse)
+
+    _, body = conn.call("RPC_CLI_CLI_CALL", codec.encode(
+        RemoteCommandRequest(name, list(args))), timeout=30.0)
+    return codec.decode(RemoteCommandResponse, body).output
+
+
+def test_grouped_two_tables_burning_verdict_names_the_table(
+        tmp_path, monkeypatch):
+    """The ISSUE 18 acceptance run: two tables on a grouped onebox;
+    per-table series survive the worker->router pid-keyed merge and the
+    beacon fold on the meta's /tables; a count-bounded serve.dispatch
+    raise fed ONLY gold traffic drives gold to burning — named in the
+    slo verdicts, the doctor's causes and a captured incident — while
+    brass stays ok."""
+    from pegasus_tpu.collector.cluster_doctor import run_cluster_doctor
+    from pegasus_tpu.collector.flight_recorder import RECORDER
+    from pegasus_tpu.collector.info_collector import (InfoCollector,
+                                                      latest_slo, reset_slo)
+    from pegasus_tpu.rpc.transport import RpcConnection
+    from pegasus_tpu.runtime.service_app import (_slo_route,
+                                                 _tables_meta_route)
+
+    from tests.test_satellites import MiniCluster
+
+    monkeypatch.setenv("PEGASUS_INCIDENT_DIR", str(tmp_path / "inc"))
+    monkeypatch.setenv("PEGASUS_SLO_FAST_S", "60")
+    monkeypatch.setenv("PEGASUS_SLO_SLOW_S", "120")
+    monkeypatch.setenv("PEGASUS_SLO_AVAIL", "0.999")
+    cluster = MiniCluster(tmp_path / "c", n_nodes=2, serve_groups=2)
+    col = None
+    RECORDER.reset()
+    reset_slo()
+    try:
+        gold = cluster.create("gold", partitions=2, replicas=2)
+        brass = cluster.create("brass", partitions=2, replicas=2)
+        for i in range(60):
+            gold.set(b"g%04d" % i, b"s", b"v%d" % i)
+        for i in range(20):
+            brass.set(b"b%04d" % i, b"s", b"v%d" % i)
+            brass.get(b"b%04d" % i, b"s")
+        for i in range(30):
+            gold.get(b"g%04d" % i, b"s")
+        time.sleep(0.7)  # ledger fragments ride the next beacons
+
+        # -- per-table series through the router's structural merge: the
+        # node answers table-stats with BOTH workers' pid-keyed fragments
+        node = cluster.stubs[0]
+        host, _, port = node.address.rpartition(":")
+        conn = RpcConnection((host, int(port)))
+        try:
+            reply = json.loads(_node_cmd(conn, "table-stats", []))
+            pids = sorted(k for k in reply if k.startswith("pid:"))
+            assert len(pids) == 2, f"one fragment per worker: {reply.keys()}"
+            seen = set()
+            for pid in pids:
+                seen.update(reply[pid])
+            assert {"gold", "brass"} <= seen, seen
+
+            # -- meta /tables: the beacon fold serves the cluster view
+            out = _tables_meta_route(cluster.meta)("/tables")
+            assert {"gold", "brass"} <= set(out["tables"]), out["tables"]
+            assert out["tables"]["gold"]["read_qps"] > 0
+            assert out["tables"]["gold"]["write_qps"] > 0
+            ops_rank = [e["table"] for e in out["top"]["ops"]]
+            assert ops_rank[0] == "gold", \
+                f"gold took the skewed share of ops: {out['top']}"
+
+            # -- baseline SLO round: both tables ok
+            col = InfoCollector([cluster.meta_addr])
+            col.collect_once()
+            verdicts = latest_slo()
+            assert verdicts["gold"]["verdict"] == "ok", verdicts
+            assert verdicts["brass"]["verdict"] == "ok", verdicts
+
+            # -- breach: count-bounded dispatch raise (bounded blast
+            # radius), fed ONLY gold traffic while armed
+            conns = [conn]
+            for stub in cluster.stubs[1:]:
+                h2, _, p2 = stub.address.rpartition(":")
+                conns.append(RpcConnection((h2, int(p2))))
+            for c in conns:
+                _node_cmd(c, "set-fail-point",
+                          ["serve.dispatch", "40*raise(slo breach drill)"])
+            errs = 0
+            for i in range(300):
+                try:
+                    gold.set(b"g%04d" % (i % 60), b"s", b"x")
+                except Exception:  # noqa: BLE001 - the drill's rejects
+                    errs += 1
+                if errs >= 12:
+                    break
+            assert errs >= 12, "the armed raise must reject gold traffic"
+            # drain + disarm every worker before scraping: each fan-out
+            # attempt consumes one remaining count in EVERY still-armed
+            # worker, so >40 attempts guarantee the scrape path is clean
+            for c in conns:
+                for _ in range(50):
+                    try:
+                        _node_cmd(c, "set-fail-point",
+                                  ["serve.dispatch", "off()"])
+                    except Exception:  # noqa: BLE001 - still armed: retry
+                        continue
+                _node_cmd(c, "help", [])  # clean: answers without a raise
+            for c in conns[1:]:
+                c.close()
+
+            time.sleep(0.7)  # error totals ride the next beacons
+            col.collect_once()
+            verdicts = latest_slo()
+            assert verdicts["gold"]["verdict"] == "burning", verdicts
+            assert verdicts["gold"]["errors_fast"] >= 10
+            assert verdicts["brass"]["verdict"] == "ok", \
+                f"only the victim table may burn: {verdicts}"
+            assert _slo_route("/slo")["slo"] is verdicts
+
+            # -- the doctor names the burning table as a degraded cause
+            report = run_cluster_doctor([cluster.meta_addr])
+            slo_causes = [c for c in report["causes"]
+                          if "table gold SLO burning" in c["cause"]]
+            assert slo_causes, report["causes"]
+            assert not any("table brass SLO burning" in c["cause"]
+                           for c in report["causes"])
+
+            # -- the incident embeds the burning table's in-window series
+            inc = RECORDER.capture([cluster.meta_addr],
+                                   reason="slo drill", trigger="test")
+            assert "gold" in inc.get("slo_tables", {}), inc.get("errors")
+            assert inc["slo_tables"]["gold"]["verdict"]["verdict"] \
+                == "burning"
+            assert "brass" not in inc["slo_tables"]
+        finally:
+            conn.close()
+        gold.close()
+        brass.close()
+    finally:
+        if col is not None:
+            col.stop()
+        cluster.stop()
+        RECORDER.reset()
+        reset_slo()
+        TABLE_STATS.reset()

@@ -602,7 +602,8 @@ def test_trace_overhead_bench_smoke():
     out = tob.run(n=500)
     assert set(out) == {"n", "stage_span_us", "stage_span_in_session_us",
                         "stage_event_us", "request_trace_us",
-                        "event_emit_us", "history_sample_us"}
+                        "table_ledger_us", "event_emit_us",
+                        "history_sample_us"}
     for k, v in out.items():
         assert v > 0, (k, v)
     # a stage span must stay far below the stages it wraps (>=10ms each):
@@ -611,6 +612,8 @@ def test_trace_overhead_bench_smoke():
     # the flight recorder's emit rides transition edges of hot paths and
     # stays on in tier-1 — counter-increment territory, not span territory
     assert out["event_emit_us"] < 100, out
+    # the tenant ledger bills every served request — same territory
+    assert out["table_ledger_us"] < 100, out
 
 
 def test_metric_lint_reverse_pass_flags_stale_rows(monkeypatch):

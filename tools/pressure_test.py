@@ -97,6 +97,13 @@ def _parse_args(argv=None):
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--read-pct", type=int, default=50)
     ap.add_argument("--key-space", type=int, default=100_000)
+    ap.add_argument("--tables", type=int, default=1,
+                    help="number of tables to load (table, table2..tableN; "
+                         "a self-booted onebox creates the extras): each "
+                         "table gets a DISTINCT key prefix and a skewed "
+                         "share of the op mix (table k weighted 1/(k+1)), "
+                         "the multi-tenant shape the per-table ledgers "
+                         "attribute (ISSUE 18)")
     ap.add_argument("--scenario", default="none",
                     choices=["none", "smoke", "full", "offload",
                              "corruption"],
@@ -287,12 +294,26 @@ def _deliver_offload_placements(caller, box, svc_addr: str,
             continue       # placement simply compacts locally
 
 
+def _table_list(args):
+    """--tables N -> [table, table2, .., tableN] (N=1: just --table)."""
+    n = max(1, args.tables)
+    return [args.table] + [f"{args.table}{i}" for i in range(2, n + 1)]
+
+
 def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
-            written, written_lock, windows, journal):
+            written, written_lock, windows, journal, table_ops=None):
     from pegasus_tpu.client import MetaResolver, PegasusClient, PegasusError
 
     rng = random.Random(tid)
-    cli = PegasusClient(MetaResolver([meta_addr], args.table), timeout=10)
+    tables = _table_list(args)
+    clis = [PegasusClient(MetaResolver([meta_addr], t), timeout=10)
+            for t in tables]
+    cli = clis[0]
+    # skewed tenant mix: table k draws weight 1/(k+1), so the first table
+    # dominates and the per-table ledgers have an asymmetry to attribute
+    weights = [1.0 / (k + 1) for k in range(len(tables))]
+    wsum = sum(weights)
+    local_tables = {t: 0 for t in tables}
     per_thread_qps = args.qps / args.threads
     interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
     next_fire = time.time()
@@ -340,12 +361,24 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
             continue
         next_fire += interval
         i = rng.randrange(args.key_space)
-        hk = b"pres%07d" % i
+        if len(tables) == 1:
+            hk = b"pres%07d" % i
+        else:
+            # distinct per-table key prefix: self-verification (value
+            # derived from the FULL key) stays sound across tenants
+            r = rng.random() * wsum
+            t_idx = 0
+            while t_idx < len(tables) - 1 and r > weights[t_idx]:
+                r -= weights[t_idx]
+                t_idx += 1
+            cli = clis[t_idx]
+            hk = b"%s:pres%07d" % (tables[t_idx].encode(), i)
+            local_tables[tables[t_idx]] += 1
         if rng.randrange(100) < args.read_pct:
             # snapshot BEFORE the read: a write completing between
             # the get and a later check would fake a lost write
             with written_lock:
-                was_written = i in written
+                was_written = hk in written
             try:
                 v = timed(cli.get, hk, b"s")
             except PegasusError as e:
@@ -381,12 +414,16 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
                 classify_error(journal.now(), "set", repr(e))
                 continue
             with written_lock:
-                written.add(i)
+                written.add(hk)
             local["writes"] += 1
-    cli.close()
+    for c in clis:
+        c.close()
     with stats_lock:
         for k, v in local.items():
             stats[k] += v
+        if table_ops is not None:
+            for t, v in local_tables.items():
+                table_ops[t] = table_ops.get(t, 0) + v
 
 
 def run_pressure(argv=None) -> int:
@@ -426,6 +463,10 @@ def run_pressure(argv=None) -> int:
 
             box = Onebox(args.table, partitions=8)
             meta_addr = box.meta_addr
+        tables = _table_list(args)
+        if box is not None:
+            for extra in tables[1:]:
+                box.cluster.create(extra, partitions=8).close()
 
         stats = {"reads": 0, "writes": 0, "errors_in_window": 0,
                  "errors_steady": 0, "recovered_reads": 0,
@@ -434,6 +475,7 @@ def run_pressure(argv=None) -> int:
         lat = LatencyReservoir(cap=args.reservoir)
         written = set()
         written_lock = threading.Lock()
+        table_ops = {}  # per-table op counts (guarded by stats_lock)
 
         # flight recorder (ISSUE 12): the FIRST named failure of the run
         # captures an incident artifact AT failure time (the nodes' event
@@ -463,7 +505,7 @@ def run_pressure(argv=None) -> int:
 
         audits = None
         if args.audit_every > 0:
-            audits = AuditRounds([meta_addr], apps=[args.table],
+            audits = AuditRounds([meta_addr], apps=tables,
                                  every_s=args.audit_every,
                                  wait_s=min(5.0, args.audit_every),
                                  journal=journal).start()
@@ -475,7 +517,7 @@ def run_pressure(argv=None) -> int:
             # the zero-wrong-reads claim. The huge cadence parks the
             # loop on its stop event; stop(final_round=True) below runs
             # the single post-quiesce round.
-            audits = AuditRounds([meta_addr], apps=[args.table],
+            audits = AuditRounds([meta_addr], apps=tables,
                                  every_s=3600.0, wait_s=5.0,
                                  journal=journal).start()
         if args.inject_fault:
@@ -508,7 +550,7 @@ def run_pressure(argv=None) -> int:
             _worker, t, args, meta_addr, stop_at, stats, stats_lock, lat,
             written, written_lock,
             windows if args.scenario != "none" else None, journal,
-            name=f"pressure-{t}", start=False)
+            table_ops, name=f"pressure-{t}", start=False)
             for t in range(args.threads)]
         for t in threads:
             t.start()
@@ -612,6 +654,8 @@ def run_pressure(argv=None) -> int:
                   "audit_rounds": audit_summary,
                   "fault_windows": windows.bounds(),
                   "failures": [f["failure"] for f in failures]}
+        if len(tables) > 1:
+            detail["table_ops"] = dict(sorted(table_ops.items()))
         if xcluster is not None:
             detail["cross_cluster"] = {
                 k: xcluster[k] for k in ("match", "src", "dst", "dupid")

@@ -93,6 +93,22 @@ def bench_history_sample(n: int) -> float:
     return dur
 
 
+def bench_table_ledger(n: int) -> float:
+    """One per-request table-ledger charge (ISSUE 18): the tenant plane
+    bills every served read on the hot path (a rate increment, a
+    percentile set, a bytes-out add against pre-resolved counters), so
+    its cost must stay in the same noise band as the request tracer."""
+    from pegasus_tpu.runtime.table_stats import TABLE_STATS
+
+    led = TABLE_STATS.ledger("t_overhead_bench")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        led.charge_read(120, 64)
+    dur = (time.perf_counter() - t0) / n
+    TABLE_STATS.reset()
+    return dur
+
+
 def bench_request_trace(n: int) -> float:
     from pegasus_tpu.runtime.tracing import RequestTracer
 
@@ -117,6 +133,8 @@ def run(n: int = None) -> dict:
         # one request trace = root + 2 nested spans + finalize
         "request_trace_us": round(
             bench_request_trace(max(1, n // 10)) * 1e6, 2),
+        # tenant plane (ISSUE 18): one per-request table-ledger charge
+        "table_ledger_us": round(bench_table_ledger(n) * 1e6, 2),
         # flight recorder (ISSUE 12): event emit + one history sample
         "event_emit_us": round(bench_event_emit(n) * 1e6, 2),
         "history_sample_us": round(
