@@ -569,20 +569,23 @@ def _ycsb_params():
 
 def _ycsb_mix():
     """(mix letter, read fraction): PEGASUS_BENCH_YCSB_MIX selects the
-    YCSB point-op mix — 'a' 50/50 read/update (default), 'b' 95/5,
-    'c' 100/0 read-only. The read-heavy variants are the device-served
-    read A/B workload (run with PEGASUS_DEVICE_READS=1 vs 0 against a
-    tpu-backend onebox on hardware; see ROADMAP)."""
+    YCSB op mix — 'a' 50/50 read/update (default), 'b' 95/5,
+    'c' 100/0 read-only, 'e' 95/5 short-scan/insert (the YCSB-E shape:
+    the "read" is a bounded multi_get range under one hashkey). The
+    read-heavy variants are the device-served read A/B workload, and 'e'
+    the device-served RANGE-read one (run with PEGASUS_DEVICE_READS=1 vs
+    0 against a tpu-backend onebox on hardware; see ROADMAP)."""
     m = (os.environ.get("PEGASUS_BENCH_YCSB_MIX", "a").strip().lower()
          or "a")
-    return m, {"a": 0.5, "b": 0.95, "c": 1.0}.get(m, 0.5)
+    return m, {"a": 0.5, "b": 0.95, "c": 1.0, "e": 0.95}.get(m, 0.5)
 
 
 def _ycsb_metric_name() -> str:
     records, ops, threads, partitions, value_size = _ycsb_params()
     mix, read_frac = _ycsb_mix()
     pct = int(round(read_frac * 100))
-    return (f"YCSB-{mix.upper()} {pct}/{100 - pct} read-update ops/sec "
+    shape = "scan-insert" if mix == "e" else "read-update"
+    return (f"YCSB-{mix.upper()} {pct}/{100 - pct} {shape} ops/sec "
             f"({records} records, "
             f"{ops} ops, {threads} threads, {partitions} partitions, "
             f"value={value_size}B)")
@@ -632,9 +635,12 @@ def _max_quantiles(dicts):
     return out
 
 
+_YCSB_E_GROUP = 100  # sortkeys per hashkey in the mix='e' load shape
+
+
 def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
                        read_frac: float = 0.5, during=None,
-                       tables=("ycsb",)):
+                       tables=("ycsb",), scan_mix: bool = False):
     """Shared YCSB workload driver: load `records`, run the read/update
     mix (`read_frac` reads) from `n_threads` clients. -> stats dict (the
     sweep mode reruns this once per group count). `during`, when given,
@@ -643,7 +649,13 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
     load, not just at rest); its return value lands in stats["during"].
     With multiple `tables` the record budget splits evenly and each
     worker thread pins one table (tid % len(tables)) — the multi-tenant
-    shape the per-table ledger breakdown attributes."""
+    shape the per-table ledger breakdown attributes.
+
+    scan_mix=True is the YCSB-E shape: records load as _YCSB_E_GROUP
+    sortkeys per hashkey, the read op is a SHORT SCAN (bounded multi_get
+    range from a random start sortkey, length uniform 1.._YCSB_E_GROUP —
+    the device range-read path) and the write op an INSERT of a fresh
+    row, latencies in bench.ycsb.{scan,insert}_latency_us."""
     from pegasus_tpu.client import MetaResolver, PegasusClient
     from pegasus_tpu.runtime.perf_counters import counters
     from pegasus_tpu.runtime.tasking import spawn_thread
@@ -651,17 +663,27 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
     tables = tuple(tables) or ("ycsb",)
     per_records = records if len(tables) == 1 else max(1,
                                                       records // len(tables))
+
+    def load_key(i):
+        if scan_mix:
+            return (b"user%09d" % (i // _YCSB_E_GROUP),
+                    b"s%04d" % (i % _YCSB_E_GROUP))
+        return b"user%012d" % i, b"f0"
+
     t0 = time.perf_counter()
     for table in tables:
         load_cli = PegasusClient(MetaResolver([box.meta_addr], table))
         for i in range(per_records):
-            load_cli.set(b"user%012d" % i, b"f0", value)
+            hk, sk = load_key(i)
+            load_cli.set(hk, sk, value)
         load_cli.close()
     load_s = time.perf_counter() - t0
 
     errors = [0]
     read_lat = counters.percentile("bench.ycsb.read_latency_us")
     update_lat = counters.percentile("bench.ycsb.update_latency_us")
+    scan_lat = counters.percentile("bench.ycsb.scan_latency_us")
+    insert_lat = counters.percentile("bench.ycsb.insert_latency_us")
     zipf = ZipfKeys(per_records)
 
     def worker(tid):
@@ -670,10 +692,28 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
         rng = random.Random(tid)
         cli = PegasusClient(MetaResolver([box.meta_addr],
                                          tables[tid % len(tables)]))
+        inserts = 0
         for _ in range(n_ops // n_threads):
-            k = b"user%012d" % zipf.pick(rng)
+            pick = zipf.pick(rng)
             s = time.perf_counter()
             try:
+                if scan_mix:
+                    if rng.random() < read_frac:
+                        hk = b"user%09d" % (pick // _YCSB_E_GROUP)
+                        first = rng.randrange(_YCSB_E_GROUP)
+                        cli.multi_get(
+                            hk, None,
+                            max_kv_count=rng.randint(1, _YCSB_E_GROUP),
+                            start_sortkey=b"s%04d" % first)
+                        scan_lat.set(int((time.perf_counter() - s) * 1e6))
+                    else:
+                        # fresh rows keyed per thread: inserts, not updates
+                        cli.set(b"insert%03d" % tid, b"s%08d" % inserts,
+                                value)
+                        inserts += 1
+                        insert_lat.set(int((time.perf_counter() - s) * 1e6))
+                    continue
+                k = b"user%012d" % pick
                 if rng.random() < read_frac:
                     cli.get(k, b"f0")
                     read_lat.set(int((time.perf_counter() - s) * 1e6))
@@ -711,10 +751,11 @@ def _ycsb_load_and_run(box, records, n_ops, n_threads, value,
         "load_s": round(load_s, 2),
         "load_ops_s": round(per_records * len(tables) / max(load_s, 1e-9), 1),
         "errors": errors[0],
-        "client_latency_us": {
-            "read": read_lat.percentiles(),
-            "update": update_lat.percentiles(),
-        },
+        "client_latency_us": (
+            {"scan": scan_lat.percentiles(),
+             "insert": insert_lat.percentiles()} if scan_mix else
+            {"read": read_lat.percentiles(),
+             "update": update_lat.percentiles()}),
     }
 
 
@@ -768,11 +809,14 @@ def _ycsb_group_sweep(groups_list):
         # are process-global and would otherwise blend the runs
         counters.remove("bench.ycsb.read_latency_us")
         counters.remove("bench.ycsb.update_latency_us")
+        counters.remove("bench.ycsb.scan_latency_us")
+        counters.remove("bench.ycsb.insert_latency_us")
         host_start = _host_info()
         box = Onebox("ycsb", partitions=partitions, serve_groups=g)
         try:
             stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
-                                       read_frac=_ycsb_mix()[1])
+                                       read_frac=_ycsb_mix()[1],
+                                       scan_mix=_ycsb_mix()[0] == "e")
         finally:
             box.stop()
         entry = {"groups": g, "host": {"start": host_start,
@@ -863,7 +907,8 @@ def ycsb_main():
         stats = _ycsb_load_and_run(box, records, n_ops, n_threads, value,
                                    read_frac=read_frac,
                                    during=audit_under_load,
-                                   tables=ycsb_tables)
+                                   tables=ycsb_tables,
+                                   scan_mix=mix == "e")
         audit = stats.pop("during") or {}
         audit.pop("digests", None)  # per-node digests: bulky, summarized
         # zero mismatches is only a PASS when the audit actually compared
@@ -934,6 +979,20 @@ def ycsb_main():
             "lane": read_lane,
             "device_numbers_degraded": bool(
                 read_lane["fallbacks"] or read_lane["deadline_abandons"]),
+            # device-served RANGE reads (ISSUE 19): the scan path's own
+            # totals + span durations and the same fallback-free rule —
+            # a degraded lane's scan throughput is not a device number
+            "scan": {
+                "range": {k: snap.get("read.range." + k, 0)
+                          for k in ("batch_count", "rows", "device_count",
+                                    "host_count", "reverse_host_count")},
+                "batch_size": snap.get("read.range.batch.size"),
+                "spans": {k: v for k, v in snap.items()
+                          if k.startswith("compact.stage.read.range")},
+                "device_numbers_degraded": bool(
+                    read_lane["fallbacks"]
+                    or read_lane["deadline_abandons"]),
+            },
         }
         result = {
             "metric": _ycsb_metric_name(),

@@ -98,7 +98,14 @@ class PegasusClient:
         last = None
         for attempt in range(3):
             if attempt > 0:
-                self.resolver.refresh()
+                try:
+                    self.resolver.refresh()
+                except (RpcError, OSError):
+                    # a transient meta hiccup must not kill a DATA-op
+                    # retry: the cached routing is still the best guess,
+                    # and the op either succeeds against it or fails with
+                    # its own (actionable) error below
+                    pass
                 if phash:
                     # reconfiguration may have CHANGED the partition count
                     # (split): recompute the route, not just the address
@@ -367,10 +374,14 @@ class PegasusClient:
         partition's scan session up front as a batched fan-out: all the
         get_scanner requests leave before any response is awaited
         (call_many send/collect split), so the partitions build their
-        first batches concurrently instead of serially on first use. A
-        failed prefetch degrades that scanner to lazy fetching."""
+        first batches concurrently instead of serially on first use —
+        and every scanner keeps pipelining its CONTINUATION batches the
+        same way (Scanner prefetch: the next RPC_SCAN is on the wire
+        while the current batch drains). A failed prefetch degrades that
+        scanner to lazy fetching."""
         n = self.resolver.partition_count
-        scanners = [Scanner(self, [p], b"", b"", batch_size)
+        scanners = [Scanner(self, [p], b"", b"", batch_size,
+                            prefetch=prefetch)
                     for p in range(n)]
         if not prefetch:
             return scanners
@@ -491,7 +502,15 @@ class PegasusClient:
 
 class Scanner:
     """Iterates (hash_key, sort_key, value) across partitions sequentially
-    (reference pegasus_scanner_impl walks partitions in order)."""
+    (reference pegasus_scanner_impl walks partitions in order).
+
+    prefetch=True pipelines continuation batches: as soon as a batch with
+    a live server session is absorbed, the next RPC_SCAN leaves on the
+    wire (call_many send/collect split) and is collected when iteration
+    drains the current batch — the server builds batch N+1 (one
+    device-served range dispatch per batch) while the client consumes
+    batch N. A failed prefetch degrades that fetch to the retrying lazy
+    path, so semantics are unchanged."""
 
     def __init__(self, client: PegasusClient, pidxs, start_key, stop_key,
                  batch_size, phash: int = 0, **opts):
@@ -501,12 +520,15 @@ class Scanner:
         self.stop_key = stop_key
         self.batch_size = batch_size
         self.phash = phash
+        self._prefetch = bool(opts.pop("prefetch", False))
         self.opts = opts
         self._cur = 0
         self._ctx = None
         self._batch = []
         self._bi = 0
         self._done = False
+        self._pending = None  # in-flight continuation (conn, calls, handle,
+        #                       pidx, ctx) — collected by the next _fetch
 
     def __iter__(self):
         return self
@@ -529,6 +551,8 @@ class Scanner:
             self._done = True
             return
         pidx = self.pidxs[self._cur]
+        if self._collect_prefetch(pidx):
+            return
         if self._ctx is None:
             req = msg.GetScannerRequest(
                 start_key=self.start_key, stop_key=self.stop_key,
@@ -556,6 +580,44 @@ class Scanner:
             # limiter may spend its whole budget on filtered-out rows —
             # keep the session and fetch again
             self._ctx = resp.context_id
+            if self._prefetch:
+                self._send_prefetch()
+
+    def _send_prefetch(self):
+        """Fire the next RPC_SCAN for the live session without waiting
+        (best effort: any failure just leaves the lazy path to do the
+        fetch with its full retry machinery)."""
+        pidx = self.pidxs[self._cur]
+        calls = [(codes.RPC_SCAN, codec.encode(msg.ScanRequest(self._ctx)),
+                  self.client.resolver.app_id, pidx, self.phash)]
+        try:
+            conn = self.client.pool.get(self.client.resolver.resolve(pidx),
+                                        shard=pidx)
+            self._pending = (conn, calls, conn.call_many_send(calls),
+                             pidx, self._ctx)
+        except (RpcError, OSError):
+            self._pending = None
+
+    def _collect_prefetch(self, pidx) -> bool:
+        """Absorb an in-flight prefetched batch. -> True when it served
+        this fetch; False degrades to the lazy path (stale target after a
+        partition transition, send/collect failure, server-side error)."""
+        if self._pending is None:
+            return False
+        conn, calls, handle, ppidx, pctx = self._pending
+        self._pending = None
+        if ppidx != pidx or pctx != self._ctx:
+            return False
+        try:
+            (_, rbody), = conn.call_many_collect(handle, calls,
+                                                 self.client.timeout)
+            resp = codec.decode(msg.ScanResponse, rbody)
+        except (RpcError, OSError):
+            return False
+        if resp.error != Status.OK:
+            return False
+        self._absorb(resp)
+        return True
 
     def _preload(self, resp):
         """Absorb a fan-out-prefetched first batch (get_unordered_scanners

@@ -6,7 +6,9 @@ and re-queries when a call fails with a routing error — which is how the
 client survives primary failover transparently.
 """
 
+import os
 import threading
+import time
 
 from ..meta import messages as mm
 from ..meta.meta_server import RPC_CM_QUERY_CONFIG
@@ -59,21 +61,39 @@ class MetaResolver:
         return (host, int(port))
 
     def _refresh(self):
+        """Query the partition table, trying every meta address over
+        PEGASUS_META_RESOLVE_ROUNDS rounds (default 3) with a short
+        backoff between rounds. One pass used to be the whole budget, and
+        a FRESH connection's first call can transiently exceed its
+        timeout when the meta's accept loop lags behind a loaded host
+        (the parallel-suite flake: connect() completes inside listen's
+        backlog before the server thread ever accept()s, so the request
+        sits unread until the timeout). A wedged connection is also
+        INVALIDATED before the retry — reusing the half-open socket would
+        just time out again and turn one slow accept into a permanent
+        'no meta server reachable'."""
+        rounds = max(1, int(os.environ.get("PEGASUS_META_RESOLVE_ROUNDS",
+                                           "3")))
         last = None
-        for meta in self.meta_addrs:
-            host, _, port = meta.rpartition(":")
-            try:
-                conn = self.pool.get((host, int(port)))
-                _, body = conn.call(RPC_CM_QUERY_CONFIG,
-                                    codec.encode(mm.QueryConfigRequest(self.app_name)),
-                                    timeout=5.0)
-                resp = codec.decode(mm.QueryConfigResponse, body)
-                if resp.error:
-                    raise RpcError(resp.error, resp.error_text)
-                with self._lock:
-                    self._app = resp.app
-                    self._partitions = resp.partitions
-                return
-            except (RpcError, OSError) as e:
-                last = e
+        for attempt in range(rounds):
+            if attempt:
+                time.sleep(0.05 * attempt)
+            for meta in self.meta_addrs:
+                host, _, port = meta.rpartition(":")
+                addr = (host, int(port))
+                try:
+                    conn = self.pool.get(addr)
+                    _, body = conn.call(RPC_CM_QUERY_CONFIG,
+                                        codec.encode(mm.QueryConfigRequest(self.app_name)),
+                                        timeout=5.0)
+                    resp = codec.decode(mm.QueryConfigResponse, body)
+                    if resp.error:
+                        raise RpcError(resp.error, resp.error_text)
+                    with self._lock:
+                        self._app = resp.app
+                        self._partitions = resp.partitions
+                    return
+                except (RpcError, OSError) as e:
+                    last = e
+                    self.pool.invalidate(addr)
         raise RpcError(7, f"no meta server reachable: {last}")

@@ -57,6 +57,31 @@ from .sstable import CorruptionError, SSTable, verify_sst, write_sst
 MANIFEST = "MANIFEST"
 CHECKPOINT_PREFIX = "checkpoint."
 
+# range-read totals resolved once (PR 6's rule: the registry lock is
+# per-lookup and these fire on every multi_get range / sortkey_count /
+# scanner batch)
+from ..runtime.perf_counters import counters as _counters  # noqa: E402
+
+_C_RANGE_BATCH = _counters.number("read.range.batch_count")
+_C_RANGE_ROWS = _counters.number("read.range.rows")
+_C_RANGE_DEVICE = _counters.number("read.range.device_count")
+_C_RANGE_HOST = _counters.number("read.range.host_count")
+_C_RANGE_REV_HOST = _counters.number("read.range.reverse_host_count")
+
+
+def _count_rows(it):
+    """Wrap a merged-scan iterator with read.range.rows accounting — one
+    bulk counter add per iterator lifetime (close/exhaustion), not one
+    registry hit per row."""
+    c = 0
+    try:
+        for rec in it:
+            c += 1
+            yield rec
+    finally:
+        if c:
+            _C_RANGE_ROWS.increment(c)
+
 # meta-store keys (reference: src/server/meta_store.cpp:29)
 META_DATA_VERSION = "pegasus_data_version"
 META_LAST_FLUSHED_DECREE = "pegasus_last_flushed_decree"
@@ -724,18 +749,38 @@ class LsmEngine:
         the hashkey — the reference's prefix-bloom range pruning
         (src/server/hashkey_transform.h:31-60 + ReadOptions prefix_same_as_
         start), which min/max-key overlap alone cannot provide."""
-        now = epoch_now() if now is None else now
-        # snapshot-only under the engine lock: the old code SORTED and
-        # range-filtered the whole memtable inside it, so concurrent
-        # scanners convoyed on the lock (BASELINE's 4-thread-slower-than-
-        # 1-thread scan). list(dict.items()) is a plain O(n) copy; the
-        # sort/filter runs lock-free below.
+        return self._scan_over(None, start_key, stop_key, now,
+                               include_deleted, reverse, hash32)
+
+    def _scan_snapshot(self):
+        """One consistent source snapshot for a merged scan — the part of
+        scan() that must hold the engine lock. snapshot-only under it: the
+        old code SORTED and range-filtered the whole memtable inside, so
+        concurrent scanners convoyed on the lock (BASELINE's
+        4-thread-slower-than-1-thread scan). list(dict.items()) is a plain
+        O(n) copy; the sort/filter runs lock-free in _scan_over."""
         with self._lock:
             mem_items = list(self._mem.items())
             imm_items = [list(imm.items()) for imm in self._imm]
             ssts = list(self._l0)
             for lv in sorted(self._levels):
                 ssts.extend(self._levels[lv])
+        return mem_items, imm_items, ssts
+
+    def _scan_over(self, snap, start_key, stop_key, now,
+                   include_deleted=False, reverse=False, hash32=None,
+                   sst_bounds=None):
+        """The merged-scan generator over a _scan_snapshot (None = take
+        one lazily on first pull, preserving scan()'s generator
+        semantics). `sst_bounds` ({id(sst): (lo, hi)}) injects
+        pre-resolved per-SST row intervals — the device range path
+        (scan_range_batch) supplies them so the IDENTICAL merge below
+        yields byte-identical rows with the host binary searches elided;
+        absent entries mean the SST was pruned."""
+        if snap is None:
+            snap = self._scan_snapshot()
+        now = epoch_now() if now is None else now
+        mem_items, imm_items, ssts = snap
 
         def in_range(k):
             return k >= start_key and (stop_key is None or k < stop_key)
@@ -750,21 +795,32 @@ class LsmEngine:
                 yield k, v, e, d
 
         def sst_source(sst):
-            if sst.n == 0:
-                return
-            if stop_key is not None and sst.min_key and sst.min_key >= stop_key:
-                return
-            if start_key and sst.max_key and sst.max_key < start_key:
-                return
-            if hash32 is not None and not sst.maybe_contains_hash(hash32):
-                return
-            try:
-                b = sst.block()
-            except CorruptionError as e:
-                self._notify_corruption(e)
-                raise
-            lo = sst.lower_bound(start_key) if start_key else 0
-            hi = sst.lower_bound(stop_key) if stop_key is not None else b.n
+            if sst_bounds is not None:
+                lohi = sst_bounds.get(id(sst))
+                if lohi is None or lohi[0] >= lohi[1]:
+                    return  # pruned or empty interval
+                try:
+                    b = sst.block()
+                except CorruptionError as e:
+                    self._notify_corruption(e)
+                    raise
+                lo, hi = lohi
+            else:
+                if sst.n == 0:
+                    return
+                if stop_key is not None and sst.min_key and sst.min_key >= stop_key:
+                    return
+                if start_key and sst.max_key and sst.max_key < start_key:
+                    return
+                if hash32 is not None and not sst.maybe_contains_hash(hash32):
+                    return
+                try:
+                    b = sst.block()
+                except CorruptionError as e:
+                    self._notify_corruption(e)
+                    raise
+                lo = sst.lower_bound(start_key) if start_key else 0
+                hi = sst.lower_bound(stop_key) if stop_key is not None else b.n
             rng = range(hi - 1, lo - 1, -1) if reverse else range(lo, hi)
             for i in rng:
                 yield b.key(i), b.value(i), int(b.expire_ts[i]), bool(b.deleted[i])
@@ -799,6 +855,104 @@ class LsmEngine:
                 if d or check_if_ts_expired(now, e):
                     continue
             yield k, v, e
+
+    def scan_range_batch(self, ranges, now=None, reverse=False,
+                         hash32s=None) -> list:
+        """Batched bounded scans over ONE consistent snapshot: for each
+        (start_key, stop_key) in `ranges` (stop None = open end), yields
+        exactly what scan(start, stop) would — newest-wins / tombstone /
+        TTL filtered by the same merge generator — but every indexed
+        resident SST resolves its per-query lower_bound row intervals
+        device-side in ONE batched kernel dispatch per SST
+        (ops/device_lookup.py range_batch) under READ_LANE_GUARD, whose
+        fallback recomputes the same intervals with host binary search
+        over the SAME snapshot. Both paths feed identical intervals to
+        the identical generator (_scan_over), so results are
+        byte-identical by construction. reverse=True (and engines without
+        device reads) serve entirely host-side and say so in
+        read.range.{reverse_host_count,host_count}.
+
+        `now` is a scalar or per-range list (the server's range coalescer
+        groups requests that resolved their clocks independently).
+        -> list of iterators, one per range, in order."""
+        n = len(ranges)
+        if n == 0:
+            return []
+        if now is None:
+            now = epoch_now()
+        nows = list(now) if isinstance(now, (list, tuple)) else [now] * n
+        h32s = list(hash32s) if hash32s is not None else [None] * n
+        _C_RANGE_BATCH.increment()
+        snap = self._scan_snapshot()
+        device_ok = (not reverse and self._device_reads_on()
+                     and any(s.device_index is not None for s in snap[2]))
+        if not device_ok:
+            (_C_RANGE_REV_HOST if reverse else _C_RANGE_HOST).increment(n)
+            return [_count_rows(self._scan_over(
+                        snap, s, t, nows[i], False, reverse, h32s[i]))
+                    for i, (s, t) in enumerate(ranges)]
+        from ..runtime.lane_guard import READ_LANE_GUARD
+
+        bounds = READ_LANE_GUARD.run(
+            lambda: self._resolve_sst_bounds(snap[2], ranges, h32s, True),
+            lambda: self._resolve_sst_bounds(snap[2], ranges, h32s, False),
+            op="range")
+        return [_count_rows(self._scan_over(snap, s, t, nows[i], False,
+                                            False, h32s[i],
+                                            sst_bounds=bounds[i]))
+                for i, (s, t) in enumerate(ranges)]
+
+    def _resolve_sst_bounds(self, ssts, ranges, h32s, use_device) -> list:
+        """Per-(query, SST) row intervals for a range batch over a
+        snapshot. Pure function of the snapshot (the read lane's fallback
+        reruns it with use_device=False and must see the exact same
+        inputs). -> one {id(sst): (lo, hi)} dict per query; an SST absent
+        from a query's dict was pruned by exactly the host iterator's
+        metadata/bloom conditions, so _scan_over skips it identically."""
+        bounds = [dict() for _ in ranges]
+        for sst in ssts:
+            if sst.n == 0:
+                continue
+            cand = []
+            for qi, (start_key, stop_key) in enumerate(ranges):
+                if stop_key is not None and sst.min_key \
+                        and sst.min_key >= stop_key:
+                    continue
+                if start_key and sst.max_key and sst.max_key < start_key:
+                    continue
+                if h32s[qi] is not None \
+                        and not sst.maybe_contains_hash(h32s[qi]):
+                    continue
+                if not start_key and stop_key is None:
+                    # whole-run query: no bound to resolve on any path
+                    bounds[qi][id(sst)] = (0, sst.n)
+                    continue
+                cand.append(qi)
+            if not cand:
+                continue
+            dr = sst.device_index if use_device else None
+            try:
+                if dr is not None and len(cand) >= self._device_read_min:
+                    from ..ops.device_lookup import range_batch
+
+                    iv = range_batch(dr, [ranges[qi] for qi in cand])
+                    if self.table_ledger is not None:
+                        self.table_ledger.charge_device_read(len(cand))
+                    for qi, (lo, hi) in zip(cand, iv):
+                        bounds[qi][id(sst)] = (int(lo), int(hi))
+                    continue
+                for qi in cand:
+                    start_key, stop_key = ranges[qi]
+                    lo = sst.lower_bound(start_key) if start_key else 0
+                    hi = sst.lower_bound(stop_key) \
+                        if stop_key is not None else sst.n
+                    bounds[qi][id(sst)] = (lo, hi)
+            except CorruptionError as e:
+                self._notify_corruption(e)
+                raise
+        (_C_RANGE_DEVICE if use_device else _C_RANGE_HOST).increment(
+            len(ranges))
+        return bounds
 
     # ------------------------------------------------------------------ audit
 

@@ -5,6 +5,13 @@ ids carry random high bits so a stale id from before a restart/failover
 misses instead of resuming someone else's iterator (reference :100-110).
 One session keeps ONE id for its whole life (the reference's fetch/put dance
 re-inserts under the same id, :86-140); eviction is LRU, O(1) per op.
+
+Evicted/cleared sessions get their iterator CLOSED, not just dropped: the
+live generator pins the engine snapshot it was opened over (memtable
+copies, SST handles), and the range-read iterators additionally flush
+their row accounting from a ``finally`` — waiting for GC to fire those
+would hold the snapshot for an unbounded time and undercount
+``read.range.rows`` until collection.
 """
 
 import random
@@ -20,6 +27,20 @@ class ScanContext:
         self.lock = threading.Lock()  # one scan RPC at a time per context
 
 
+def _close_iterator(ctx: ScanContext) -> None:
+    """Release the session's engine snapshot now (and fire the range
+    iterators' accounting finallys). A parked session is never mid-pull
+    (fetch removes it from the cache for the duration of a scan RPC),
+    but a racing close is harmless — swallow it."""
+    close = getattr(ctx.iterator, "close", None)
+    if close is None:
+        return
+    try:
+        close()
+    except Exception:  # noqa: BLE001 — best-effort release
+        pass
+
+
 class ScanContextCache:
     def __init__(self, max_contexts: int = 1000):
         self._lock = threading.Lock()
@@ -30,6 +51,7 @@ class ScanContextCache:
 
     def put(self, ctx: ScanContext) -> int:
         """Insert (or re-insert after a fetch) keeping the session's id."""
+        evicted = []
         with self._lock:
             if ctx.id is None:
                 ctx.id = self._high_bits | self._next
@@ -37,8 +59,10 @@ class ScanContextCache:
             self._contexts[ctx.id] = ctx
             self._contexts.move_to_end(ctx.id)
             while len(self._contexts) > self._max:
-                self._contexts.popitem(last=False)
-            return ctx.id
+                evicted.append(self._contexts.popitem(last=False)[1])
+        for old in evicted:   # close outside the lock: may run finallys
+            _close_iterator(old)
+        return ctx.id
 
     def fetch(self, cid: int):
         """Remove and return (re-inserted after use via put, same id)."""
@@ -47,7 +71,9 @@ class ScanContextCache:
 
     def remove(self, cid: int):
         with self._lock:
-            self._contexts.pop(cid, None)
+            ctx = self._contexts.pop(cid, None)
+        if ctx is not None:
+            _close_iterator(ctx)
 
     def __len__(self):
         with self._lock:

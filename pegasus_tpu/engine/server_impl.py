@@ -68,6 +68,16 @@ class _ReadSlot:
         self.done = False
 
 
+class _RangeSlot:
+    __slots__ = ("rng", "now", "h32", "event", "value", "err", "done")
+
+    def __init__(self, rng, now, h32):
+        self.rng, self.now, self.h32 = rng, now, h32
+        self.event = threading.Event()
+        self.value = self.err = None
+        self.done = False
+
+
 class _ReadCoalescer:
     """Groups CONCURRENT point reads into one engine.get_batch call — the
     read-path twin of the plog's leader/follower group commit: the first
@@ -101,6 +111,15 @@ class _ReadCoalescer:
         if not self.engine._device_reads_on():
             return self.engine.get(key, now=now)
         slot = _ReadSlot(key, now)
+        self._join(slot)
+        if slot.err is not None:
+            raise slot.err
+        return slot.value
+
+    def _join(self, slot) -> None:
+        """Queue `slot` and drive the leader/follower drain until it is
+        served — the group-commit loop shared with the range twin
+        (_RangeCoalescer), which differs only in what _serve dispatches."""
         with self._lock:
             self._queue.append(slot)
         while not slot.done:
@@ -138,9 +157,6 @@ class _ReadCoalescer:
                         # slot so relinquished work doesn't wait out a
                         # 50ms poll tick
                         self._queue[0].event.set()
-        if slot.err is not None:
-            raise slot.err
-        return slot.value
 
     def _serve(self, batch) -> None:
         self._c_batch_size.set(len(batch))
@@ -154,6 +170,51 @@ class _ReadCoalescer:
             return
         for s, v in zip(batch, vals):
             s.value, s.done = v, True
+            s.event.set()
+
+
+class _RangeCoalescer(_ReadCoalescer):
+    """The _ReadCoalescer's range twin: concurrent bounded scans on the
+    same partition (multi_get hash ranges, sortkey_count, filter-free
+    scanner batches) group into ONE engine.scan_range_batch call — one
+    device interval resolve per SST per GROUP instead of per request.
+    Reverse ranges skip the queue entirely: the engine serves them
+    host-side (and counts them in read.range.reverse_host_count) anyway,
+    so there is nothing to share."""
+
+    def __init__(self, engine, max_batch: int = None):
+        super().__init__(engine, max_batch)
+        self._lock = lockrank.named_lock("read.range_coalescer")
+        self._c_batch_size = counters.percentile("read.range.batch.size")
+
+    def scan_range(self, start: bytes, stop, now: int, hash32=None,
+                   reverse: bool = False):
+        """-> the merged-scan iterator scan(start, stop) would return
+        (stop None = open end), device-resolved and group-coalesced when
+        the engine's device reads are on."""
+        if reverse or not self.engine._device_reads_on():
+            return self.engine.scan_range_batch(
+                [(start, stop)], now=now, reverse=reverse,
+                hash32s=[hash32])[0]
+        slot = _RangeSlot((start, stop), now, hash32)
+        self._join(slot)
+        if slot.err is not None:
+            raise slot.err
+        return slot.value
+
+    def _serve(self, batch) -> None:
+        self._c_batch_size.set(len(batch))
+        try:
+            its = self.engine.scan_range_batch(
+                [s.rng for s in batch], now=[s.now for s in batch],
+                hash32s=[s.h32 for s in batch])
+        except Exception as e:  # noqa: BLE001 - every waiter needs the outcome
+            for s in batch:
+                s.err, s.done = e, True
+                s.event.set()
+            return
+        for s, it in zip(batch, its):
+            s.value, s.done = it, True
             s.event.set()
 
 
@@ -200,9 +261,11 @@ class PegasusServer:
         self._c_get_latency = counters.percentile(
             self._pfx + "get_latency_us")
         # device-served reads: concurrent on_get point reads coalesce into
-        # engine.get_batch device batches (no-op passthrough when the
+        # engine.get_batch device batches, concurrent bounded scans into
+        # engine.scan_range_batch ones (no-op passthroughs when the
         # engine's device reads are off)
         self._read_coalescer = _ReadCoalescer(self.engine)
+        self._range_coalescer = _RangeCoalescer(self.engine)
         from .manual_compact_service import ManualCompactService
 
         self.manual_compact_service = ManualCompactService(self)
@@ -600,20 +663,26 @@ class PegasusServer:
         else:
             stop = key_schema.generate_next_bytes(req.hash_key)
 
-        # reverse iterates the engine descending (the reference's Prev()
-        # from the stop key), so bounded reads return the range's TAIL and
-        # the limiter budget is spent at the correct end
-        limiter = self._make_limiter()
         out, complete = [], True
         size = 0
         iterated = 0
         h32 = _hk_hash32(req.hash_key)
-        if req.reverse:
-            scan_hi = stop + b"\x00" if req.stop_inclusive else stop
-            it = self.engine.scan(start, scan_hi, now=now, reverse=True,
-                                  hash32=h32)
-        else:
-            it = self.engine.scan(start, None, now=now, hash32=h32)
+        # both directions resolve the same bounded range [start, scan_hi)
+        # through the range coalescer — device-served interval resolve for
+        # forward scans, host-walked (and counted as such) for reverse
+        scan_hi = stop + b"\x00" if req.stop_inclusive else stop
+        it = self._range_coalescer.scan_range(start, scan_hi, now,
+                                              hash32=h32,
+                                              reverse=req.reverse)
+        # reverse iterates the engine descending (the reference's Prev()
+        # from the stop key), so bounded reads return the range's TAIL and
+        # the limiter budget is spent at the correct end. The limiter
+        # starts AFTER scan_range: the device interval resolve (its cold
+        # jit especially) is bounded by the read lane's own deadline and
+        # must not eat the per-RPC iteration-time budget — the host twin
+        # pays no such setup, and byte-identity includes the
+        # complete/INCOMPLETE verdict
+        limiter = self._make_limiter()
         for k, raw, _ in it:
             if req.reverse:
                 if k == start and not req.start_inclusive:
@@ -663,10 +732,16 @@ class PegasusServer:
                                  server=self.server)
         start = key_schema.generate_key(hash_key, b"")
         stop = key_schema.generate_next_bytes(hash_key)
+        # counts resolve from the device intervals minus the host-filtered
+        # deletions: the merged iterator already applies newest-wins /
+        # tombstone / TTL, so counting its rows IS the filtered count.
+        # scan_range (the eager device resolve, jit included) runs before
+        # the limiter starts — see on_multi_get
+        it = self._range_coalescer.scan_range(start, stop, now,
+                                              hash32=_hk_hash32(hash_key))
         limiter = self._make_limiter(count_only=True)
         count = 0
-        for _ in self.engine.scan(start, stop, now=now,
-                                  hash32=_hk_hash32(hash_key)):
+        for _ in it:
             limiter.add_count()
             if not limiter.valid():
                 resp.error = Status.INCOMPLETE
@@ -727,7 +802,16 @@ class PegasusServer:
                 h32 = _hk_hash32(hk_start)
         except (ValueError, IndexError, struct.error):
             pass
-        it = self.engine.scan(start, stop, now=now, hash32=h32)
+        # the filter-free fast path (no row can be rejected server-side)
+        # routes through the range coalescer so the scanner's batches
+        # resolve their SST intervals on device; filtered scans keep the
+        # plain host iterator — their effective ranges are sparse and the
+        # per-row filters dominate anyway
+        if self._scan_filter_free(req):
+            it = self._range_coalescer.scan_range(start, stop, now,
+                                                  hash32=h32)
+        else:
+            it = self.engine.scan(start, stop, now=now, hash32=h32)
         return self._fill_scan_batch(resp, it, req, now)
 
     def _scan_row_passes(self, req, k: bytes) -> bool:

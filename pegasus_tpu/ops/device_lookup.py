@@ -27,6 +27,11 @@ Two pieces:
      lane+klen equality IS full-key equality), fenced, then resolved
      with a fixed-depth vectorized binary search. Returns each query's
      row index in the run, or -1.
+  3. A batched range kernel (`range_batch`): the same fence-bounded
+     lower_bound run over a batch of (start, stop) bounds, resolving
+     each range query to the run's contiguous row interval [lo, hi) in
+     one dispatch — the device half of engine scan_range_batch
+     (multi_get hash ranges, sortkey_count, scanner batches).
 
 The kernel returns INDICES only; the host materializes values from the
 SST's cached block exactly like the host binary search does, so the
@@ -53,6 +58,42 @@ _QUERY_MIN_BUCKET = 8  # pad query batches to pow2 buckets >= this
 _C_LOOKUPS = counters.number("read.device.lookup_count")
 _C_KEYS = counters.number("read.device.keys")
 _C_HITS = counters.number("read.device.hits")
+
+
+def _fence_lower_bound(jnp, lex_less, padded_len, w, fence_len, steps,
+                       cols, klen, fence, n, step, qcols, qklen):
+    """Trace-time shared core of the point and range kernels: fence probe
+    -> fixed-depth vectorized lower_bound over the full (prefix lanes,
+    klen) sort key. Returns each query's lower_bound row index in [0, n]
+    (n = every row < query). Runs hold the FULL key in their lanes
+    (pack_run_device refuses otherwise), so lane/klen lex order IS byte
+    order and the result matches SSTable.lower_bound exactly — including
+    for queries LONGER than the 4*w-byte window: such a query's lane
+    image ties only with rows that are proper byte prefixes of it, and
+    the klen tiebreak orders those below the query, same as bytes."""
+    q0 = qcols[0]
+    # fence window: rows before sample a-1 are < q0, rows from sample
+    # b on are > q0, so the full-key lower_bound lies in [lo, hi)
+    a = jnp.searchsorted(fence, q0, side="left").astype(jnp.int32)
+    b = jnp.searchsorted(fence, q0, side="right").astype(jnp.int32)
+    n1 = n - 1
+    lo = jnp.where(a > 0, jnp.minimum((a - 1) * step, n1), 0)
+    hi = jnp.where(b < fence_len, jnp.minimum(b * step, n1), n)
+    length = jnp.maximum(hi - lo, 0)
+    qkey = list(qcols) + [qklen]
+    for _ in range(steps):
+        half = length >> 1
+        mid = lo + half
+        midc = jnp.minimum(mid, padded_len - 1)
+        row = [jnp.take(cols[j], midc) for j in range(w)] \
+            + [jnp.take(klen, midc)]
+        less = lex_less(row, qkey)
+        active = length > 0
+        lo = jnp.where(active & less, mid + 1, lo)
+        length = jnp.where(active,
+                           jnp.where(less, length - half - 1, half),
+                           0)
+    return lo
 
 
 @functools.lru_cache(maxsize=64)
@@ -108,28 +149,9 @@ def _compiled_lookup(padded_len: int, w: int, fence_len: int, qpad: int):
     steps = max(1, padded_len.bit_length())
 
     def fn(cols, klen, fence, n, step, qcols, qklen):
-        q0 = qcols[0]
-        # fence window: rows before sample a-1 are < q0, rows from sample
-        # b on are > q0, so the full-key lower_bound lies in [lo, hi)
-        a = jnp.searchsorted(fence, q0, side="left").astype(jnp.int32)
-        b = jnp.searchsorted(fence, q0, side="right").astype(jnp.int32)
-        n1 = n - 1
-        lo = jnp.where(a > 0, jnp.minimum((a - 1) * step, n1), 0)
-        hi = jnp.where(b < fence_len, jnp.minimum(b * step, n1), n)
-        length = jnp.maximum(hi - lo, 0)
-        qkey = list(qcols) + [qklen]
-        for _ in range(steps):
-            half = length >> 1
-            mid = lo + half
-            midc = jnp.minimum(mid, padded_len - 1)
-            row = [jnp.take(cols[j], midc) for j in range(w)] \
-                + [jnp.take(klen, midc)]
-            less = lex_less(row, qkey)
-            active = length > 0
-            lo = jnp.where(active & less, mid + 1, lo)
-            length = jnp.where(active,
-                               jnp.where(less, length - half - 1, half),
-                               0)
+        lo = _fence_lower_bound(jnp, lex_less, padded_len, w, fence_len,
+                                steps, cols, klen, fence, n, step,
+                                qcols, qklen)
         safe = jnp.minimum(lo, padded_len - 1)
         eq = lo < n
         for j in range(w):
@@ -189,3 +211,63 @@ def lookup_batch(dr, keys) -> np.ndarray:
     _C_KEYS.increment(len(keys))
     _C_HITS.increment(int((rows >= 0).sum()))
     return rows
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_range(padded_len: int, w: int, fence_len: int, qpad: int):
+    """Jitted batched range resolve for one (run shape, query bucket):
+    the point kernel's fence-bounded lower_bound run TWICE — once over
+    the start keys, once over the stop keys — in one program, yielding
+    each query's contiguous row interval [lo, hi). Keyed on the padded
+    bucket lengths like _compiled_lookup so live sizes share programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from .device_sort import lex_less
+
+    steps = max(1, padded_len.bit_length())
+
+    def fn(cols, klen, fence, n, step, scols, sklen, tcols, tklen):
+        lo = _fence_lower_bound(jnp, lex_less, padded_len, w, fence_len,
+                                steps, cols, klen, fence, n, step,
+                                scols, sklen)
+        hi = _fence_lower_bound(jnp, lex_less, padded_len, w, fence_len,
+                                steps, cols, klen, fence, n, step,
+                                tcols, tklen)
+        # a stop below the start (empty/inverted range) clamps to empty
+        return jnp.stack([lo, jnp.maximum(hi, lo)])
+
+    return jax.jit(fn)
+
+
+def range_batch(dr, ranges) -> np.ndarray:
+    """Resolve each (start_key, stop_key) query against one HBM-resident
+    run: -> np.int32[(len(ranges), 2)], each row the run's contiguous
+    row interval [lo, hi) holding exactly the keys in [start, stop).
+    stop_key None means "to the end of the run". Both bounds resolve in
+    ONE kernel dispatch and ONE coalesced download per run per batch.
+    Raises on device failure — the caller (engine/db.py
+    scan_range_batch) runs this under READ_LANE_GUARD with the host
+    SSTable.lower_bound walk as the byte-identical fallback."""
+    import jax.numpy as jnp
+
+    nq = len(ranges)
+    if not nq or dr is None or dr.fence is None:
+        return np.zeros((nq, 2), np.int32)
+    starts = [s for s, _ in ranges]
+    stops = [(t if t is not None else b"") for _, t in ranges]
+    open_stop = np.fromiter((t is None for _, t in ranges),
+                            dtype=bool, count=nq)
+    with _TRACE.span("read.range", records=nq):
+        _inject("read.range")
+        scols, sklen = pack_queries(starts, dr.w)
+        tcols, tklen = pack_queries(stops, dr.w)
+        fn = _compiled_range(dr.padded_len, dr.w, dr.fence_len, len(sklen))
+        out = fn(tuple(dr.cols), dr.klen, dr.fence,
+                 jnp.int32(dr.n), jnp.int32(dr.fence_step),
+                 tuple(jnp.asarray(c) for c in scols), jnp.asarray(sklen),
+                 tuple(jnp.asarray(c) for c in tcols), jnp.asarray(tklen))
+        iv = np.asarray(out)[:, :nq].T.copy()
+    # a None stop packed as b"" would lower_bound to 0; patch to run end
+    iv[open_stop, 1] = dr.n
+    return iv

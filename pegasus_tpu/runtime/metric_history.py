@@ -57,6 +57,10 @@ _DEFAULT_PREFIXES = (
     # tenant plane (ISSUE 18): per-table ledgers + SLO burn gauges, so
     # incident windows carry the offending table's series unprompted
     "table.", "slo.",
+    # device-served range reads (ISSUE 19): batch/row totals plus the
+    # device-vs-host split — a fallback storm shows up as host_count
+    # climbing in the history window
+    "read.range.",
 )
 
 

@@ -1,12 +1,17 @@
-"""Device-served reads (ISSUE 7): HBM-resident point lookups.
+"""Device-served reads: HBM-resident point lookups (ISSUE 7) and
+fence-bounded range reads (ISSUE 19).
 
 Acceptance: device-vs-host read BYTE-IDENTITY on cpu — identical
 ReadResponse/MultiGetResponse wire bytes for mixed hit/miss/TTL-expired/
 tombstoned keys across flushed+compacted state, including a mid-read
 fallback (wedge/raise in the device probe) — plus the fence index
 unit-level contract, the HBM residency gauges, and the collector's
-read-residency drive. The read-lane chaos/breaker-isolation cases live
-in tests/test_lane_guard.py next to the compact lane's.
+read-residency drive. The range half extends the same contract to
+multi_get ranges / sortkey_count / scanner batches (forward, reverse,
+inclusivity, limits, split-pmask, boundary-dense single-hashkey runs)
+and to the `read.range` fail point. The read-lane chaos/breaker-
+isolation cases live in tests/test_lane_guard.py next to the compact
+lane's.
 """
 
 import threading
@@ -19,6 +24,7 @@ from pegasus_tpu.engine.db import EngineOptions, LsmEngine
 from pegasus_tpu.engine.server_impl import PegasusServer
 from pegasus_tpu.rpc import codec
 from pegasus_tpu.rpc import messages as msg
+from pegasus_tpu.rpc.messages import Status
 from pegasus_tpu.runtime import fail_points as fp
 from pegasus_tpu.runtime.lane_guard import READ_LANE_GUARD, LaneGuardConfig
 from pegasus_tpu.runtime.perf_counters import counters
@@ -416,3 +422,283 @@ def test_replica_stub_set_read_residency_command(tmp_path):
         assert "no replica" in stub._cmd_set_read_residency(["9.9", "on"])
     finally:
         srv.close()
+
+
+# ------------------------------------------- range reads (ISSUE 19)
+
+
+DENSE_P = b"p" * 9  # long shared sortkey prefix: composite keys agree
+#                     deep into the packed lanes (all-equal-first-lane)
+
+
+def _load_dense(engine):
+    """The range-read edge loader: ONE hash key whose sortkeys share a
+    long prefix, so every packed first lane (and several more) is EQUAL
+    and only deep lanes / the klen tiebreak discriminate — plus
+    boundary-dense neighbors (keys differing in the last byte, and
+    proper-prefix pairs exercising the klen tiebreak), TTL-expired and
+    tombstoned rows, split across L1 / L0 / memtable."""
+    for i in range(120):
+        engine.put(key_schema.generate_key(b"hx", DENSE_P + b"%04d" % i),
+                   V + b"d%d" % i)
+    # proper-prefix pair: same lanes where they overlap, klen decides
+    engine.put(key_schema.generate_key(b"hx", DENSE_P + b"0050x"), V + b"px")
+    engine.put(key_schema.generate_key(b"hx", DENSE_P + b"expired"),
+               V + b"old", expire_ts=NOW - 100)
+    engine.put(key_schema.generate_key(b"hx", DENSE_P + b"gone"), V + b"dead")
+    engine.flush()
+    engine.compact()                 # -> L1
+    engine.delete(key_schema.generate_key(b"hx", DENSE_P + b"gone"))
+    engine.put(key_schema.generate_key(b"hx", DENSE_P + b"0001"), V + b"new")
+    for i in range(120, 150):
+        engine.put(key_schema.generate_key(b"hx", DENSE_P + b"%04d" % i),
+                   V + b"d%d" % i)
+    engine.flush()                   # -> newer L0 shadowing L1
+    engine.put(key_schema.generate_key(b"hx", DENSE_P + b"zzmem"), V + b"mem")
+
+
+def _range_combos(prefix=b""):
+    """(start, stop, start_inclusive, stop_inclusive, reverse,
+    max_kv_count) sweeps: open/bounded/inverted/absent bounds, both
+    inclusivities, both directions, limited and unlimited."""
+    combos = []
+    for start, stop in ((b"", b""), (b"", prefix + b"0047"),
+                        (prefix + b"0010", prefix + b"0047"),
+                        (prefix + b"0010", b""),
+                        (prefix + b"0046x", prefix + b"0123"),  # absent bounds
+                        (prefix + b"0050", prefix + b"0050"),   # point range
+                        (prefix + b"0090", prefix + b"0010")):  # inverted
+        for si in (True, False):
+            for ti in (True, False):
+                for rev in (False, True):
+                    for maxn in (0, 5):
+                        combos.append((start, stop, si, ti, rev, maxn))
+    return combos
+
+
+def _assert_range_wire_identical(srv_on, srv_off, hash_keys, prefix=b""):
+    for hk in hash_keys:
+        assert codec.encode(srv_on.on_sortkey_count(hk, now=NOW)) == \
+            codec.encode(srv_off.on_sortkey_count(hk, now=NOW)), hk
+        for start, stop, si, ti, rev, maxn in _range_combos(prefix):
+            req = msg.MultiGetRequest(
+                hash_key=hk, sort_keys=[], max_kv_count=maxn,
+                start_sortkey=start, stop_sortkey=stop,
+                start_inclusive=si, stop_inclusive=ti, reverse=rev)
+            assert codec.encode(srv_on.on_multi_get(req, now=NOW)) == \
+                codec.encode(srv_off.on_multi_get(req, now=NOW)), \
+                (hk, start, stop, si, ti, rev, maxn)
+    assert _scan_wire(srv_on) == _scan_wire(srv_off)
+    assert _scan_wire(srv_on, batch_size=7) == \
+        _scan_wire(srv_off, batch_size=7)
+
+
+def _scan_wire(srv, **req_kw):
+    """Drain a full scanner session into normalized wire blobs (the
+    context id is a server-local session handle, not wire contract —
+    normalized to its completed/continuing sign)."""
+    out = []
+    resp = srv.on_get_scanner(msg.GetScannerRequest(**req_kw), now=NOW)
+    for _ in range(10_000):
+        out.append(codec.encode(msg.ScanResponse(
+            error=resp.error, kvs=resp.kvs,
+            context_id=min(resp.context_id, 0), app_id=resp.app_id,
+            partition_index=resp.partition_index, server=resp.server)))
+        if resp.error != Status.OK or resp.context_id < 0:
+            return out
+        resp = srv.on_scan(msg.ScanRequest(resp.context_id), now=NOW)
+    raise AssertionError("scanner session never completed")
+
+
+def test_range_responses_byte_identical_device_vs_host(tmp_path, read_guard):
+    """Acceptance (ISSUE 19): identical MultiGetResponse/CountResponse/
+    ScanResponse bytes for range reads over mixed hit/miss/TTL-expired/
+    tombstoned state — and the forward queries actually took the device
+    path while reverse ones were counted host-side."""
+    srv_on, srv_off = _server_pair(tmp_path)
+    try:
+        dev0 = counters.number("read.range.device_count").value()
+        rev0 = counters.number("read.range.reverse_host_count").value()
+        rows0 = counters.number("read.range.rows").value()
+        _assert_range_wire_identical(srv_on, srv_off,
+                                     [b"h0", b"h1", b"h2", b"zz"],
+                                     prefix=b"s0")
+        assert counters.number("read.range.device_count").value() > dev0
+        assert counters.number("read.range.reverse_host_count").value() > rev0
+        assert counters.number("read.range.rows").value() > rows0
+        assert read_guard.state()["fallbacks"] == 0
+    finally:
+        srv_on.close()
+        srv_off.close()
+
+
+def test_range_identity_dense_single_hashkey(tmp_path, read_guard):
+    """The boundary-dense edge: one hash key, equal first lanes
+    everywhere, proper-prefix sortkeys, shadowing layers — the fence
+    degenerates to near-equal samples and only deep lanes / klen
+    discriminate."""
+    srv_on, srv_off = _server_pair(tmp_path, load=_load_dense)
+    try:
+        dev0 = counters.number("read.range.device_count").value()
+        _assert_range_wire_identical(srv_on, srv_off, [b"hx"],
+                                     prefix=DENSE_P)
+        assert counters.number("read.range.device_count").value() > dev0
+    finally:
+        srv_on.close()
+        srv_off.close()
+
+
+def test_range_identity_under_split_pmask(tmp_path, read_guard):
+    """Post-split state (partition_mask > 0): the scanner's filter-free
+    fast path must correctly NOT engage (rows need the per-row partition
+    hash check) and every response stays identical to the host twin."""
+    srv_on, srv_off = _server_pair(tmp_path)
+    try:
+        for srv in (srv_on, srv_off):
+            srv.engine.opts.partition_mask = 1
+        _assert_range_wire_identical(srv_on, srv_off, [b"h0", b"h1"],
+                                     prefix=b"s0")
+    finally:
+        srv_on.close()
+        srv_off.close()
+
+
+def test_range_responses_identical_through_mid_read_fallback(tmp_path,
+                                                             read_guard):
+    """The `read.range` fail point: a raising interval resolve (retry ->
+    host fallback) and a wedged one (deadline abandon -> host fallback)
+    both serve identical bytes, and the failed attempts land in
+    host_count, not device_count."""
+    srv_on, srv_off = _server_pair(tmp_path)
+    try:
+        req = msg.MultiGetRequest(hash_key=b"h0", sort_keys=[],
+                                  start_sortkey=b"s000",
+                                  stop_sortkey=b"s040")
+        fp.cfg("read.range", "raise(transient resolve error)")
+        dev0 = counters.number("read.range.device_count").value()
+        host0 = counters.number("read.range.host_count").value()
+        assert codec.encode(srv_on.on_multi_get(req, now=NOW)) == \
+            codec.encode(srv_off.on_multi_get(req, now=NOW))
+        st = read_guard.state()
+        assert st["fallbacks"] >= 1 and st["retries"] >= 1
+        assert counters.number("read.range.device_count").value() == dev0
+        assert counters.number("read.range.host_count").value() > host0
+        fp.cfg("read.range", "off()")
+
+        # close the breaker the raise storm walked up, then wedge once:
+        # the 0.3 s deadline abandons the kernel mid-flight
+        read_guard.reset()
+        read_guard.config.deadline_s = 0.3
+        fp.cfg("read.range", "1*sleep(1500)")
+        assert codec.encode(srv_on.on_multi_get(req, now=NOW)) == \
+            codec.encode(srv_off.on_multi_get(req, now=NOW))
+        st = read_guard.state()
+        assert st["deadline_abandons"] == 1
+        assert "read.range" in st["last_failure"]["error"]  # attribution
+    finally:
+        srv_on.close()
+        srv_off.close()
+
+
+def test_concurrent_ranges_coalesce_and_match(tmp_path, read_guard):
+    """Concurrent range reads group through the server's range coalescer
+    into one scan_range_batch; every response still matches the
+    host-served twin."""
+    srv_on, srv_off = _server_pair(tmp_path)
+    try:
+        reqs = []
+        for i in range(0, 40, 4):
+            reqs.append(msg.MultiGetRequest(
+                hash_key=b"h%d" % (i % 3), sort_keys=[],
+                start_sortkey=b"s%03d" % i, stop_sortkey=b"s%03d" % (i + 9)))
+        expected = [codec.encode(srv_off.on_multi_get(r, now=NOW))
+                    for r in reqs]
+        batch0 = counters.number("read.range.batch_count").value()
+        errors = []
+
+        def worker(t):
+            try:
+                for i, (r, want) in enumerate(zip(reqs, expected)):
+                    if (i + t) % 2 == 0:
+                        assert codec.encode(
+                            srv_on.on_multi_get(r, now=NOW)) == want
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # grouping cut the engine calls below the request count (30 range
+        # reads issued; followers ride the leader's batch), and the batch
+        # size histogram recorded the groups
+        served = sum(1 for t in range(6) for i in range(len(reqs))
+                     if (i + t) % 2 == 0)
+        assert counters.number("read.range.batch_count").value() - batch0 \
+            <= served
+        assert counters.percentile(
+            "read.range.batch.size").percentiles()["p50"] >= 1
+    finally:
+        srv_on.close()
+        srv_off.close()
+
+
+def test_scan_context_eviction_closes_iterator():
+    """An evicted or cleared scan session releases its engine snapshot
+    NOW — iterator.close() fires the generator's finally (where the
+    range iterators flush read.range.rows) instead of waiting on GC."""
+    from pegasus_tpu.engine.scan_context import (ScanContext,
+                                                 ScanContextCache)
+
+    closed = []
+
+    def gen(tag):
+        try:
+            yield tag
+        finally:
+            closed.append(tag)
+
+    cache = ScanContextCache(max_contexts=2)
+    ctxs = [ScanContext(gen(i), None) for i in range(3)]
+    for c in ctxs:
+        next(c.iterator)            # enter the body so finally is armed
+    ids = [cache.put(c) for c in ctxs]
+    assert closed == [0]            # LRU overflow closed the oldest
+    cache.remove(ids[1])
+    assert closed == [0, 1]         # explicit clear_scanner closes too
+    assert cache.fetch(ids[2]) is ctxs[2]
+    assert closed == [0, 1]         # the live session untouched
+
+
+def test_range_batch_intervals_match_host_lower_bound(tmp_path):
+    """Unit contract of the kernel: for arbitrary (start, stop) byte
+    strings — present, absent, open, inverted, longer than the packed
+    lane window — the device interval equals the host lower_bound pair
+    (clamped to hi >= lo)."""
+    from pegasus_tpu.ops.device_lookup import range_batch
+
+    eng = LsmEngine(str(tmp_path / "db"), _engine_opts(device_reads=True))
+    try:
+        _load_dense(eng)
+        ssts = [s for s in _prime_all(eng) if s.device_index is not None]
+        assert ssts
+        sst = max(ssts, key=lambda s: s.n)
+        block = sst.block()
+        k = [block.key(i) for i in range(block.n)]
+        ranges = [(b"", None), (b"", k[3]), (k[2], k[-2]),
+                  (k[5] + b"\x00", k[9] + b"zz"),        # absent bounds
+                  (k[-1] + b"\xff", None),               # past the end
+                  (k[9], k[2]),                          # inverted
+                  (k[4], k[4]),                          # empty point
+                  (k[0] + b"longer-than-any-lane-window" * 3, None)]
+        iv = range_batch(sst.device_index, ranges)
+        for (start, stop), (lo, hi) in zip(ranges, iv):
+            want_lo = sst.lower_bound(start)
+            want_hi = sst.n if stop is None else sst.lower_bound(stop)
+            assert (int(lo), int(hi)) == (want_lo, max(want_hi, want_lo)), \
+                (start, stop)
+    finally:
+        eng.close()

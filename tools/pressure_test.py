@@ -3,8 +3,11 @@ engine (ISSUE 11's production-sim harness).
 
 The reference's src/test/pressure_test + kill_test tiers in one driver: a
 load generator holding a TARGET qps against a cluster with a configurable
-op mix, writing SELF-CHECKING rows (value derived from key) so every read
-verifies itself, while (optionally) a scripted fault schedule runs
+op mix (point gets, RANGE reads — bounded multi_gets plus a periodic
+full-table unordered-scanner sweep, exercising the device-served range
+path under faults — and writes), writing SELF-CHECKING rows (value
+derived from key) so every read verifies itself, while (optionally) a
+scripted fault schedule runs
 node kills, group-worker kills, remote fail-point wedges, a mid-load
 partition split, a balancer primary move, compaction-scheduler token
 flips and a duplication leg to a second cluster — all under periodic
@@ -96,6 +99,16 @@ def _parse_args(argv=None):
     ap.add_argument("--seconds", type=float, default=30.0)
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--read-pct", type=int, default=50)
+    ap.add_argument("--scan-pct", type=int, default=10,
+                    help="share of ops that are RANGE reads — a bounded "
+                         "multi_get over the hash key's sortkey range, "
+                         "carved out of the write share — so the "
+                         "device-served range path (ISSUE 19) runs under "
+                         "node kills, splits and audits; also enables a "
+                         "periodic full-table unordered-scanner sweep on "
+                         "thread 0 (every row self-verifies, with re-read "
+                         "verification before anything counts); 0 "
+                         "disables both")
     ap.add_argument("--key-space", type=int, default=100_000)
     ap.add_argument("--tables", type=int, default=1,
                     help="number of tables to load (table, table2..tableN; "
@@ -317,7 +330,8 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
     per_thread_qps = args.qps / args.threads
     interval = 1.0 / per_thread_qps if per_thread_qps > 0 else 0
     next_fire = time.time()
-    local = {"reads": 0, "writes": 0, "errors_in_window": 0,
+    local = {"reads": 0, "writes": 0, "scans": 0, "sweeps": 0,
+             "sweep_rows": 0, "errors_in_window": 0,
              "errors_steady": 0, "recovered_reads": 0,
              "verify_failures": 0, "not_found": 0}
 
@@ -343,19 +357,87 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
         finally:
             lat.add((time.perf_counter() - t0) * 1000)
 
-    def reread(hk, attempts=5, delay=0.2):
+    def reread(hk, attempts=5, delay=0.2, op=None):
         """-> (ok, value): retry a read past transient routing blips
-        before concluding anything about the key."""
+        before concluding anything about the key. `op` replays a
+        NON-point read (the scan leg re-verifies through the same range
+        path it failed on); default is the point get."""
         for _ in range(attempts):
             time.sleep(delay)
             try:
-                return True, cli.get(hk, b"s")
+                return True, cli.get(hk, b"s") if op is None else op()
             except PegasusError:
                 continue
         return False, None
 
+    def verify_row(hk, i, v, was_written):
+        """Self-check one read result (shared by the point-get and the
+        range-scan legs — byte-identity means the SAME row must come
+        back either way)."""
+        if v is None:
+            if was_written:
+                # an acked write must be readable; re-read before
+                # declaring it lost (routing may still be settling)
+                ok, v2 = reread(hk, attempts=3, delay=0.3)
+                if v2 == expected_value(hk):
+                    local["recovered_reads"] += 1
+                else:
+                    local["verify_failures"] += 1
+                    journal.record("verify.lost", key=i, thread=tid)
+            else:
+                local["not_found"] += 1
+        elif v != expected_value(hk):
+            local["verify_failures"] += 1
+            journal.record("verify.corrupt", key=i, thread=tid)
+
+    def range_read(hk):
+        """The scan-leg op: a bounded multi_get RANGE ((start, stop]
+        resolved through scan_range_batch server-side) that must surface
+        the one self-verifying b\"s\" row. Untimed — the first attempt
+        wraps it in timed(), rereads replay it raw."""
+        _, kvs = cli.multi_get(hk, None, 0, 0, start_sortkey=b"",
+                               stop_sortkey=b"t", stop_inclusive=True)
+        return kvs.get(b"s")
+
+    def sweep():
+        """Full-table unordered-scanner sweep over the primary table:
+        every surviving row must self-verify while the chaos schedule
+        runs. Values are key-derived and never overwritten, so a
+        mismatch is corruption, not a race — but it still gets one
+        point-get re-read before it counts (a scanner batch fetched
+        mid-failover is retried internally, this guards the residue)."""
+        rows = 0
+        scanners = []
+        try:
+            scanners = clis[0].get_unordered_scanners(batch_size=500)
+            for sc in scanners:
+                for h, s, val in sc:
+                    rows += 1
+                    if s != b"s" or val == expected_value(h):
+                        continue
+                    ok, v2 = reread(h, attempts=3, delay=0.3)
+                    if v2 != expected_value(h):
+                        local["verify_failures"] += 1
+                        journal.record("verify.sweep_corrupt",
+                                       key=h.decode("latin-1"), thread=tid)
+        except PegasusError as e:
+            classify_error(journal.now(), "sweep", repr(e))
+            return
+        finally:
+            for sc in scanners:
+                sc.close()
+        local["sweeps"] += 1
+        local["sweep_rows"] += rows
+
+    next_sweep = time.time() + 10.0 if (tid == 0 and args.scan_pct) \
+        else float("inf")
+
     while time.time() < stop_at:
         now = time.time()
+        if now >= next_sweep:
+            sweep()
+            next_sweep = time.time() + 10.0
+            next_fire = time.time()  # don't burst-repay the sweep time
         if interval and now < next_fire:
             time.sleep(min(interval, next_fire - now))
             continue
@@ -374,7 +456,8 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
             cli = clis[t_idx]
             hk = b"%s:pres%07d" % (tables[t_idx].encode(), i)
             local_tables[tables[t_idx]] += 1
-        if rng.randrange(100) < args.read_pct:
+        roll = rng.randrange(100)
+        if roll < args.read_pct:
             # snapshot BEFORE the read: a write completing between
             # the get and a later check would fake a lost write
             with written_lock:
@@ -392,21 +475,21 @@ def _worker(tid, args, meta_addr, stop_at, stats, stats_lock, lat,
                     continue
                 local["recovered_reads"] += 1
             local["reads"] += 1
-            if v is None:
-                if was_written:
-                    # an acked write must be readable; re-read before
-                    # declaring it lost (routing may still be settling)
-                    ok, v2 = reread(hk, attempts=3, delay=0.3)
-                    if v2 == expected_value(hk):
-                        local["recovered_reads"] += 1
-                    else:
-                        local["verify_failures"] += 1
-                        journal.record("verify.lost", key=i, thread=tid)
-                else:
-                    local["not_found"] += 1
-            elif v != expected_value(hk):
-                local["verify_failures"] += 1
-                journal.record("verify.corrupt", key=i, thread=tid)
+            verify_row(hk, i, v, was_written)
+        elif roll < args.read_pct + args.scan_pct:
+            with written_lock:
+                was_written = hk in written
+            try:
+                v = timed(range_read, hk)
+            except PegasusError as e:
+                t_err = journal.now()
+                ok, v = reread(hk, op=lambda: range_read(hk))
+                if not ok:
+                    classify_error(t_err, "multi_get_range", repr(e))
+                    continue
+                local["recovered_reads"] += 1
+            local["scans"] += 1
+            verify_row(hk, i, v, was_written)
         else:
             try:
                 timed(cli.set, hk, b"s", expected_value(hk))
@@ -468,7 +551,8 @@ def run_pressure(argv=None) -> int:
             for extra in tables[1:]:
                 box.cluster.create(extra, partitions=8).close()
 
-        stats = {"reads": 0, "writes": 0, "errors_in_window": 0,
+        stats = {"reads": 0, "writes": 0, "scans": 0, "sweeps": 0,
+                 "sweep_rows": 0, "errors_in_window": 0,
                  "errors_steady": 0, "recovered_reads": 0,
                  "verify_failures": 0, "not_found": 0}
         stats_lock = threading.Lock()
@@ -539,7 +623,7 @@ def run_pressure(argv=None) -> int:
                            node=victim, declared=False)
         journal.record("load.start", qps=args.qps, seconds=args.seconds,
                        threads=args.threads, read_pct=args.read_pct,
-                       scenario=args.scenario)
+                       scan_pct=args.scan_pct, scenario=args.scenario)
         t_start = time.time()
         stop_at = t_start + args.seconds
         if runner is not None:
@@ -645,7 +729,7 @@ def run_pressure(argv=None) -> int:
                          count=stats["errors_steady"],
                          detail="errors outside any declared fault window")
 
-        total_ops = stats["reads"] + stats["writes"]
+        total_ops = stats["reads"] + stats["writes"] + stats["scans"]
         failures = journal.failures
         detail = {**stats, "elapsed_s": round(elapsed, 1),
                   "avg_ms": lat.avg(), "p95_ms": lat.percentile(0.95),
@@ -666,7 +750,8 @@ def run_pressure(argv=None) -> int:
             detail["incident"] = incident_box[0]
         print(json.dumps({
             "metric": f"pressure test achieved qps (target {args.qps}, "
-                      f"{args.read_pct}% reads, {args.threads} threads, "
+                      f"{args.read_pct}% reads, {args.scan_pct}% scans, "
+                      f"{args.threads} threads, "
                       f"scenario {args.scenario})",
             "value": round(total_ops / elapsed, 1),
             "unit": "ops/s",
