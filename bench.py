@@ -539,6 +539,8 @@ def _arm_watchdog():
                 _emit(_ycsb_degraded(f"watchdog fired after {budget}s"))
             elif os.environ.get("PEGASUS_BENCH_MODE") == "learn":
                 _emit(_learn_degraded(f"watchdog fired after {budget}s"))
+            elif os.environ.get("PEGASUS_BENCH_MODE") == "native":
+                _emit(_native_degraded(f"watchdog fired after {budget}s"))
             else:
                 n_total, n_runs, value_size, _ = _bench_params()
                 _emit(_degraded(n_total, n_runs, value_size,
@@ -1056,6 +1058,188 @@ def ycsb_main():
     _emit(result)
 
 
+# ----------------------------------------------------------- native A/B
+
+# the native read data plane's attribution series (ISSUE 20): totals are
+# deltas across each run so the A/B legs are cleanly separable
+_NATIVE_COUNTERS = ("native.wave_count", "native.batch_frames",
+                    "native.writev_count", "native.writev_bytes",
+                    "native.sst_mmap_count")
+
+
+def _native_metric_name() -> str:
+    records, n_ops, n_threads, partitions, value_size = _ycsb_params()
+    return (f"YCSB-C read-only ops/sec with PEGASUS_NATIVE=1 "
+            f"(A/B vs =0 over mixes b/c/e + pipelined batch_get; "
+            f"{records} records, {n_ops} ops, "
+            f"{n_threads} threads, {partitions} partitions, "
+            f"value={value_size}B)")
+
+
+def _native_degraded(reason: str, detail: dict = None) -> dict:
+    d = {"degraded": True, "reason": reason}
+    d.update(detail or {})
+    return {"metric": _native_metric_name(), "value": None, "unit": "ops/s",
+            "vs_baseline": None, "detail": d}
+
+
+def _native_pipelined_leg(box, records, n_ops, n_threads, value):
+    """Pipelined point-read leg for the native A/B. The YCSB mixes issue
+    one blocking call per thread at a time, so no multi-frame wave ever
+    reaches a connection and the binned-dispatch / vectored-reply stages
+    sit idle (their counters flatline in both legs). This leg drives
+    `PegasusClient.batch_get` — 32 keys per wave per thread — which is
+    exactly the shape the C plane amortizes: the client send is one
+    vectored sendmsg, the server bins the hot RPC_GET wave into one
+    `on_get_batch`, and the replies leave as one vectored write.
+    Self-checking: every read verifies the loaded value."""
+    import random
+
+    from pegasus_tpu.client import MetaResolver, PegasusClient
+    from pegasus_tpu.runtime.tasking import spawn_thread
+
+    wave_keys = 32
+    load_cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+    for i in range(records):
+        load_cli.set(b"user%012d" % i, b"f0", value)
+    load_cli.close()
+
+    done = [0] * n_threads
+    errors = [0] * n_threads
+
+    def worker(tid):
+        rng = random.Random(0xBA7C4 + tid)
+        cli = PegasusClient(MetaResolver([box.meta_addr], "ycsb"))
+        try:
+            per = n_ops // n_threads
+            while done[tid] < per:
+                items = [(b"user%012d" % rng.randrange(records), b"f0")
+                         for _ in range(min(wave_keys, per - done[tid]))]
+                vals = cli.batch_get(items)
+                errors[tid] += sum(1 for v in vals if v != value)
+                done[tid] += len(items)
+        finally:
+            cli.close()
+
+    t0 = time.perf_counter()
+    threads = [spawn_thread(worker, tid, daemon=False, start=False)
+               for tid in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    run_s = time.perf_counter() - t0
+    ops = sum(done)
+    return {"ops_s": round(ops / max(run_s, 1e-9), 1),
+            "run_s": round(run_s, 2), "errors": sum(errors)}
+
+
+def native_main():
+    """PEGASUS_BENCH_MODE=native: the native-read-data-plane A/B
+    (ISSUE 20, BENCH_native artifact). The SAME YCSB workload runs with
+    PEGASUS_NATIVE=0 (pure-Python frame loop, per-frame sendall, copying
+    SST reads) then =1 (C binned dispatch waves, vectored sendmsg
+    replies, zero-copy mmap SST sections) for each of the read-heavy
+    mixes b (95/5), c (read-only) and e (short-scan), plus a PIPELINED
+    batch_get leg that actually forms multi-frame waves (the blocking
+    YCSB threads never do) — fresh onebox per leg, both legs
+    byte-identical on the wire (test-enforced). Each side scores its
+    best of PEGASUS_BENCH_NATIVE_REPS interleaved reps (a discarded
+    warmup leg eats the jit compiles first). Emits ONE
+    json line: value = mix c's native-on ops/s, vs_baseline = mix c's
+    on/off ratio, detail.mixes the full grid with per-stage native.*
+    counter deltas attributing where the native plane actually ran.
+    Host-only (JAX_PLATFORMS=cpu): no TPU lease needed."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _enable_compile_cache()
+    records, n_ops, n_threads, partitions, value_size = _ycsb_params()
+    from pegasus_tpu.runtime.perf_counters import counters
+
+    from tools._onebox import Onebox
+
+    host_start = _host_info()
+    value = os.urandom(value_size)
+    prior = os.environ.get("PEGASUS_NATIVE")
+    reps = int(os.environ.get("PEGASUS_BENCH_NATIVE_REPS", 3))
+    mixes = {}
+
+    def run_leg(mix, nat):
+        os.environ["PEGASUS_NATIVE"] = nat
+        # fresh latency windows per leg: the percentile counters
+        # are process-global and would otherwise blend the runs
+        counters.remove("bench.ycsb.read_latency_us")
+        counters.remove("bench.ycsb.update_latency_us")
+        counters.remove("bench.ycsb.scan_latency_us")
+        counters.remove("bench.ycsb.insert_latency_us")
+        base = {name: counters.rate(name).total()
+                for name in _NATIVE_COUNTERS}
+        box = Onebox("ycsb", partitions=partitions)
+        try:
+            if mix == "pipelined":
+                stats = _native_pipelined_leg(
+                    box, records, n_ops, n_threads, value)
+            else:
+                read_frac = {"b": 0.95, "c": 1.0, "e": 0.95}[mix]
+                stats = _ycsb_load_and_run(
+                    box, records, n_ops, n_threads, value,
+                    read_frac=read_frac, scan_mix=mix == "e")
+        finally:
+            box.stop()
+        leg = {
+            "ops_s": stats["ops_s"],
+            "run_s": stats["run_s"],
+            "errors": stats["errors"],
+            "native_counters": {
+                name: counters.rate(name).total() - base[name]
+                for name in _NATIVE_COUNTERS},
+        }
+        if "client_latency_us" in stats:
+            leg["client_latency_us"] = stats["client_latency_us"]
+        print(f"native A/B: mix={mix} PEGASUS_NATIVE={nat} -> "
+              f"{stats['ops_s']} ops/s (errors={stats['errors']})",
+              file=sys.stderr, flush=True)
+        return leg
+
+    try:
+        # discarded warmup leg: the first onebox in a process eats the
+        # jit compiles and thread-pool spin-up; neither side should
+        run_leg("c", "0")
+        for mix in ("b", "c", "e", "pipelined"):
+            # identical legs vary ±25% on a loaded 1-cpu host, so a
+            # single-shot A/B is noise: interleave off/on reps (drift
+            # hits both sides alike) and score each side by its best
+            # rep — the run least disturbed by the host
+            legs = {"0": [], "1": []}
+            for _ in range(reps):
+                for nat in ("0", "1"):
+                    legs[nat].append(run_leg(mix, nat))
+            entry = {}
+            for nat in ("0", "1"):
+                best = max(legs[nat], key=lambda leg: leg["ops_s"])
+                best["rep_ops_s"] = [leg["ops_s"] for leg in legs[nat]]
+                entry["on" if nat == "1" else "off"] = best
+            entry["ratio"] = round(
+                entry["on"]["ops_s"] / max(entry["off"]["ops_s"], 1e-9), 3)
+            mixes[mix] = entry
+    finally:
+        if prior is None:
+            os.environ.pop("PEGASUS_NATIVE", None)
+        else:
+            os.environ["PEGASUS_NATIVE"] = prior
+    _emit({
+        "metric": _native_metric_name(),
+        "value": mixes["c"]["on"]["ops_s"],
+        "unit": "ops/s",
+        "vs_baseline": mixes["c"]["ratio"],
+        "detail": {
+            "mixes": mixes,
+            "records": records, "ops": n_ops, "threads": n_threads,
+            "partitions": partitions, "value_size": value_size,
+            "host": {"start": host_start, "end": _host_info()},
+        },
+    })
+
+
 def _learn_params():
     """(records, value_size) for PEGASUS_BENCH_MODE=learn — single
     source for the lane, the watchdog and the crash handler so a
@@ -1455,6 +1639,9 @@ if __name__ == "__main__":
         elif _mode == "offload":
             _arm_watchdog()
             offload_main()
+        elif _mode == "native":
+            _arm_watchdog()
+            native_main()
         else:
             main()
     except Exception as e:  # noqa: BLE001 - the driver needs a JSON line, always
@@ -1468,6 +1655,8 @@ if __name__ == "__main__":
                 _emit(_learn_degraded(f"bench crashed: {e!r}"))
             elif _mode == "offload":
                 _emit(_offload_degraded(f"bench crashed: {e!r}"))
+            elif _mode == "native":
+                _emit(_native_degraded(f"bench crashed: {e!r}"))
             else:
                 n_total, n_runs, value_size, _ = _bench_params()
                 _emit(_degraded(n_total, n_runs, value_size,

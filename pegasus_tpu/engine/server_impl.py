@@ -111,18 +111,42 @@ class _ReadCoalescer:
         if not self.engine._device_reads_on():
             return self.engine.get(key, now=now)
         slot = _ReadSlot(key, now)
-        self._join(slot)
+        self._join_many([slot])
         if slot.err is not None:
             raise slot.err
         return slot.value
 
-    def _join(self, slot) -> None:
-        """Queue `slot` and drive the leader/follower drain until it is
-        served — the group-commit loop shared with the range twin
-        (_RangeCoalescer), which differs only in what _serve dispatches."""
+    def get_many(self, keys, now: int):
+        """Batch point reads from ONE caller thread (the native dispatch
+        batch, ISSUE 20): the whole wave joins the coalescer as a slot
+        GROUP — it merges with concurrent readers' slots into shared
+        device batches, and parks a single connection thread instead of
+        one thread per key. Raises the first slot error (the caller
+        treats the wave as one read against one snapshot)."""
+        if not keys:
+            return []
+        if not self.engine._device_reads_on():
+            return self.engine.get_batch(keys, now=[now] * len(keys))
+        slots = [_ReadSlot(k, now) for k in keys]
+        self._join_many(slots)
+        out = []
+        for s in slots:
+            if s.err is not None:
+                raise s.err
+            out.append(s.value)
+        return out
+
+    def _join_many(self, slots) -> None:
+        """Queue every slot and drive the leader/follower drain until ALL
+        are served — the group-commit loop shared with the range twin
+        (_RangeCoalescer), which differs only in what _serve dispatches.
+        Leadership rules are unchanged from the single-slot form: claim
+        the drain when free, serve at most MAX_LEADER_ROUNDS batches past
+        the round where every OWN slot is done, hand off on exit."""
         with self._lock:
-            self._queue.append(slot)
-        while not slot.done:
+            self._queue.extend(slots)
+        while not all(s.done for s in slots):
+            pending = next(s for s in slots if not s.done)
             with self._lock:
                 lead = not self._draining and bool(self._queue)
                 if lead:
@@ -133,9 +157,9 @@ class _ReadCoalescer:
                 # A poke without a result (leader handoff) clears the
                 # event so the next park actually waits — slot.done, not
                 # the event, is the loop's truth
-                slot.event.wait(0.05)
-                if not slot.done:
-                    slot.event.clear()
+                pending.event.wait(0.05)
+                if not pending.done:
+                    pending.event.clear()
                 continue
             try:
                 rounds = 0
@@ -147,7 +171,8 @@ class _ReadCoalescer:
                         break
                     self._serve(batch)
                     rounds += 1
-                    if slot.done and rounds >= self.MAX_LEADER_ROUNDS:
+                    if (rounds >= self.MAX_LEADER_ROUNDS
+                            and all(s.done for s in slots)):
                         break
             finally:
                 with self._lock:
@@ -197,7 +222,7 @@ class _RangeCoalescer(_ReadCoalescer):
                 [(start, stop)], now=now, reverse=reverse,
                 hash32s=[hash32])[0]
         slot = _RangeSlot((start, stop), now, hash32)
-        self._join(slot)
+        self._join_many([slot])
         if slot.err is not None:
             raise slot.err
         return slot.value
@@ -595,6 +620,43 @@ class PegasusServer:
             self.table_ledger.charge_read(elapsed_us, size)
         self._check_slow_query("get", hk, elapsed_us)
         return resp
+
+    def on_get_batch(self, keys, now: int = None) -> list:
+        """on_get over a native dispatch batch (ISSUE 20): ONE coalescer
+        slot-group join (or one engine.get_batch when device reads are
+        off) serves the whole wave, then the per-key bookkeeping runs
+        exactly as on_get runs it — same counters, same CU charges, same
+        abnormal-size/slow-query tracing, byte-identical ReadResponses.
+        Latency samples share the batch's elapsed time (the wave IS one
+        storage operation)."""
+        t0 = time.perf_counter()
+        now = epoch_now() if now is None else now
+        raws = self._read_coalescer.get_many(keys, now)
+        out = []
+        elapsed_us = int((time.perf_counter() - t0) * 1e6)
+        for key, raw in zip(keys, raws):
+            resp = msg.ReadResponse(app_id=self.app_id,
+                                    partition_index=self.pidx,
+                                    server=self.server)
+            if raw is None:
+                resp.error = Status.NOT_FOUND
+            else:
+                resp.value = self._schema.extract_user_data(raw)
+            try:
+                hk, _ = key_schema.restore_key(key)
+            except ValueError:
+                hk = key  # malformed client key: still account, never raise
+            self.cu_calculator.add_get_cu(hk, key, resp.value)
+            size = len(key) + len(resp.value)
+            self._check_abnormal_size("get", hk, size,
+                                      self._abnormal_get_size)
+            self._c_get_qps.increment()
+            self._c_get_latency.set(elapsed_us)
+            if self.table_ledger is not None:
+                self.table_ledger.charge_read(elapsed_us, size)
+            self._check_slow_query("get", hk, elapsed_us)
+            out.append(resp)
+        return out
 
     def _check_abnormal_size(self, op: str, hash_key: bytes, size: int,
                              size_thr: int, rows: int = 0,

@@ -18,8 +18,12 @@ import struct
 import numpy as np
 
 from .block import KVBlock
+from ..runtime.perf_counters import counters
 
 MAGIC = b"PGTS1\n"
+
+# zero-copy mmap loads (ISSUE 20): flatlines when PEGASUS_NATIVE=0
+_C_SST_MMAP = counters.rate("native.sst_mmap_count")
 
 
 class CorruptionError(ValueError):
@@ -201,7 +205,13 @@ def _read_section(f, path: str, base: int, name: str, sec: dict) -> bytes:
 
 
 def read_sst(path: str) -> tuple:
-    """-> (KVBlock, header dict)."""
+    """-> (KVBlock, header dict). With PEGASUS_NATIVE on (the default)
+    uncompressed sections are ZERO-COPY views over an mmap of the file
+    (ISSUE 20); with the knob off, the classic read()+copy path."""
+    from .. import native
+
+    if native.native_on():
+        return _read_sst_mmap(path)
     with open(path, "rb") as f:
         header = _read_header_open(f, path)
         base = f.tell()
@@ -220,6 +230,71 @@ def read_sst(path: str) -> tuple:
             except (ValueError, TypeError) as e:
                 raise CorruptionError(
                     path, f"section {name} unmaterializable: {e}") from e
+    return KVBlock(**cols), header
+
+
+def _read_sst_mmap(path: str) -> tuple:
+    """read_sst's zero-copy twin: ONE mmap of the whole file, each
+    uncompressed section materialized as an np.frombuffer view over the
+    mapping — no f.read() double copy, and page-cache pages are shared
+    across processes opening the same SST.
+
+    Lifetime: every view's .base chain pins the memoryview, which pins
+    the mmap object, which holds the kernel mapping open — and a mapped
+    inode's data stays valid after the path is UNLINKED (compaction
+    removes its inputs while readers may still hold their blocks). So a
+    block loaded here stays readable for exactly as long as any of its
+    arrays is referenced, file deletion notwithstanding — the lifetime
+    regression test in test_native_dataplane.py pins this. The views are
+    read-only (ACCESS_READ), which is safe because SST-loaded blocks are
+    never mutated in place: compaction's in-place rewrites
+    (_rewrite_expire / _apply_default_ttl) only touch freshly gathered
+    output blocks. zlib-compressed sections decompress into fresh bytes
+    as before (nothing to alias).
+    """
+    import mmap
+    import zlib
+
+    with open(path, "rb") as f:
+        header = _read_header_open(f, path)
+        base = f.tell()
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError) as e:  # empty / unmappable file
+            raise CorruptionError(path, f"unmappable: {e}") from e
+    _C_SST_MMAP.increment()
+    mv = memoryview(mm)
+    cols = {}
+    for name, _ in _COLUMNS:
+        try:
+            sec = header["sections"][name]
+        except (KeyError, TypeError) as e:
+            raise CorruptionError(
+                path, f"header missing section {name}") from e
+        off, n = base + sec["offset"], sec["nbytes"]
+        if off < base or n < 0 or off + n > len(mm):
+            raise CorruptionError(
+                path, f"section {name} truncated "
+                      f"({max(0, len(mm) - off)}/{n} bytes)")
+        stored = mv[off:off + n]
+        want = sec.get("crc32")
+        if want is not None and (zlib.crc32(stored) & 0xFFFFFFFF) != want:
+            raise CorruptionError(
+                path, f"section {name} crc32 mismatch "
+                      f"(stored {want:#010x}, "
+                      f"computed {zlib.crc32(stored) & 0xFFFFFFFF:#010x})")
+        if sec.get("compression", "none") == "zlib":
+            try:
+                stored = zlib.decompress(stored)
+            except zlib.error as e:
+                raise CorruptionError(
+                    path, f"section {name} undecompressable: {e}") from e
+        try:
+            cols[name] = np.frombuffer(
+                stored, dtype=np.dtype(sec["dtype"])).reshape(sec["shape"])
+        except (ValueError, TypeError) as e:
+            raise CorruptionError(
+                path, f"section {name} unmaterializable: {e}") from e
     return KVBlock(**cols), header
 
 

@@ -97,6 +97,15 @@ def available() -> bool:
     return _load() is not None
 
 
+def native_on() -> bool:
+    """The one knob for the native read data plane (ISSUE 20):
+    ``PEGASUS_NATIVE=0`` forces the byte-identical pure-Python twins for
+    frame dispatch, vectored reply writes, and mmap SST reads. Read live
+    per call (not cached) so a test or bench A/B can flip it in-process
+    between connections."""
+    return os.environ.get("PEGASUS_NATIVE", "1") != "0"
+
+
 # ------------------------------------------------------------- fastcodec
 # The RPC wire codec's C interpreter (fastcodec.c): a true CPython
 # extension (needs Python.h, unlike hostops' plain ctypes), compiled on

@@ -28,6 +28,11 @@
 #include <stdint.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
+
+#ifndef MSG_NOSIGNAL
+#define MSG_NOSIGNAL 0
+#endif
 
 static PyObject *CodecError; /* set by register_error(); fallback ValueError */
 
@@ -650,24 +655,36 @@ static PyTypeObject Plan_Type = {
  * touching Python until the finished (header, body) list is returned.
  */
 
+#define FR_MAX_HOT 8 /* hot-code bins per reader (3 used today) */
+
 typedef struct {
     PyObject_HEAD
     PlanObject *plan;   /* RpcHeader plan (strong) */
+    PyObject *hot;      /* tuple of hot code strs (strong), may be NULL */
     unsigned char *buf; /* unparsed bytes */
     Py_ssize_t len, cap, pos;
 } FrameReaderObject;
 
+static PyObject *str_code; /* interned "code" attr name (module init) */
+
 static PyObject *FrameReader_new(PyTypeObject *type, PyObject *args,
                                  PyObject *kw)
 {
-    PyObject *plan;
-    if (!PyArg_ParseTuple(args, "O!", &Plan_Type, &plan))
+    PyObject *plan, *hot = NULL;
+    if (!PyArg_ParseTuple(args, "O!|O!", &Plan_Type, &plan, &PyTuple_Type,
+                          &hot))
         return NULL;
+    if (hot && PyTuple_GET_SIZE(hot) > FR_MAX_HOT) {
+        RAISE("too many hot codes");
+        return NULL;
+    }
     FrameReaderObject *self = (FrameReaderObject *)type->tp_alloc(type, 0);
     if (!self)
         return NULL;
     Py_INCREF(plan);
     self->plan = (PlanObject *)plan;
+    Py_XINCREF(hot);
+    self->hot = hot;
     self->buf = NULL;
     self->len = self->cap = self->pos = 0;
     return (PyObject *)self;
@@ -676,6 +693,7 @@ static PyObject *FrameReader_new(PyTypeObject *type, PyObject *args,
 static void FrameReader_dealloc(FrameReaderObject *self)
 {
     Py_XDECREF(self->plan);
+    Py_XDECREF(self->hot);
     PyMem_Free(self->buf);
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
@@ -722,52 +740,165 @@ static PyObject *FrameReader_feed(FrameReaderObject *self, PyObject *data)
     Py_RETURN_NONE;
 }
 
+/* parse ONE complete frame at self->pos into a (header, body) pair.
+ * 1 = parsed (pair set, pos advanced), 0 = incomplete, -1 = error. */
+static int fr_parse_one(FrameReaderObject *self, PyObject **pair_out)
+{
+    Py_ssize_t avail = self->len - self->pos;
+    if (avail < 8)
+        return 0;
+    const unsigned char *p = self->buf + self->pos;
+    uint32_t plen, hlen;
+    memcpy(&plen, p, 4); /* little-endian host assumed (x86/arm) */
+    memcpy(&hlen, p + 4, 4);
+    if (plen < 4 || (Py_ssize_t)hlen > (Py_ssize_t)plen - 4) {
+        RAISE("corrupt frame lengths");
+        return -1;
+    }
+    if (avail < 4 + (Py_ssize_t)plen)
+        return 0;
+    Rd r = {p + 8, (Py_ssize_t)hlen, 0};
+    PyObject *header = dec_struct(self->plan, &r);
+    if (!header)
+        return -1;
+    if (r.off != r.len) {
+        Py_DECREF(header);
+        RAISE("trailing bytes after header");
+        return -1;
+    }
+    PyObject *body = PyBytes_FromStringAndSize(
+        (const char *)p + 8 + hlen, (Py_ssize_t)plen - 4 - hlen);
+    if (!body) {
+        Py_DECREF(header);
+        return -1;
+    }
+    PyObject *pair = PyTuple_Pack(2, header, body);
+    Py_DECREF(header);
+    Py_DECREF(body);
+    if (!pair)
+        return -1;
+    self->pos += 4 + (Py_ssize_t)plen;
+    *pair_out = pair;
+    return 1;
+}
+
 /* parse every complete frame at self->pos into `out`; 0 ok, -1 error */
 static int fr_parse_frames(FrameReaderObject *self, PyObject *out)
 {
     for (;;) {
-        Py_ssize_t avail = self->len - self->pos;
-        if (avail < 8)
-            return 0;
-        const unsigned char *p = self->buf + self->pos;
-        uint32_t plen, hlen;
-        memcpy(&plen, p, 4); /* little-endian host assumed (x86/arm) */
-        memcpy(&hlen, p + 4, 4);
-        if (plen < 4 || (Py_ssize_t)hlen > (Py_ssize_t)plen - 4) {
-            RAISE("corrupt frame lengths");
-            return -1;
-        }
-        if (avail < 4 + (Py_ssize_t)plen)
-            return 0;
-        Rd r = {p + 8, (Py_ssize_t)hlen, 0};
-        PyObject *header = dec_struct(self->plan, &r);
-        if (!header)
-            return -1;
-        if (r.off != r.len) {
-            Py_DECREF(header);
-            RAISE("trailing bytes after header");
-            return -1;
-        }
-        PyObject *body = PyBytes_FromStringAndSize(
-            (const char *)p + 8 + hlen, (Py_ssize_t)plen - 4 - hlen);
-        if (!body) {
-            Py_DECREF(header);
-            return -1;
-        }
-        PyObject *pair = PyTuple_Pack(2, header, body);
-        Py_DECREF(header);
-        Py_DECREF(body);
-        if (!pair)
-            return -1;
-        int rc = PyList_Append(out, pair);
+        PyObject *pair;
+        int rc = fr_parse_one(self, &pair);
+        if (rc <= 0)
+            return rc;
+        rc = PyList_Append(out, pair);
         Py_DECREF(pair);
         if (rc < 0)
             return -1;
-        self->pos += 4 + (Py_ssize_t)plen;
     }
 }
 
-static PyObject *FrameReader_read_wave(FrameReaderObject *self, PyObject *arg)
+/* The dispatch variant: every complete frame parsed AND binned by hot
+ * task code. Output entries are (code str, [(header, body), ...]) in
+ * first-arrival order; frames whose code is in self->hot coalesce into
+ * the entry opened by their first frame, every other frame gets its own
+ * singleton entry — so Python dispatches hot read codes once per BATCH
+ * instead of once per frame. */
+static int fr_parse_frames_binned(FrameReaderObject *self, PyObject *out)
+{
+    PyObject *bins[FR_MAX_HOT]; /* borrowed: each list lives in `out` */
+    Py_ssize_t nhot = self->hot ? PyTuple_GET_SIZE(self->hot) : 0;
+    for (Py_ssize_t i = 0; i < nhot; i++)
+        bins[i] = NULL;
+    for (;;) {
+        PyObject *pair;
+        int rc = fr_parse_one(self, &pair);
+        if (rc <= 0)
+            return rc;
+        PyObject *code = PyObject_GetAttr(PyTuple_GET_ITEM(pair, 0),
+                                          str_code);
+        if (!code) {
+            Py_DECREF(pair);
+            return -1;
+        }
+        Py_ssize_t hot_idx = -1;
+        for (Py_ssize_t i = 0; i < nhot; i++) {
+            int eq = PyObject_RichCompareBool(
+                code, PyTuple_GET_ITEM(self->hot, i), Py_EQ);
+            if (eq < 0) {
+                Py_DECREF(code);
+                Py_DECREF(pair);
+                return -1;
+            }
+            if (eq) {
+                hot_idx = i;
+                break;
+            }
+        }
+        if (hot_idx >= 0 && bins[hot_idx]) {
+            rc = PyList_Append(bins[hot_idx], pair);
+            Py_DECREF(code);
+            Py_DECREF(pair);
+            if (rc < 0)
+                return -1;
+            continue;
+        }
+        PyObject *lst = PyList_New(0);
+        if (!lst || PyList_Append(lst, pair) < 0) {
+            Py_XDECREF(lst);
+            Py_DECREF(code);
+            Py_DECREF(pair);
+            return -1;
+        }
+        Py_DECREF(pair);
+        PyObject *entry = PyTuple_Pack(2, code, lst);
+        Py_DECREF(code);
+        if (!entry) {
+            Py_DECREF(lst);
+            return -1;
+        }
+        rc = PyList_Append(out, entry);
+        Py_DECREF(entry);
+        if (rc < 0) {
+            Py_DECREF(lst);
+            return -1;
+        }
+        if (hot_idx >= 0)
+            bins[hot_idx] = lst; /* borrowed; `out` keeps it alive */
+        Py_DECREF(lst);
+    }
+}
+
+/* one recv() with the GIL released into the (pre-reserved) buffer tail;
+ * 0 ok (len advanced), -1 = Python error already set */
+static int fr_recv(FrameReaderObject *self, long fd)
+{
+    if (fr_reserve(self, 1 << 18) < 0)
+        return -1;
+    Py_ssize_t n;
+    for (;;) {
+        Py_BEGIN_ALLOW_THREADS
+        n = recv((int)fd, self->buf + self->len,
+                 (size_t)(self->cap - self->len), 0);
+        Py_END_ALLOW_THREADS
+        if (n >= 0 || errno != EINTR)
+            break;
+        if (PyErr_CheckSignals() < 0)
+            return -1;
+    }
+    if (n == 0) {
+        PyErr_SetString(PyExc_ConnectionError, "peer closed");
+        return -1;
+    }
+    if (n < 0) {
+        PyErr_SetFromErrno(PyExc_OSError);
+        return -1;
+    }
+    self->len += n;
+    return 0;
+}
+
+static PyObject *fr_read_loop(FrameReaderObject *self, PyObject *arg,
+                              int (*parse)(FrameReaderObject *, PyObject *))
 {
     long fd = PyLong_AsLong(arg);
     if (fd == -1 && PyErr_Occurred())
@@ -776,40 +907,28 @@ static PyObject *FrameReader_read_wave(FrameReaderObject *self, PyObject *arg)
     if (!out)
         return NULL;
     for (;;) {
-        if (fr_parse_frames(self, out) < 0) {
+        if (parse(self, out) < 0) {
             Py_DECREF(out);
             return NULL;
         }
         if (PyList_GET_SIZE(out) > 0)
             return out;
-        if (fr_reserve(self, 1 << 18) < 0) {
+        if (fr_recv(self, fd) < 0) {
             Py_DECREF(out);
             return NULL;
         }
-        Py_ssize_t n;
-        for (;;) {
-            Py_BEGIN_ALLOW_THREADS
-            n = recv((int)fd, self->buf + self->len,
-                     (size_t)(self->cap - self->len), 0);
-            Py_END_ALLOW_THREADS
-            if (n >= 0 || errno != EINTR)
-                break;
-            if (PyErr_CheckSignals() < 0) {
-                Py_DECREF(out);
-                return NULL;
-            }
-        }
-        if (n == 0) {
-            Py_DECREF(out);
-            PyErr_SetString(PyExc_ConnectionError, "peer closed");
-            return NULL;
-        }
-        if (n < 0) {
-            Py_DECREF(out);
-            return PyErr_SetFromErrno(PyExc_OSError);
-        }
-        self->len += n;
     }
+}
+
+static PyObject *FrameReader_read_wave(FrameReaderObject *self, PyObject *arg)
+{
+    return fr_read_loop(self, arg, fr_parse_frames);
+}
+
+static PyObject *FrameReader_read_wave_binned(FrameReaderObject *self,
+                                              PyObject *arg)
+{
+    return fr_read_loop(self, arg, fr_parse_frames_binned);
 }
 
 static PyMethodDef FrameReader_methods[] = {
@@ -817,6 +936,9 @@ static PyMethodDef FrameReader_methods[] = {
      "feed(bytes): preload already-read bytes into the buffer"},
     {"read_wave", (PyCFunction)FrameReader_read_wave, METH_O,
      "read_wave(fd) -> [(header, body), ...]; blocks for >=1 frame"},
+    {"read_wave_binned", (PyCFunction)FrameReader_read_wave_binned, METH_O,
+     "read_wave_binned(fd) -> [(code, [(header, body), ...]), ...];\n"
+     "frames with a hot code coalesce into one entry per wave"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -830,6 +952,133 @@ static PyTypeObject FrameReader_Type = {
     .tp_methods = FrameReader_methods,
 };
 
+/* ------------------------------------------------------- vectored writer */
+
+#ifndef FC_IOV_MAX /* stay under every libc's UIO_MAXIOV (>= 1024) */
+#define FC_IOV_MAX 1000
+#endif
+
+/* sendmsg_frames(fd, [(header_bytes, body), ...]) -> total bytes sent.
+ * Encodes the 8-byte length prefix for every frame into one arena and
+ * gathers prefix+header+body iovecs into as few sendmsg() calls as
+ * IOV_MAX allows, with the GIL released for the syscalls — the whole
+ * response wave leaves in one C call instead of len(wave) Python
+ * send()s. */
+static PyObject *sendmsg_frames(PyObject *mod, PyObject *args)
+{
+    long fd;
+    PyObject *pairs;
+    if (!PyArg_ParseTuple(args, "lO", &fd, &pairs))
+        return NULL;
+    PyObject *seq = PySequence_Fast(pairs, "pairs must be a sequence");
+    if (!seq)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n == 0) {
+        Py_DECREF(seq);
+        return PyLong_FromLong(0);
+    }
+    Py_buffer *bufs = PyMem_Calloc((size_t)(2 * n), sizeof(Py_buffer));
+    unsigned char *prefix = PyMem_Malloc((size_t)(8 * n));
+    struct iovec *iov = PyMem_Malloc((size_t)(3 * n) * sizeof(struct iovec));
+    Py_ssize_t nbufs = 0;
+    PyObject *result = NULL;
+    if (!bufs || !prefix || !iov) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "pairs items must be (header, body) tuples");
+            goto done;
+        }
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(pair, 0), &bufs[2 * i],
+                               PyBUF_SIMPLE) < 0)
+            goto done;
+        nbufs++;
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(pair, 1), &bufs[2 * i + 1],
+                               PyBUF_SIMPLE) < 0)
+            goto done;
+        nbufs++;
+        Py_ssize_t hlen = bufs[2 * i].len, blen = bufs[2 * i + 1].len;
+        Py_ssize_t plen = 4 + hlen + blen;
+        if (hlen > (Py_ssize_t)UINT32_MAX || plen > (Py_ssize_t)UINT32_MAX) {
+            RAISE("frame too large");
+            goto done;
+        }
+        uint32_t w = (uint32_t)plen;
+        memcpy(prefix + 8 * i, &w, 4); /* little-endian host assumed */
+        w = (uint32_t)hlen;
+        memcpy(prefix + 8 * i + 4, &w, 4);
+        iov[3 * i].iov_base = prefix + 8 * i;
+        iov[3 * i].iov_len = 8;
+        iov[3 * i + 1].iov_base = bufs[2 * i].buf;
+        iov[3 * i + 1].iov_len = (size_t)hlen;
+        iov[3 * i + 2].iov_base = bufs[2 * i + 1].buf;
+        iov[3 * i + 2].iov_len = (size_t)blen;
+    }
+    {
+        Py_ssize_t iovcnt = 3 * n, idx = 0;
+        unsigned long long total = 0;
+        while (idx < iovcnt) {
+            /* skip fully-consumed entries so msg_iovlen counts real work */
+            if (iov[idx].iov_len == 0) {
+                idx++;
+                continue;
+            }
+            Py_ssize_t cnt = iovcnt - idx;
+            if (cnt > FC_IOV_MAX)
+                cnt = FC_IOV_MAX;
+            struct msghdr msg;
+            memset(&msg, 0, sizeof(msg));
+            msg.msg_iov = iov + idx;
+            msg.msg_iovlen = (size_t)cnt;
+            ssize_t s;
+            Py_BEGIN_ALLOW_THREADS
+            s = sendmsg((int)fd, &msg, MSG_NOSIGNAL);
+            Py_END_ALLOW_THREADS
+            if (s < 0) {
+                if (errno == EINTR) {
+                    if (PyErr_CheckSignals() < 0)
+                        goto done;
+                    continue;
+                }
+                if (errno == EPIPE || errno == ECONNRESET) {
+                    PyErr_SetString(PyExc_ConnectionError,
+                                    "peer closed during vectored send");
+                    goto done;
+                }
+                PyErr_SetFromErrno(PyExc_OSError);
+                goto done;
+            }
+            total += (unsigned long long)s;
+            size_t left = (size_t)s; /* advance past what the kernel took */
+            while (left > 0) {
+                if (iov[idx].iov_len <= left) {
+                    left -= iov[idx].iov_len;
+                    iov[idx].iov_len = 0;
+                    idx++;
+                } else {
+                    iov[idx].iov_base = (char *)iov[idx].iov_base + left;
+                    iov[idx].iov_len -= left;
+                    left = 0;
+                }
+            }
+        }
+        result = PyLong_FromUnsignedLongLong(total);
+    }
+done:
+    for (Py_ssize_t i = 0; i < nbufs; i++)
+        PyBuffer_Release(&bufs[i]);
+    PyMem_Free(iov);
+    PyMem_Free(prefix);
+    PyMem_Free(bufs);
+    Py_DECREF(seq);
+    return result;
+}
+
 /* ----------------------------------------------------------------- module */
 
 static PyObject *register_error(PyObject *mod, PyObject *exc)
@@ -842,6 +1091,9 @@ static PyObject *register_error(PyObject *mod, PyObject *exc)
 static PyMethodDef mod_methods[] = {
     {"register_error", register_error, METH_O,
      "register the CodecError class raised on malformed data"},
+    {"sendmsg_frames", sendmsg_frames, METH_VARARGS,
+     "sendmsg_frames(fd, [(header, body), ...]) -> bytes sent;\n"
+     "vectored frame write with length prefixes, GIL released"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -853,6 +1105,9 @@ static struct PyModuleDef fastcodec_module = {
 PyMODINIT_FUNC PyInit_fastcodec(void)
 {
     if (PyType_Ready(&Plan_Type) < 0 || PyType_Ready(&FrameReader_Type) < 0)
+        return NULL;
+    str_code = PyUnicode_InternFromString("code");
+    if (!str_code)
         return NULL;
     PyObject *m = PyModule_Create(&fastcodec_module);
     if (!m)

@@ -91,17 +91,79 @@ def _send_frame(sock, header: RpcHeader, body: bytes, lock=None) -> None:
         sock.sendall(frame)
 
 
+# the native read data plane's attribution counters (ISSUE 20): waves
+# drained by the C reader, frames that arrived pre-binned into hot-code
+# batches, and vectored sends. With PEGASUS_NATIVE=0 all four flatline —
+# the bench A/B and the metric-history fallback regression both read
+# these.
+_C_WAVE = counters.rate("native.wave_count")
+_C_BATCH_FRAMES = counters.rate("native.batch_frames")
+_C_WRITEV = counters.rate("native.writev_count")
+_C_WRITEV_BYTES = counters.rate("native.writev_bytes")
+
+
+def _native_writer():
+    """-> the fastcodec module when the native vectored writer should be
+    used, else None (knob off, extension absent/stale, or the
+    ``serve.native`` fail point forcing the pure-Python twin)."""
+    from .. import native
+
+    if not native.native_on():
+        return None
+    fc = native.fastcodec()
+    if fc is None or not hasattr(fc, "sendmsg_frames"):
+        return None
+    try:
+        if fail_point("serve.native") is not None:
+            return None
+    except FailPointError:
+        return None
+    return fc
+
+
+def _send_encoded_frames(sock, enc, lock=None) -> None:
+    """Vectored frame write: `enc` is [(header_bytes, body), ...] and the
+    whole wave leaves in one call. Native path: fastcodec.sendmsg_frames
+    gathers length prefixes + headers + bodies into iovecs and sendmsg()s
+    with the GIL released (zero body copies). Fallback: one coalesced
+    bytearray + sendall. Both write the exact same bytes in the exact
+    same order — the byte-identity test pins that."""
+    fc = _native_writer()
+    ctx = lock if lock is not None else nullcontext()
+    with ctx:
+        if fc is not None:
+            fd = sock.fileno()
+            if fd >= 0:
+                sent = fc.sendmsg_frames(fd, enc)
+                _C_WRITEV.increment()
+                _C_WRITEV_BYTES.increment(sent)
+                return
+        buf = bytearray()
+        for h, b in enc:
+            buf += struct.pack("<II", 4 + len(h) + len(b), len(h))
+            buf += h
+            buf += b
+        sock.sendall(buf)
+
+
+def _send_frames(sock, pairs, lock=None) -> None:
+    """_send_encoded_frames over [(RpcHeader, body), ...]."""
+    _send_encoded_frames(sock, [(codec.encode(h), b) for h, b in pairs],
+                         lock=lock)
+
+
 class _FrameReader:
     """Buffered framing for a socket with a SINGLE reader thread: one
     kernel recv typically yields several pipelined frames (length word +
     header + body used to cost 2+ recv syscalls per frame)."""
 
-    __slots__ = ("sock", "buf", "pos")
+    __slots__ = ("sock", "buf", "pos", "hot")
 
-    def __init__(self, sock, initial: bytes = b""):
+    def __init__(self, sock, initial: bytes = b"", hot=()):
         self.sock = sock
         self.buf = bytearray(initial)
         self.pos = 0
+        self.hot = frozenset(hot)
 
     def _fill(self, need: int) -> None:
         buf = self.buf
@@ -121,6 +183,10 @@ class _FrameReader:
         self._fill(4 + plen)
         pos = self.pos  # _fill may have compacted
         (hlen,) = struct.unpack_from("<I", self.buf, pos + 4)
+        if plen < 4 or hlen > plen - 4:
+            # same validation, same error class as the C reader — the
+            # adversarial-frame differential test pins the parity
+            raise codec.CodecError("corrupt frame lengths")
         mv = memoryview(self.buf)
         try:
             header = codec.decode(RpcHeader, mv[pos + 8 : pos + 8 + hlen])
@@ -146,6 +212,25 @@ class _FrameReader:
             out.append(self.frame())
         return out
 
+    def wave_batched(self):
+        """wave() binned by hot task code — the pure-Python twin of
+        fastcodec's read_wave_binned, same coalescing semantics: frames
+        whose code is in `hot` join ONE (code, frames) entry opened at
+        their first frame's arrival position; every other frame gets a
+        singleton entry in arrival order."""
+        out, bins = [], {}
+        for header, body in self.wave():
+            code = header.code
+            lst = bins.get(code)
+            if lst is not None:
+                lst.append((header, body))
+                continue
+            lst = [(header, body)]
+            if code in self.hot:
+                bins[code] = lst
+            out.append((code, lst))
+        return out
+
 
 class _NativeFrameReader:
     """fastcodec.FrameReader wrapper: drains a pipelined frame wave in ONE
@@ -154,13 +239,13 @@ class _NativeFrameReader:
 
     __slots__ = ("sock", "fr")
 
-    def __init__(self, fc, sock, initial: bytes = b""):
+    def __init__(self, fc, sock, initial: bytes = b"", hot=()):
         self.sock = sock
-        self.fr = fc.FrameReader(codec._plan_of(RpcHeader))
+        self.fr = fc.FrameReader(codec._plan_of(RpcHeader), tuple(hot))
         if initial:
             self.fr.feed(initial)
 
-    def wave(self):
+    def _fd(self):
         # resolve the fd per wave, never cache it: after sock.close() (a
         # timed-out connection being invalidated under this reader) the
         # number can be REUSED by a brand-new socket, and a cached fd
@@ -169,21 +254,41 @@ class _NativeFrameReader:
         fd = self.sock.fileno()
         if fd < 0:
             raise ConnectionError("socket closed")
-        return self.fr.read_wave(fd)
+        return fd
+
+    def wave(self):
+        wave = self.fr.read_wave(self._fd())
+        _C_WAVE.increment()
+        return wave
+
+    def wave_batched(self):
+        """Binned dispatch wave: header parse + hot-code binning both
+        happen in C; Python sees [(code, [(header, body), ...]), ...]."""
+        wave = self.fr.read_wave_binned(self._fd())
+        _C_WAVE.increment()
+        for _, frames in wave:
+            if len(frames) > 1:
+                _C_BATCH_FRAMES.increment(len(frames))
+        return wave
 
 
-def make_frame_reader(sock, initial: bytes = b""):
+def make_frame_reader(sock, initial: bytes = b"", hot=()):
     """Best available frame reader for a blocking socket: the C wave
-    drainer when fastcodec is importable AND the RpcHeader plan compiled
-    to a C plan (a Python-plan header would hand the C reader an
-    incompatible object), else the buffered Python reader."""
+    drainer when PEGASUS_NATIVE is on, fastcodec is importable (with the
+    binned-wave entry point — an older .so without it must not be half
+    used) AND the RpcHeader plan compiled to a C plan (a Python-plan
+    header would hand the C reader an incompatible object), else the
+    buffered Python reader. `hot` is the task codes to coalesce into
+    per-code batches in wave_batched()."""
     from .. import native
 
-    fc = native.fastcodec()
-    if fc is not None and hasattr(fc, "FrameReader") \
-            and isinstance(codec._plan_of(RpcHeader), fc.Plan):
-        return _NativeFrameReader(fc, sock, initial)
-    return _FrameReader(sock, initial)
+    if native.native_on():
+        fc = native.fastcodec()
+        if fc is not None and hasattr(fc, "FrameReader") \
+                and hasattr(fc.FrameReader, "read_wave_binned") \
+                and isinstance(codec._plan_of(RpcHeader), fc.Plan):
+            return _NativeFrameReader(fc, sock, initial, hot)
+    return _FrameReader(sock, initial, hot)
 
 
 class RpcServer:
@@ -211,6 +316,11 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._handlers = {}
+        # hot read codes with a BATCH handler: fn(headers, bodies) ->
+        # per-frame results (bytes | RpcError | Exception). The frame
+        # reader coalesces these codes in C (ISSUE 20) and dispatch
+        # enters Python once per batch instead of once per frame.
+        self._batch_handlers = {}
         self._middlewares = []   # fn(code, header, body, next) -> body
         from ..runtime.tasking import tracked_executor
 
@@ -253,10 +363,19 @@ class RpcServer:
         with self._conn_lock:
             self._conns.add(sock)
         try:
-            reader = make_frame_reader(sock, initial)
+            # always bin hot codes — the C wave amortization holds even
+            # when middlewares (tracer/profiler/fault toollets) are
+            # installed, because _dispatch_batch routes those batches
+            # back through the per-frame path, middleware chain intact
+            hot = tuple(self._batch_handlers)
+            reader = make_frame_reader(sock, initial, hot)
             while True:
-                for header, body in reader.wave():
-                    dispatch(sock, wlock, header, body)
+                for code, frames in reader.wave_batched():
+                    if len(frames) == 1:
+                        header, body = frames[0]
+                        dispatch(sock, wlock, header, body)
+                    else:
+                        self._dispatch_batch(sock, wlock, code, frames)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -281,10 +400,23 @@ class RpcServer:
     def register(self, code: str, handler) -> None:
         self._handlers[code] = handler
 
+    def register_batch(self, code: str, handler) -> None:
+        """Register a batch handler: fn(headers, bodies) -> one result
+        per frame, each bytes (success), RpcError, or any Exception
+        (encoded exactly like the per-frame path encodes them). The code
+        MUST also have a per-frame handler — singleton frames, traced
+        frames, middleware'd connections and the serve.native fallback
+        all still route per frame."""
+        self._batch_handlers[code] = handler
+
     def register_serverlet(self, obj) -> None:
-        """Register every (code, fn) pair from obj.rpc_handlers()."""
+        """Register every (code, fn) pair from obj.rpc_handlers(), plus
+        obj.rpc_batch_handlers() when the serverlet provides them."""
         for code, fn in obj.rpc_handlers().items():
             self.register(code, fn)
+        for code, fn in getattr(obj, "rpc_batch_handlers",
+                                dict)().items():
+            self.register_batch(code, fn)
 
     def add_middleware(self, mw) -> None:
         """mw(code, header, body, next_fn) -> response body. The rDSN
@@ -396,6 +528,105 @@ class RpcServer:
             counters.rate("rpc.server.error_count").increment()
         try:
             _send_frame(sock, resp, out, lock=wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    def _dispatch_batch(self, sock, wlock, code: str, frames) -> None:
+        """Dispatch a hot-code batch the reader coalesced: ONE pool task,
+        ONE handler call, ONE vectored reply write for the whole batch.
+        Falls back to per-frame dispatch when the serve.native fail point
+        triggers mid-wave, when any frame carries a trace context (spans
+        must attach per request), or when middlewares are installed
+        (tracer/profiler/fault toollets wrap per-frame handlers; the C
+        wave binning still amortizes the read side) — the per-frame twin
+        produces byte-identical responses, so the fallback is invisible
+        on the wire."""
+        batch_ok = True
+        try:
+            if fail_point("serve.native") is not None:
+                batch_ok = False
+        except FailPointError:
+            batch_ok = False
+        if (not batch_ok or self._middlewares
+                or code not in self._batch_handlers
+                or any(h.trace_id for h, _ in frames)):
+            for header, body in frames:
+                self._dispatch(sock, wlock, header, body)
+            return
+        # serve.dispatch fires once per batch — the batch IS one dispatch
+        try:
+            fail_point("serve.dispatch")
+        except FailPointError as e:
+            err = counters.rate("rpc.server.error_count")
+            pairs = []
+            for header, _ in frames:
+                pairs.append((RpcHeader(
+                    seq=header.seq, code=header.code, is_response=True,
+                    error=ERR_BUSY, error_text=str(e)), b""))
+                err.increment()
+                if header.app_id:
+                    from ..runtime.table_stats import TABLE_STATS
+
+                    TABLE_STATS.charge_app_error(header.app_id)
+            try:
+                _send_frames(sock, pairs, lock=wlock)
+            except (ConnectionError, OSError):
+                pass
+            return
+        with self._busy_lock:
+            self._busy += 1
+            depth = self._busy - self.POOL_WORKERS
+        if depth > 0:
+            self._depth_gauge.set(depth)
+        try:
+            self._pool.submit(self._serve_batch_pooled, sock, wlock, code,
+                              frames)
+        except RuntimeError:   # server stopping: pool already shut down
+            with self._busy_lock:
+                self._busy -= 1
+
+    def _serve_batch_pooled(self, sock, wlock, code, frames) -> None:
+        try:
+            self._serve_batch(sock, wlock, code, frames)
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+                depth = self._busy - self.POOL_WORKERS
+            self._depth_gauge.set(max(0, depth))
+
+    def _serve_batch(self, sock, wlock, code: str, frames) -> None:
+        t0 = time.perf_counter()
+        headers = [h for h, _ in frames]
+        bodies = [b for _, b in frames]
+        try:
+            results = self._batch_handlers[code](headers, bodies)
+        except Exception as e:  # handler bug -> errors, not a dead conn
+            results = [e] * len(frames)
+        pairs, n_err = [], 0
+        for header, res in zip(headers, results):
+            resp = RpcHeader(seq=header.seq, code=header.code,
+                             is_response=True)
+            out = b""
+            if isinstance(res, RpcError):
+                resp.error, resp.error_text = res.err, res.text
+            elif isinstance(res, BaseException):
+                resp.error, resp.error_text = ERR_INVALID_DATA, repr(res)
+            else:
+                out = res
+            if resp.error:
+                n_err += 1
+            pairs.append((resp, out))
+        # same counter cardinality as the per-frame path: one qps tick
+        # and one latency sample PER FRAME (the batch shares its elapsed)
+        elapsed = int((time.perf_counter() - t0) * 1e6)
+        counters.rate("rpc.server.qps").increment(len(frames))
+        lat = counters.percentile("rpc.server.latency_us")
+        for _ in frames:
+            lat.set(elapsed)
+        if n_err:
+            counters.rate("rpc.server.error_count").increment(n_err)
+        try:
+            _send_frames(sock, pairs, lock=wlock)
         except (ConnectionError, OSError):
             pass
 
@@ -524,7 +755,7 @@ class RpcConnection:
             raise RpcError(ERR_NETWORK_FAILURE, str(self._dead))
         ctx = REQUEST_TRACER.current()
         sharded = self.shard is not None
-        pend, buf = [], bytearray()
+        pend, enc, total = [], [], 0
         with self._plock:
             for call in calls:
                 code, body = call[0], call[1]
@@ -543,14 +774,15 @@ class RpcConnection:
                     trace_sampled=bool(ctx and ctx.sampled),
                     sharded=sharded)
                 h = codec.encode(header)
-                buf += struct.pack("<II", 4 + len(h) + len(body), len(h))
-                buf += h
-                buf += body
-        with REQUEST_TRACER.span("rpc.call_many", bytes=len(buf),
+                enc.append((h, body))
+                total += 8 + len(h) + len(body)
+        with REQUEST_TRACER.span("rpc.call_many", bytes=total,
                                  records=len(calls)):
             try:
-                with self._wlock:
-                    self._sock.sendall(buf)
+                # vectored when native: the frame bodies go straight into
+                # iovecs with the GIL released, instead of being copied
+                # into one coalesced bytearray first
+                _send_encoded_frames(self._sock, enc, lock=self._wlock)
             except (ConnectionError, OSError) as e:
                 with self._plock:
                     for seq, _, _ in pend:

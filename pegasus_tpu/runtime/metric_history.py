@@ -61,6 +61,12 @@ _DEFAULT_PREFIXES = (
     # device-vs-host split — a fallback storm shows up as host_count
     # climbing in the history window
     "read.range.",
+    # native read data plane (ISSUE 20): wave/batch/writev rates — a
+    # fallback-to-Python regression (stale .so, knob flipped, fail
+    # point left armed) shows as these flatlining while rpc.server.qps
+    # holds. "serve." also widens the old "serve.group." sample to the
+    # serving plane's other series
+    "native.", "serve.",
 )
 
 

@@ -48,6 +48,20 @@ if not os.environ.get("PEGASUS_TEST_TPU"):
 
     jax.config.update("jax_platforms", "cpu")
 
+# rebuild any stale native artifact BEFORE the first pegasus_tpu import
+# caches a loaded .so (ISSUE 20): tier-1 must never silently exercise a
+# binary older than its C source. Failures degrade loudly to the
+# pure-Python twins and never fail collection.
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    from tools import build_native  # noqa: E402
+
+    build_native.ensure()
+except Exception as _e:  # noqa: BLE001 - the gate is best-effort
+    print(f"[conftest] build_native: {_e!r}")
+
 # persistent compile cache: the suite jit-compiles many static shapes; cold
 # runs took 7 minutes in round 1 (VERDICT weak #9)
 from pegasus_tpu.base.utils import enable_compile_cache  # noqa: E402
